@@ -1,0 +1,39 @@
+"""chainermn_tpu — TPU-native distributed training framework.
+
+From-scratch SPMD re-design of the reference ChainerMN
+(``abiraja2004/chainermn``; see ``SURVEY.md``): communicators over
+:class:`jax.sharding.Mesh` instead of NCCL/MPI, collectives as XLA ops inside
+jitted steps, differentiable comm functions via ``shard_map`` AD, and
+training/data/fault-tolerance integration re-built on optax/orbax.
+
+API facade (reference anchor: ``chainermn/__init__.py``).
+"""
+
+from chainermn_tpu.comm import (
+    CommunicatorBase,
+    DummyCommunicator,
+    XlaCommunicator,
+    create_communicator,
+    flat_mesh,
+    hybrid_mesh,
+    topology_mesh,
+)
+
+__version__ = "0.1.0"
+
+# Populated as subpackages land; mirrors the reference facade exports:
+# create_multi_node_optimizer, create_multi_node_evaluator, scatter_dataset,
+# create_empty_dataset, create_multi_node_checkpointer, iterators, functions,
+# links.
+from chainermn_tpu import comm  # noqa: E402
+
+__all__ = [
+    "CommunicatorBase",
+    "DummyCommunicator",
+    "XlaCommunicator",
+    "create_communicator",
+    "flat_mesh",
+    "hybrid_mesh",
+    "topology_mesh",
+    "comm",
+]

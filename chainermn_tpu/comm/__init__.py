@@ -1,0 +1,75 @@
+"""Communicator factory.
+
+Reference anchor: ``chainermn/communicators/__init__.py — create_communicator``.
+Every GPU-era communicator name maps to :class:`XlaCommunicator` with an
+appropriate mesh, because the hand-written NCCL/MPI hierarchies are what XLA's
+ICI/DCN collective scheduler does internally (see ``SURVEY.md`` §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+
+from . import mesh as mesh_lib
+from .base import CommunicatorBase
+from .mesh import flat_mesh, hybrid_mesh, topology_mesh, Topology
+from .xla import DummyCommunicator, XlaCommunicator
+
+__all__ = [
+    "CommunicatorBase",
+    "XlaCommunicator",
+    "DummyCommunicator",
+    "create_communicator",
+    "flat_mesh",
+    "hybrid_mesh",
+    "topology_mesh",
+    "Topology",
+]
+
+_HIERARCHICAL = {"hierarchical", "two_dimensional", "non_cuda_aware"}
+_FLAT = {"xla", "pure_nccl", "flat", "single_node"}
+
+
+def create_communicator(
+    communicator_name: str = "hierarchical",
+    mesh=None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    allreduce_grad_dtype: Optional[Any] = None,
+) -> CommunicatorBase:
+    """Create a communicator (reference signature:
+    ``create_communicator(communicator_name='hierarchical', mpi_comm=None,
+    allreduce_grad_dtype=None)``; ``mpi_comm`` → ``mesh``/``devices``).
+
+    Names:
+      * ``hierarchical`` / ``two_dimensional`` / ``non_cuda_aware`` — topology
+        ``(inter, intra)`` mesh (host × chip), collectives ride ICI first.
+      * ``xla`` / ``pure_nccl`` / ``flat`` / ``single_node`` — flat 1-D mesh.
+      * ``naive`` — flat mesh over CPU devices (the GPU-free CI path).
+      * ``dummy`` — no-op allreduce, benchmarking only.
+
+    ``allreduce_grad_dtype`` (fp16/bf16) enables the reduced-precision wire
+    format of the reference's ``pure_nccl`` path, for any name.
+    """
+    name = communicator_name
+    if name == "dummy":
+        return DummyCommunicator(
+            mesh=mesh if mesh is not None else flat_mesh(devices),
+            allreduce_grad_dtype=allreduce_grad_dtype,
+        )
+    if name == "naive":
+        if mesh is None:
+            if devices is None:
+                devices = jax.devices("cpu")
+            mesh = flat_mesh(devices)
+        return XlaCommunicator(mesh=mesh, allreduce_grad_dtype=allreduce_grad_dtype)
+    if name in _FLAT:
+        if mesh is None:
+            mesh = flat_mesh(devices)
+        return XlaCommunicator(mesh=mesh, allreduce_grad_dtype=allreduce_grad_dtype)
+    if name in _HIERARCHICAL:
+        if mesh is None:
+            mesh = topology_mesh(devices)
+        return XlaCommunicator(mesh=mesh, allreduce_grad_dtype=allreduce_grad_dtype)
+    raise ValueError(f"unknown communicator name {communicator_name!r}")

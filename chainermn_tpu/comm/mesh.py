@@ -1,0 +1,133 @@
+"""Device-mesh construction for communicators.
+
+TPU-native replacement for the reference's topology bootstrap
+(``chainermn/communicators/_communication_utility.py`` — ``init_ranks``,
+``init_intra_mpi_comm``, ``init_inter_mpi_comm``, ``init_nccl_comm``): instead of
+allgathering hostnames over MPI and splitting intra/inter MPI+NCCL communicators,
+we build a :class:`jax.sharding.Mesh` whose axes encode the same topology —
+``inter`` = across hosts (DCN), ``intra`` = chips within a host (ICI) — and let
+XLA's collective scheduler pick hierarchical algorithms (the hand-written
+hierarchical/two-dimensional communicator tricks of the reference are what XLA
+already does internally over ICI/DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+#: Canonical axis names used throughout the framework.
+INTER_AXIS = "inter"  # across hosts (DCN plane)
+INTRA_AXIS = "intra"  # chips within a host (ICI plane)
+DATA_AXIS = "data"  # flat data-parallel axis (single-axis meshes)
+
+
+def topology_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(inter, intra)`` mesh mirroring the host/chip topology.
+
+    Equivalent of the reference's ``init_ranks`` (hostname allgather →
+    ``(intra_rank, inter_rank)`` assignment): device.process_index plays the role
+    of the hostname.  Ranks are ordered host-major so the collapsed linear rank
+    ``inter_rank * intra_size + intra_rank`` matches MPI's typical rank layout.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    n_proc = len(by_proc)
+    per_proc = {p: len(ds) for p, ds in by_proc.items()}
+    intra = min(per_proc.values())
+    if any(v != intra for v in per_proc.values()):
+        # Ragged hosts: fall back to a flat layout factored as (n, 1).
+        arr = np.array(devices).reshape(len(devices), 1)
+        return Mesh(arr, (INTER_AXIS, INTRA_AXIS))
+    arr = np.empty((n_proc, intra), dtype=object)
+    for i, p in enumerate(sorted(by_proc)):
+        # Sort within a process by device id for a stable intra order.
+        arr[i, :] = sorted(by_proc[p], key=lambda d: d.id)
+    return Mesh(arr, (INTER_AXIS, INTRA_AXIS))
+
+
+def flat_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = DATA_AXIS,
+) -> Mesh:
+    """A 1-D mesh over all devices — the ``pure_nccl`` analog (one flat ring)."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(list(devices)), (axis_name,))
+
+
+def hybrid_mesh(
+    shape: dict,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """An N-D mesh from ``{axis_name: size}`` — the hybrid DP×MP process-grid
+    analog of the reference's ``CommunicatorBase.split`` two-level usage.
+
+    Example: ``hybrid_mesh({"data": 4, "model": 2})`` on 8 devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    sizes = tuple(shape.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {int(np.prod(sizes))} devices, "
+            f"got {len(devices)}"
+        )
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(shape.keys()))
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Rank/size bookkeeping mirroring the reference's ``init_ranks`` output."""
+
+    rank: int
+    size: int
+    intra_rank: int
+    intra_size: int
+    inter_rank: int
+    inter_size: int
+
+
+def topology_from_mesh(mesh: Mesh, axes: Tuple[str, ...]) -> Topology:
+    """Derive process-plane topology numbers for a communicator over ``axes``.
+
+    ``size`` is the total number of participants (mesh extent over ``axes``).
+    ``rank`` is this *process*'s first participating device position — under
+    single-controller SPMD every device participates; per-device rank inside a
+    traced program comes from ``lax.axis_index`` instead.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    size = 1
+    for a in axes:
+        size *= sizes[a]
+    if INTER_AXIS in axes and INTRA_AXIS in axes:
+        inter_size = sizes[INTER_AXIS]
+        intra_size = sizes[INTRA_AXIS]
+    else:
+        inter_size = jax.process_count()
+        intra_size = max(size // max(inter_size, 1), 1)
+    proc = jax.process_index()
+    intra_rank = 0
+    inter_rank = proc if inter_size > 1 else 0
+    rank = inter_rank * intra_size + intra_rank
+    return Topology(
+        rank=rank,
+        size=size,
+        intra_rank=intra_rank,
+        intra_size=intra_size,
+        inter_rank=inter_rank,
+        inter_size=inter_size,
+    )

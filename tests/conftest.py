@@ -1,0 +1,40 @@
+"""Test harness: simulate an 8-device pod on CPU.
+
+The analog of the reference's ``mpiexec -n 8 pytest`` single-host simulation
+(SURVEY.md §4): force 8 virtual CPU devices so every multi-chip code path runs
+hostside, exactly as it would over a real mesh.
+
+The environment preselects the TPU platform (axon PJRT plugin registered from
+sitecustomize, which sets ``jax_platforms='axon,cpu'`` via jax.config), so env
+vars alone don't stick — reclaim CPU through jax.config and drop any
+already-initialized backends.  bench.py is the real-chip path and does not use
+this conftest.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+except Exception:  # pragma: no cover - best effort; devices check will catch it
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 forced CPU devices, got {devs}"
+    return devs[:8]
