@@ -21,11 +21,21 @@ from chainermn_tpu.comm import (
 
 __version__ = "0.1.0"
 
-# Populated as subpackages land; mirrors the reference facade exports:
-# create_multi_node_optimizer, create_multi_node_evaluator, scatter_dataset,
-# create_empty_dataset, create_multi_node_checkpointer, iterators, functions,
-# links.
 from chainermn_tpu import comm  # noqa: E402
+from chainermn_tpu.datasets import (  # noqa: E402
+    create_empty_dataset,
+    scatter_dataset,
+)
+from chainermn_tpu.extensions import create_multi_node_evaluator  # noqa: E402
+from chainermn_tpu.iterators import (  # noqa: E402
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+from chainermn_tpu.optimizers import (  # noqa: E402
+    MultiNodeOptimizer,
+    TrainState,
+    create_multi_node_optimizer,
+)
 
 __all__ = [
     "CommunicatorBase",
@@ -36,4 +46,12 @@ __all__ = [
     "hybrid_mesh",
     "topology_mesh",
     "comm",
+    "create_multi_node_optimizer",
+    "MultiNodeOptimizer",
+    "TrainState",
+    "create_multi_node_evaluator",
+    "scatter_dataset",
+    "create_empty_dataset",
+    "create_multi_node_iterator",
+    "create_synchronized_iterator",
 ]

@@ -160,7 +160,25 @@ class XlaCommunicator(CommunicatorBase):
     def shard_rankwise(self, tree: Any) -> Any:
         """Place a host pytree (leading axis ``size``) into rankwise layout."""
         sh = self.rankwise_sharding()
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+        size = self.size
+
+        def put(x):
+            shape = np.shape(x)
+            if shape and shape[0] % size != 0:
+                raise ValueError(
+                    f"leading dim {shape[0]} is not divisible by the "
+                    f"communicator size {size} (global batch / rankwise "
+                    f"arrays must split evenly over the mesh)"
+                )
+            return jax.device_put(x, sh)
+
+        return jax.tree_util.tree_map(put, tree)
+
+    def shard_batch(self, tree: Any) -> Any:
+        """Shard a global batch's leading dim over this communicator's axes —
+        the per-chip half of ``scatter_dataset``'s two-level sharding.
+        Same placement as rankwise layout (leading dim split over our axes)."""
+        return self.shard_rankwise(tree)
 
     def replicate(self, tree: Any) -> Any:
         sh = NamedSharding(self._mesh, P())
@@ -204,22 +222,25 @@ class XlaCommunicator(CommunicatorBase):
         return lax.axis_index(self._axes)
 
     # ------------------------------------------------------- eager array plane
+    def grad_reduce_leaf(self, g):
+        """In-graph per-leaf gradient mean — shared by the eager
+        ``allreduce_grad`` facade and the optimizer's jitted train step.
+
+        Honors ``allreduce_grad_dtype`` (fp16/bf16 wire format; the 1/size
+        division fused into the cast-back, as the reference fused it into its
+        unpack kernel — ``pure_nccl_communicator.py``)."""
+        wire = self.allreduce_grad_dtype
+        axes = self.axis_name
+        if wire is not None and g.dtype != wire:
+            y = lax.psum(g.astype(wire), axes)
+            return (y.astype(g.dtype) / self.size).astype(g.dtype)
+        return lax.pmean(g, axes)
+
     def allreduce_grad(self, grads: Any) -> Any:
         """Mean-allreduce of a rankwise grad pytree (one fused collective)."""
-        comm_dtype = self.allreduce_grad_dtype
-        axes = self.axis_name
-        size = self.size
-
-        def body(x):
-            if comm_dtype is not None and x.dtype != comm_dtype:
-                orig = x.dtype
-                # fp16/bf16 wire format; 1/size fused into the cast-back
-                # (reference: pure_nccl fused-unpack kernel).
-                y = lax.psum(x.astype(comm_dtype), axes)
-                return (y.astype(orig) / size).astype(orig)
-            return lax.pmean(x, axes)
-
-        return self._rankwise_map(("allreduce_grad", comm_dtype), body)(grads)
+        return self._rankwise_map(
+            ("allreduce_grad", self.allreduce_grad_dtype), self.grad_reduce_leaf
+        )(grads)
 
     def allreduce(self, x: Any, op: str = "sum") -> Any:
         axes = self.axis_name
@@ -412,7 +433,12 @@ class DummyCommunicator(XlaCommunicator):
     """No-op-allreduce communicator for upper-bound scaling benchmarks
     (reference anchor: ``dummy_communicator.py — DummyCommunicator``): all
     collectives short-circuit locally, so benchmark deltas vs
-    :class:`XlaCommunicator` isolate communication cost."""
+    :class:`XlaCommunicator` isolate communication cost.  Benchmarking only:
+    without the allreduce, per-device params silently diverge even though the
+    train step's output sharding claims replication."""
+
+    def grad_reduce_leaf(self, g):
+        return g
 
     def allreduce_grad(self, grads: Any) -> Any:
         return grads

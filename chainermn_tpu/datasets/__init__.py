@@ -1,0 +1,135 @@
+"""Data distribution.
+
+Reference anchors: ``chainermn/datasets/scatter_dataset.py — scatter_dataset``
+(root permutes indices, splits into near-equal slices, MPI-scatters shards)
+and ``chainermn/datasets/empty_dataset.py — create_empty_dataset``.
+
+TPU-native design: two-level sharding.  Level 1 (this module) shards the
+dataset across *host processes* by ``jax.process_index()`` — the analog of the
+MPI scatter.  Level 2 happens at batch time: the trainer forms a per-host
+global batch whose leading dim is sharded over the device mesh
+(``XlaCommunicator.shard_batch``).  Single-process jobs see the whole dataset
+at level 1 and shard purely at level 2, which preserves the reference's
+"each of the N workers consumes 1/N of the data" contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class SubDataset:
+    """A view of ``dataset`` through an index list (reference analog:
+    ``chainer.datasets.SubDataset`` as produced by ``scatter_dataset``)."""
+
+    def __init__(self, dataset, indices: np.ndarray):
+        self._dataset = dataset
+        self._indices = np.asarray(indices)
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._dataset[int(j)] for j in self._indices[i]]
+        return self._dataset[int(self._indices[i])]
+
+
+def scatter_dataset(
+    dataset,
+    comm,
+    root: int = 0,
+    shuffle: bool = False,
+    seed: Optional[int] = None,
+    force_equal_length: bool = True,
+):
+    """Shard ``dataset`` across host processes.
+
+    Mirrors the reference signature ``scatter_dataset(dataset, comm, root=0,
+    shuffle=False, seed=None)``.  Every process computes the same permutation
+    (seeded — no communication needed, the SPMD win over the reference's
+    pickled MPI scatter) and takes its own slice.  ``force_equal_length`` pads
+    the tail shards by wrap-around so all processes step in lockstep, as
+    collectives require.
+    """
+    n = len(dataset)
+    order = np.arange(n)
+    if shuffle:
+        if seed is None:
+            # Fresh randomness per call, kept identical across processes by
+            # broadcasting process 0's draw (reference: root draws, scatters).
+            seed = comm.bcast_obj(int(np.random.randint(0, 2**31 - 1)), root=root)
+        order = np.random.RandomState(seed).permutation(n)
+    nproc = max(jax.process_count(), 1)
+    pidx = jax.process_index()
+    per = -(-n // nproc)  # ceil
+    if force_equal_length:
+        padded = np.resize(order, per * nproc)  # wrap-around pad
+        mine = padded[pidx * per : (pidx + 1) * per]
+    else:
+        mine = order[pidx * per : (pidx + 1) * per]
+    return SubDataset(dataset, mine)
+
+
+class _EmptyDataset:
+    def __init__(self, n: int):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        return ()
+
+
+def create_empty_dataset(dataset):
+    """Reference anchor: ``create_empty_dataset`` — placeholder of the same
+    length for ranks that only do model-parallel compute."""
+    return _EmptyDataset(len(dataset))
+
+
+class ArrayDataset:
+    """Tuple-of-arrays dataset (the ``TupleDataset`` shape the examples use)."""
+
+    def __init__(self, *arrays: np.ndarray):
+        ns = {len(a) for a in arrays}
+        assert len(ns) == 1, "all arrays must share their leading dim"
+        self._arrays = tuple(np.asarray(a) for a in arrays)
+
+    def __len__(self):
+        return len(self._arrays[0])
+
+    def __getitem__(self, i):
+        return tuple(a[i] for a in self._arrays)
+
+    @property
+    def arrays(self):
+        return self._arrays
+
+
+def make_synthetic_classification(
+    n: int = 4096,
+    dim: int = 784,
+    classes: int = 10,
+    seed: int = 0,
+    noise: float = 0.3,
+    task_seed: int = 1234,
+) -> ArrayDataset:
+    """Deterministic learnable classification task (MNIST stand-in for the
+    zero-egress environment: class = argmax of a fixed random projection plus
+    noise).  ``task_seed`` fixes the projection (the "task"); ``seed`` draws
+    the samples — so train/val splits share a task but not samples.
+    Examples/tests use this where the reference used MNIST."""
+    proj = (
+        np.random.RandomState(task_seed)
+        .normal(size=(dim, classes))
+        .astype(np.float32)
+    )
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    logits = x @ proj + noise * rng.normal(size=(n, classes)).astype(np.float32)
+    y = np.argmax(logits, axis=1).astype(np.int32)
+    return ArrayDataset(x, y)

@@ -1,0 +1,12 @@
+"""Trainer extensions: evaluation, checkpointing, fault tolerance.
+
+Reference anchors: ``chainermn/evaluators.py``,
+``chainermn/extensions/checkpoint.py``, ``chainermn/global_except_hook.py``.
+"""
+
+from chainermn_tpu.extensions.evaluator import (
+    Evaluator,
+    create_multi_node_evaluator,
+)
+
+__all__ = ["Evaluator", "create_multi_node_evaluator"]

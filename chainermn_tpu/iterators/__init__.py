@@ -1,0 +1,110 @@
+"""Iterators.
+
+Reference anchors: ``chainermn/iterators/multi_node_iterator.py —
+create_multi_node_iterator`` (master rank iterates, broadcasts each batch) and
+``chainermn/iterators/synchronized_iterator.py — create_synchronized_iterator``
+(identical RNG seed on every rank so all draw the same batches).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class SerialIterator:
+    """Minimal epoch-aware batch iterator (the Chainer ``SerialIterator``
+    shape the trainer loop consumes).  Yields tuples of stacked numpy arrays
+    for tuple datasets."""
+
+    def __init__(self, dataset, batch_size: int, repeat: bool = True,
+                 shuffle: bool = True, seed: Optional[int] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self._repeat = repeat
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.epoch = 0
+        self.iteration = 0
+        self.is_new_epoch = False
+        self._order = self._new_order()
+        self._pos = 0
+
+    def _new_order(self):
+        n = len(self.dataset)
+        return self._rng.permutation(n) if self._shuffle else np.arange(n)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = len(self.dataset)
+        if self._pos >= n:
+            if not self._repeat:
+                raise StopIteration
+            self._order = self._new_order()
+            self._pos = 0
+        idx = self._order[self._pos : self._pos + self.batch_size]
+        if len(idx) < self.batch_size and self._repeat:
+            # wrap to keep static batch shapes (XLA needs them)
+            extra = self._order[: self.batch_size - len(idx)]
+            idx = np.concatenate([idx, extra])
+        self._pos += self.batch_size
+        self.iteration += 1
+        # Epoch bookkeeping happens on the batch that COMPLETES the pass, so
+        # stop=(N, 'epoch') sees exactly N passes with no stray extra batch.
+        if self._pos >= n and self._repeat:
+            self.epoch += 1
+            self.is_new_epoch = True
+        else:
+            self.is_new_epoch = False
+        batch = [self.dataset[int(i)] for i in idx]
+        return self._stack(batch)
+
+    @staticmethod
+    def _stack(batch):
+        if isinstance(batch[0], tuple):
+            return tuple(np.stack([b[i] for b in batch]) for i in range(len(batch[0])))
+        return np.stack(batch)
+
+    @property
+    def epoch_detail(self):
+        return self.epoch + self._pos / max(len(self.dataset), 1)
+
+
+class _MultiNodeIterator:
+    """Master process iterates; every process sees the master's batch."""
+
+    def __init__(self, actual_iterator, comm, rank_master: int = 0):
+        self.actual = actual_iterator
+        self.comm = comm
+        self.rank_master = rank_master
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self.actual)
+        # Object-plane broadcast — identity single-process, gRPC multi-host.
+        return self.comm.bcast_obj(batch, root=self.rank_master)
+
+    def __getattr__(self, name):
+        return getattr(self.actual, name)
+
+
+def create_multi_node_iterator(actual_iterator, communicator, rank_master: int = 0):
+    """Reference anchor: ``create_multi_node_iterator`` — for datasets that
+    cannot be scattered; replicas receive the master's batches."""
+    return _MultiNodeIterator(actual_iterator, communicator, rank_master)
+
+
+def create_synchronized_iterator(actual_iterator, communicator):
+    """Reference anchor: ``create_synchronized_iterator`` — all ranks draw
+    identical batches.  Under a single controller every device already sees
+    the same stream, so synchronization reduces to broadcasting the master's
+    RNG-driven batches; we reuse the multi-node iterator mechanism."""
+    return _MultiNodeIterator(actual_iterator, communicator, rank_master=0)

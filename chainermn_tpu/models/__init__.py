@@ -1,0 +1,7 @@
+"""Model zoo (flax.linen), mirroring the reference's example models
+(``examples/mnist``, ``examples/imagenet/models/resnet50.py``,
+``examples/seq2seq``) as first-class library models."""
+
+from chainermn_tpu.models.mlp import MLP, classification_loss, classification_metrics
+
+__all__ = ["MLP", "classification_loss", "classification_metrics"]

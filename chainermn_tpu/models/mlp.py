@@ -1,0 +1,51 @@
+"""MLP classifier — the reference MNIST example model
+(``examples/mnist/train_mnist.py`` — ``class MLP(chainer.Chain)``: two hidden
+ReLU layers + linear head)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MLP(nn.Module):
+    hidden: Sequence[int] = (1000, 1000)
+    n_out: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.n_out)(x)
+
+
+def classification_loss(model: nn.Module):
+    """``loss_fn(params, (x, y)) -> (loss, {"accuracy": acc})``."""
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, {"accuracy": acc}
+
+    return loss_fn
+
+
+def classification_metrics(model: nn.Module):
+    """Eval-side metric fn for the Evaluator — returns PER-EXAMPLE vectors
+    (the Evaluator mask-aggregates them exactly across padded batches)."""
+
+    def metric_fn(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        acc = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        return {"val/loss": loss, "val/accuracy": acc}
+
+    return metric_fn

@@ -1,0 +1,176 @@
+"""Multi-node optimizer integration.
+
+Reference anchors: ``chainermn/optimizers.py`` — ``create_multi_node_optimizer``
+(``_MultiNodeOptimizer``: fwd/bwd → ``communicator.allreduce_grad`` → inner
+optimizer update) and ``_DoubleBufferingOptimizer`` (allreduce of step-k grads
+overlapped with step-k+1 compute; updates use 1-step-stale reduced grads).
+
+TPU-native design: instead of an eager per-iteration allreduce call between
+backward and update, the whole update is ONE jitted SPMD program built by
+:meth:`MultiNodeOptimizer.make_train_step` — gradients cross devices as a
+``lax.pmean`` *inside* the traced step, which XLA schedules and overlaps with
+the backward pass automatically (the hand-built side-stream of the reference's
+double-buffering is the compiler's job here).  The explicit double-buffering
+mode is still provided for parity of *semantics* (1-step-stale updates) via a
+pending-gradient carry in the train state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.comm.base import CommunicatorBase
+from chainermn_tpu.comm.xla import XlaCommunicator
+
+
+@struct.dataclass
+class TrainState:
+    """Replicated training state carried across steps."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    # Double-buffering carry: previous step's reduced grads (zeros at init).
+    pending_grads: Any = None
+
+
+class MultiNodeOptimizer:
+    """Wraps an optax transformation with cross-device gradient averaging.
+
+    ``loss_fn(params, batch) -> scalar`` or ``(scalar, aux_dict)`` when
+    ``has_aux=True``.  The batch passed to :meth:`update` is a *global* batch
+    whose leading dimension is sharded over the communicator's mesh axes.
+    """
+
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        communicator: CommunicatorBase,
+        double_buffering: bool = False,
+    ):
+        self.tx = tx
+        self.comm = communicator
+        self.double_buffering = double_buffering
+        self._step_cache: dict = {}
+
+    # ------------------------------------------------------------------ state
+    def init(self, params: Any) -> TrainState:
+        # Copy leaves: the train step donates its input state, and device_put
+        # aliases (no-copy) when the sharding already matches — without the
+        # copy, donation would delete arrays the caller still holds.
+        params = jax.tree_util.tree_map(jnp.array, params)
+        if isinstance(self.comm, XlaCommunicator):
+            params = self.comm.replicate(params)
+        pending = (
+            jax.tree_util.tree_map(jnp.zeros_like, params)
+            if self.double_buffering
+            else None
+        )
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self.tx.init(params),
+            pending_grads=pending,
+        )
+
+    # ------------------------------------------------------------- allreduce
+    def _allreduce_grads(self, grads: Any) -> Any:
+        """In-graph gradient mean — the ``allreduce_grad`` hot path, delegated
+        to the communicator's shared per-leaf reducer (wire-dtype aware;
+        identity for DummyCommunicator)."""
+        return jax.tree_util.tree_map(self.comm.grad_reduce_leaf, grads)
+
+    # ----------------------------------------------------------- train step
+    def make_train_step(
+        self, loss_fn: Callable, has_aux: bool = False, donate: bool = True
+    ) -> Callable:
+        """Build the jitted SPMD train step (reference hot loop §3.2).
+
+        Returns ``step(state, batch) -> (state, metrics)`` where ``metrics``
+        contains the globally averaged ``loss`` (and aux scalars).
+        """
+        comm = self.comm
+        if not isinstance(comm, XlaCommunicator):
+            raise TypeError("make_train_step requires a mesh-backed communicator")
+        mesh = comm.mesh
+        axes = comm.axes
+        dbuf = self.double_buffering
+        tx = self.tx
+
+        def body(state: TrainState, batch):
+            if has_aux:
+                (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    state.params, batch
+                )
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+                aux = {}
+            grads = self._allreduce_grads(grads)
+            if dbuf:
+                # 1-step-stale semantics: apply the PREVIOUS reduced grads,
+                # carry the fresh ones (reference: _DoubleBufferingOptimizer
+                # swap/update logic).
+                apply_grads = state.pending_grads
+                pending = grads
+            else:
+                apply_grads = grads
+                pending = state.pending_grads
+            updates, opt_state = tx.update(apply_grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = {"loss": lax.pmean(loss, comm.axis_name)}
+            for k, v in aux.items():
+                metrics[k] = lax.pmean(v, comm.axis_name)
+            return (
+                TrainState(
+                    step=state.step + 1,
+                    params=params,
+                    opt_state=opt_state,
+                    pending_grads=pending,
+                ),
+                metrics,
+            )
+
+        batch_spec = P(axes)
+        mapped = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), batch_spec),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        donate_argnums = (0,) if donate else ()
+        return jax.jit(mapped, donate_argnums=donate_argnums)
+
+    # --------------------------------------------------------------- update
+    def update(
+        self, state: TrainState, batch: Any, loss_fn: Callable, has_aux: bool = False
+    ) -> Tuple[TrainState, dict]:
+        """Eager-style API mirroring ``_MultiNodeOptimizer.update``: caches the
+        jitted step per ``loss_fn``."""
+        key = (id(loss_fn), has_aux)
+        step = self._step_cache.get(key)
+        if step is None:
+            step = self._step_cache[key] = self.make_train_step(loss_fn, has_aux)
+        if isinstance(self.comm, XlaCommunicator):
+            batch = self.comm.shard_batch(batch)
+        return step(state, batch)
+
+
+def create_multi_node_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+    communicator: CommunicatorBase,
+    double_buffering: bool = False,
+) -> MultiNodeOptimizer:
+    """Reference anchor: ``chainermn/optimizers.py — create_multi_node_optimizer
+    (opt, comm, double_buffering=False)``."""
+    return MultiNodeOptimizer(
+        actual_optimizer, communicator, double_buffering=double_buffering
+    )

@@ -1,0 +1,153 @@
+"""Minimal trainer loop.
+
+The reference delegates its loop to Chainer's ``Trainer``/``StandardUpdater``
+(see SURVEY.md §3.2); examples attach ``LogReport``/``PrintReport``/
+``ProgressBar`` on rank 0 only.  This module provides just enough of that
+shape for the stock example structure to run: a Trainer driving the jitted
+SPMD update, interval-triggered extensions, and rank-0-gated reporting
+(``jax.process_index() == 0`` — the SPMD analog of ``if comm.rank == 0:`` in
+every reference example).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class Extension:
+    """An interval-triggered trainer hook (Chainer extension analog)."""
+
+    def __init__(self, fn: Callable, trigger: Tuple[int, str] = (1, "epoch"),
+                 name: Optional[str] = None):
+        self.fn = fn
+        self.interval, self.unit = trigger
+        assert self.unit in ("epoch", "iteration")
+        self.name = name or getattr(fn, "__name__", "extension")
+        self._last_fired = 0
+
+    def should_fire(self, trainer: "Trainer") -> bool:
+        tick = trainer.epoch if self.unit == "epoch" else trainer.iteration
+        if tick // self.interval > self._last_fired // self.interval:
+            self._last_fired = tick
+            return True
+        return False
+
+    def __call__(self, trainer: "Trainer"):
+        return self.fn(trainer)
+
+    def finalize(self, trainer: "Trainer"):
+        """Called once when training ends; default no-op (LogReport flushes
+        its pending window here so a mid-epoch stop still reports)."""
+
+
+def make_extension(trigger=(1, "epoch"), name=None):
+    def deco(fn):
+        return Extension(fn, trigger=trigger, name=name)
+    return deco
+
+
+class LogReport(Extension):
+    """Collects metric means per interval; prints/records on rank 0 only."""
+
+    def __init__(self, trigger=(1, "epoch"), out: Optional[str] = None,
+                 print_report: bool = True):
+        super().__init__(self._fire, trigger=trigger, name="LogReport")
+        self.log: List[dict] = []
+        self._out = out
+        self._print = print_report
+        self._t0 = time.time()
+
+    def _fire(self, trainer: "Trainer"):
+        window = trainer.drain_observations()
+        if not window:
+            return
+        # Device arrays are converted to floats only here, at the trigger
+        # interval — the hot loop never blocks on metric values.
+        means = {k: float(np.mean([np.asarray(o[k]) for o in window if k in o]))
+                 for k in window[-1]}
+        entry = {
+            "epoch": trainer.epoch,
+            "iteration": trainer.iteration,
+            "elapsed_time": time.time() - self._t0,
+            **means,
+        }
+        self.log.append(entry)
+        self._report(means, entry)
+
+    def finalize(self, trainer: "Trainer"):
+        self._fire(trainer)
+
+    def _report(self, means, entry):
+        if jax.process_index() == 0:
+            if self._print:
+                parts = [f"epoch {entry['epoch']}", f"iter {entry['iteration']}"]
+                parts += [f"{k} {v:.4f}" for k, v in means.items()]
+                print("  ".join(parts), flush=True)
+            if self._out:
+                os.makedirs(os.path.dirname(self._out) or ".", exist_ok=True)
+                with open(self._out, "w") as f:
+                    json.dump(self.log, f, indent=1)
+
+
+class Trainer:
+    """Drives ``optimizer.update`` over a train iterator.
+
+    Args:
+      optimizer: a :class:`chainermn_tpu.optimizers.MultiNodeOptimizer`.
+      state: initial TrainState (from ``optimizer.init``).
+      loss_fn: ``loss_fn(params, batch) -> scalar`` (or ``(scalar, aux)``).
+      train_iter: yields global batches (tuples of stacked arrays).
+      stop: ``(n, 'epoch'|'iteration')`` stop trigger.
+    """
+
+    def __init__(self, optimizer, state, loss_fn, train_iter,
+                 stop: Tuple[int, str] = (1, "epoch"),
+                 extensions: Optional[List[Extension]] = None,
+                 has_aux: bool = False):
+        self.optimizer = optimizer
+        self.state = state
+        self.loss_fn = loss_fn
+        self.train_iter = train_iter
+        self.stop_n, self.stop_unit = stop
+        assert self.stop_unit in ("epoch", "iteration")
+        self.extensions = list(extensions or [])
+        self.has_aux = has_aux
+        self.iteration = 0
+        self._observations: List[dict] = []
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self.train_iter, "epoch", 0)
+
+    def extend(self, ext: Extension):
+        self.extensions.append(ext)
+
+    def drain_observations(self) -> List[dict]:
+        obs, self._observations = self._observations, []
+        return obs
+
+    def _done(self) -> bool:
+        tick = self.epoch if self.stop_unit == "epoch" else self.iteration
+        return tick >= self.stop_n
+
+    def run(self):
+        while not self._done():
+            batch = next(self.train_iter)
+            self.state, metrics = self.optimizer.update(
+                self.state, batch, self.loss_fn, has_aux=self.has_aux
+            )
+            self.iteration += 1
+            # Keep raw device arrays — no host sync on the hot path.
+            self._observations.append(dict(metrics))
+            for ext in self.extensions:
+                if ext.should_fire(self):
+                    ext(self)
+        for ext in self.extensions:
+            ext.finalize(self)
+        return self.state

@@ -1,0 +1,149 @@
+"""Dataset/iterator tests (reference analog:
+``tests/chainermn_tests/datasets_tests/test_scatter_dataset.py``)."""
+
+import numpy as np
+
+import chainermn_tpu as cmn
+from chainermn_tpu.datasets import (
+    ArrayDataset,
+    create_empty_dataset,
+    make_synthetic_classification,
+    scatter_dataset,
+)
+from chainermn_tpu.iterators import (
+    SerialIterator,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+
+
+def test_scatter_dataset_single_process(devices):
+    comm = cmn.create_communicator("xla", devices=devices)
+    ds = make_synthetic_classification(100, 8)
+    shard = scatter_dataset(ds, comm)
+    # single process → full dataset
+    assert len(shard) == 100
+
+
+def test_scatter_dataset_shuffle_deterministic(devices):
+    comm = cmn.create_communicator("xla", devices=devices)
+    ds = make_synthetic_classification(50, 4)
+    a = scatter_dataset(ds, comm, shuffle=True, seed=7)
+    b = scatter_dataset(ds, comm, shuffle=True, seed=7)
+    xa = np.stack([a[i][0] for i in range(len(a))])
+    xb = np.stack([b[i][0] for i in range(len(b))])
+    np.testing.assert_array_equal(xa, xb)
+
+
+def test_empty_dataset():
+    ds = make_synthetic_classification(37, 4)
+    e = create_empty_dataset(ds)
+    assert len(e) == 37 and e[0] == ()
+
+
+def test_serial_iterator_epochs():
+    ds = ArrayDataset(np.arange(10)[:, None].astype(np.float32))
+    it = SerialIterator(ds, 4, shuffle=False)
+    seen = []
+    for _ in range(5):
+        (batch,) = next(it)
+        assert batch.shape == (4, 1)
+        seen.append(batch)
+    assert it.epoch >= 1
+
+
+def test_serial_iterator_no_repeat_stops():
+    ds = ArrayDataset(np.arange(10)[:, None].astype(np.float32))
+    it = SerialIterator(ds, 4, repeat=False, shuffle=False)
+    n = sum(1 for _ in it)
+    assert n == 3  # 4+4+2
+
+
+def test_multi_node_iterator_single_process(devices):
+    comm = cmn.create_communicator("xla", devices=devices)
+    ds = ArrayDataset(np.arange(8)[:, None].astype(np.float32))
+    it = create_multi_node_iterator(SerialIterator(ds, 2, shuffle=False), comm)
+    (b,) = next(it)
+    np.testing.assert_array_equal(b[:, 0], [0, 1])
+
+
+def test_synchronized_iterator(devices):
+    comm = cmn.create_communicator("xla", devices=devices)
+    ds = ArrayDataset(np.arange(8)[:, None].astype(np.float32))
+    it = create_synchronized_iterator(SerialIterator(ds, 2, shuffle=True, seed=3), comm)
+    (b,) = next(it)
+    assert b.shape == (2, 1)
+
+
+def test_evaluator(devices):
+    import jax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.extensions import Evaluator, create_multi_node_evaluator
+    from chainermn_tpu.models import MLP, classification_metrics
+
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = MLP(hidden=(16,), n_out=10)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))["params"]
+    ds = make_synthetic_classification(128, 8)
+    ev = create_multi_node_evaluator(
+        Evaluator(
+            lambda: SerialIterator(ds, 64, repeat=False, shuffle=False),
+            classification_metrics(model),
+            comm,
+        ),
+        comm,
+    )
+    m = ev.evaluate(comm.replicate(params))
+    assert set(m) == {"val/loss", "val/accuracy"}
+    assert 0.0 <= m["val/accuracy"] <= 1.0
+
+
+def test_evaluator_partial_batch_exact(devices):
+    """100 samples / batch 64 → tail batch of 36; masked aggregation must
+    equal the plain full-dataset computation exactly."""
+    import jax
+    import jax.numpy as jnp
+    import chainermn_tpu as cmn
+    from chainermn_tpu.extensions import Evaluator
+    from chainermn_tpu.models import MLP, classification_metrics
+
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = MLP(hidden=(16,), n_out=10)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))["params"]
+    ds = make_synthetic_classification(100, 8)
+    ev = Evaluator(
+        lambda: SerialIterator(ds, 64, repeat=False, shuffle=False),
+        classification_metrics(model),
+        comm,
+    )
+    m = ev.evaluate(comm.replicate(params))
+
+    x, y = ds.arrays
+    logits = model.apply({"params": params}, x)
+    import optax
+    oracle_loss = float(
+        optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    )
+    oracle_acc = float(np.mean(np.argmax(np.asarray(logits), -1) == y))
+    np.testing.assert_allclose(m["val/loss"], oracle_loss, rtol=1e-5)
+    np.testing.assert_allclose(m["val/accuracy"], oracle_acc, rtol=1e-6)
+
+
+def test_trainer_epoch_count(devices):
+    """stop=(2,'epoch') must run exactly ceil(n/bs)*2 iterations."""
+    import jax
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.training import Trainer
+
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = MLP(hidden=(8,), n_out=10)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))["params"]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    ds = make_synthetic_classification(80, 8)
+    it = SerialIterator(ds, 32, shuffle=False)  # 3 batches/epoch (wrap)
+    tr = Trainer(opt, opt.init(params), classification_loss(model), it,
+                 stop=(2, "epoch"), has_aux=True)
+    tr.run()
+    assert tr.iteration == 6, tr.iteration
