@@ -22,6 +22,8 @@ from chainermn_tpu.comm import (
 __version__ = "0.1.0"
 
 from chainermn_tpu import comm  # noqa: E402
+from chainermn_tpu import functions  # noqa: E402
+from chainermn_tpu import links  # noqa: E402
 from chainermn_tpu.datasets import (  # noqa: E402
     create_empty_dataset,
     scatter_dataset,
@@ -46,6 +48,8 @@ __all__ = [
     "hybrid_mesh",
     "topology_mesh",
     "comm",
+    "functions",
+    "links",
     "create_multi_node_optimizer",
     "MultiNodeOptimizer",
     "TrainState",

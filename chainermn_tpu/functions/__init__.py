@@ -1,0 +1,50 @@
+"""Differentiable communication functions.
+
+Reference anchors: ``chainermn/functions/point_to_point_communication.py``
+(``Send``/``Recv``/``pseudo_connect``) and
+``chainermn/functions/collective_communication.py`` (``AllToAll``,
+``AllGather``, ...).
+
+The reference implements these as eager Chainer ``Function``s whose backward
+issues the transposed MPI call, sequenced by hand with *delegate variables*
+(zero-size graph edges) because MPMD backward needs explicit ordering and is
+deadlock-prone (SURVEY.md §3.4).  Under SPMD, every one of these is a single
+collective op inside a traced program — ``ppermute`` / ``all_gather`` /
+``all_to_all`` — whose transpose (backward) JAX's AD derives automatically,
+and the ordering problem disappears: there is nothing to deadlock.
+
+All functions here are **in-graph**: call them inside a ``shard_map`` body
+(``communicator.spmd``) where the communicator's mesh axes are bound.
+"""
+
+from chainermn_tpu.functions.point_to_point import (
+    DelegateVariable,
+    pseudo_connect,
+    recv,
+    send,
+    send_recv,
+    shift,
+)
+from chainermn_tpu.functions.collective import (
+    allgather,
+    allreduce,
+    alltoall,
+    bcast,
+    gather,
+    scatter,
+)
+
+__all__ = [
+    "DelegateVariable",
+    "send",
+    "recv",
+    "send_recv",
+    "shift",
+    "pseudo_connect",
+    "alltoall",
+    "allgather",
+    "allreduce",
+    "bcast",
+    "gather",
+    "scatter",
+]

@@ -1,0 +1,105 @@
+"""Differentiable point-to-point communication (in-graph).
+
+Reference anchor: ``chainermn/functions/point_to_point_communication.py`` —
+``class Send(chainer.Function)`` / ``class Recv`` / ``def pseudo_connect``.
+
+The reference's ``send`` returns a zero-size *delegate variable* keeping the
+autograd graph connected, and ``recv`` takes it to sequence backward
+correctly.  Here a send/recv pair is ONE ``lax.ppermute`` whose AD transpose
+is the inverse permutation — gradients flow from receiver back to sender with
+no manual sequencing.  ``DelegateVariable`` survives as the carrier of the
+in-flight tensor so ported code keeps its shape:
+
+    d = send(y, comm, dst=1, src=0)      # inside shard_map
+    h = recv(comm, src=0, delegate_variable=d)   # h == y on rank 1
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class DelegateVariable(NamedTuple):
+    """The in-flight tensor of a send — the SPMD re-reading of the
+    reference's zero-size delegate variable (it now *carries* the payload)."""
+
+    data: Any
+    src: int
+    dst: int
+
+
+def send_recv(x: Any, communicator, pairs: Sequence[Tuple[int, int]]) -> Any:
+    """Move ``x`` along ``[(src, dst), ...]``; ranks with no incoming edge get
+    zeros.  Differentiable: backward is the inverse permutation."""
+    perm = [(int(s), int(d)) for s, d in pairs]
+    return jax.tree_util.tree_map(
+        lambda t: lax.ppermute(t, communicator.axis_name, perm=perm), x
+    )
+
+
+def send(x: Any, communicator, rank: int, rank_src: int) -> DelegateVariable:
+    """Reference signature ``send(x, communicator, rank)`` + explicit source
+    (under SPMD all ranks run this line; the MPMD caller's implicit "my rank"
+    must be named)."""
+    moved = send_recv(x, communicator, [(rank_src, rank)])
+    return DelegateVariable(moved, rank_src, rank)
+
+
+def recv(
+    communicator,
+    rank: int,
+    delegate_variable: Optional[DelegateVariable] = None,
+):
+    """Reference signature ``recv(communicator, rank, delegate_variable)``.
+    The payload already moved in :func:`send`; this unwraps it (and checks the
+    edge matches).  A bare ``recv`` with no delegate has no SPMD meaning —
+    the send/recv pair is one collective."""
+    if delegate_variable is None:
+        raise ValueError(
+            "SPMD recv needs the DelegateVariable from the matching send: "
+            "a send/recv pair is a single collective here (see module doc)"
+        )
+    if delegate_variable.src != rank:
+        raise ValueError(
+            f"recv from rank {rank} but delegate came from rank "
+            f"{delegate_variable.src}"
+        )
+    return delegate_variable.data
+
+
+def pseudo_connect(delegate_variable: Optional[DelegateVariable], *actual_variables):
+    """Reference anchor: ``pseudo_connect(delegate_variable, *actual_variables)``.
+
+    MPMD needed this to graft backward ordering edges.  SPMD AD orders
+    collectives by data flow, so this only ties the delegate into the graph
+    (a zero-valued addition keeps any not-otherwise-consumed send
+    differentiable) and passes the variables through."""
+    if not actual_variables:
+        raise ValueError("pseudo_connect needs at least one actual variable")
+    if delegate_variable is None:
+        return actual_variables if len(actual_variables) > 1 else actual_variables[0]
+    leaves = jax.tree_util.tree_leaves(delegate_variable.data)
+    tie = sum((jnp.sum(t) * 0.0 for t in leaves), jnp.float32(0.0))
+    out = tuple(
+        jax.tree_util.tree_map(lambda t: t + tie.astype(t.dtype), v)
+        for v in actual_variables
+    )
+    return out if len(out) > 1 else out[0]
+
+
+def shift(x: Any, communicator, offset: int = 1, wrap: bool = True) -> Any:
+    """Neighbor exchange along the communicator axis (the pipeline/chain
+    primitive): rank r's value goes to rank r+offset.  ``wrap=False`` leaves
+    the edge ranks receiving zeros (GPipe-style pipelines want this)."""
+    n = communicator.size
+    if wrap:
+        pairs = [(s, (s + offset) % n) for s in range(n)]
+    else:
+        pairs = [
+            (s, s + offset) for s in range(n) if 0 <= s + offset < n
+        ]
+    return send_recv(x, communicator, pairs)

@@ -1,0 +1,18 @@
+"""Distributed links (model-parallel building blocks).
+
+Reference anchors: ``chainermn/links/multi_node_chain_list.py``,
+``chainermn/links/batch_normalization.py``.
+"""
+
+from chainermn_tpu.links.batch_normalization import (
+    MultiNodeBatchNormalization,
+    sync_batch_norm,
+)
+from chainermn_tpu.links.chain_list import MultiNodeChainList, PipelineChain
+
+__all__ = [
+    "MultiNodeChainList",
+    "PipelineChain",
+    "MultiNodeBatchNormalization",
+    "sync_batch_norm",
+]

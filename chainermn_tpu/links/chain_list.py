@@ -1,0 +1,164 @@
+"""Model-parallel chains.
+
+Reference anchor: ``chainermn/links/multi_node_chain_list.py`` —
+``class MultiNodeChainList(chainer.ChainList)`` with ``add_link(link,
+rank_in, rank_out)``: a declarative model-parallel graph where each component
+runs on its rank, stitched with blocking MPI send/recv and delegate variables
+(the most fragile machinery in the reference — SURVEY.md §3.4).
+
+SPMD re-design, two tiers:
+
+* :class:`MultiNodeChainList` — API-compatible heterogeneous chain.  Under a
+  single traced SPMD program every device walks the same stage list;
+  activations move between stage owners with ``ppermute`` so the comm pattern
+  (and its AD transpose) matches the reference's, and there is no deadlock to
+  sequence away.  Note on cost: GSPMD cannot skip a branch whose predicate
+  varies per device, so heterogeneous stages are *compute-replicated* (every
+  device computes each stage, only the owner's result propagates).  Capability
+  parity, not a speedup — for distributed speedup use :class:`PipelineChain`.
+
+* :class:`PipelineChain` — the TPU-idiomatic upgrade the reference lacked
+  (its chains were sequential; SURVEY.md §2.3 "no microbatch interleaving"):
+  homogeneous stacked stages whose parameters are SHARDED over the ``stage``
+  mesh axis (each device holds 1/S of the weights), with GPipe-style
+  microbatch pipelining via ``lax.scan`` + ``ppermute``.  Backward is AD
+  through the scan — the transposed pipeline schedule comes for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.functions.point_to_point import send_recv
+
+
+class _ChainLink(NamedTuple):
+    apply: Callable  # apply(params, x) -> y
+    rank: int  # owner
+    rank_in: Optional[int]
+    rank_out: Optional[int]
+
+
+class MultiNodeChainList:
+    """Heterogeneous model-parallel chain (API parity tier).
+
+    ``add_link(apply_fn, rank=owner, rank_in=..., rank_out=...)`` mirrors the
+    reference's ``add_link(link, rank_in, rank_out)`` with the owner made
+    explicit (MPMD implied it via the calling process).  ``__call__`` runs
+    inside a ``shard_map`` body over the communicator's axis.
+    """
+
+    def __init__(self, comm):
+        self.comm = comm
+        self._links: List[_ChainLink] = []
+
+    def add_link(
+        self,
+        apply_fn: Callable,
+        rank: int,
+        rank_in: Optional[int] = None,
+        rank_out: Optional[int] = None,
+    ):
+        self._links.append(_ChainLink(apply_fn, rank, rank_in, rank_out))
+        return self
+
+    def __call__(self, params_list: Sequence[Any], x):
+        """In-graph forward.  ``params_list[i]`` feeds link i (replicated).
+
+        Activation routing follows the reference's recv → compute → send walk
+        (SURVEY.md §3.4) with ``ppermute`` edges instead of MPI.  The edge
+        into link i is derived from its ``rank_in`` or the previous link's
+        ``rank_out`` (validated for consistency); owners on the same rank
+        need no edge."""
+        assert len(params_list) == len(self._links)
+        h = x
+        for i, link in enumerate(self._links):
+            src = None
+            if link.rank_in is not None:
+                src = link.rank_in
+            if i > 0:
+                prev = self._links[i - 1]
+                if prev.rank_out is not None:
+                    if prev.rank_out != link.rank:
+                        raise ValueError(
+                            f"link {i - 1} declares rank_out={prev.rank_out} "
+                            f"but link {i} is owned by rank {link.rank}"
+                        )
+                    if src is not None and src != prev.rank:
+                        raise ValueError(
+                            f"link {i} declares rank_in={src} but link "
+                            f"{i - 1} is owned by rank {prev.rank}"
+                        )
+                    src = prev.rank
+                if src is None and prev.rank != link.rank:
+                    raise ValueError(
+                        f"broken chain: link {i - 1} (rank {prev.rank}) → "
+                        f"link {i} (rank {link.rank}) has no declared edge; "
+                        f"set rank_out/rank_in"
+                    )
+            if src is not None and src != link.rank:
+                h = send_recv(h, self.comm, [(src, link.rank)])
+            h = link.apply(params_list[i], h)
+            if link.rank_out is not None and i + 1 == len(self._links):
+                # terminal send (to the output consumer)
+                h = send_recv(h, self.comm, [(link.rank, link.rank_out)])
+        return h
+
+
+class PipelineChain:
+    """GPipe-style pipeline over homogeneous stacked stages.
+
+    Args:
+      stage_apply: ``stage_apply(stage_params, x) -> y`` with matching
+        x/y shapes (e.g. one transformer block).
+      comm: communicator whose (single) axis is the ``stage`` dimension;
+        device s owns stage s.
+      n_microbatches: how many microbatches the global batch splits into.
+
+    Call inside ``shard_map``: ``pipe(stacked_params_local, x)`` where
+    ``stacked_params_local`` is this device's stage slice (leading axis 1 of
+    the stage-stacked params) and ``x`` is the full local batch (replicated
+    input; stage 0 consumes it).  Returns the pipeline output (replicated).
+    """
+
+    def __init__(self, stage_apply: Callable, comm, n_microbatches: int):
+        self.stage_apply = stage_apply
+        self.comm = comm
+        self.n_micro = n_microbatches
+
+    def __call__(self, stage_params, x):
+        comm = self.comm
+        S = comm.size
+        M = self.n_micro
+        idx = comm.axis_index()
+        B = x.shape[0]
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+        micro = x.reshape(M, B // M, *x.shape[1:])
+        mb_shape = micro.shape[1:]
+
+        fwd_pairs = [(s, s + 1) for s in range(S - 1)]
+
+        def tick(buf, t):
+            # Inject microbatch t at stage 0 (valid while t < M).
+            t_in = jnp.minimum(t, M - 1)
+            inj = lax.dynamic_index_in_dim(micro, t_in, axis=0, keepdims=False)
+            is_stage0 = (idx == 0)
+            cur = jnp.where(is_stage0, inj, buf)
+            y = self.stage_apply(stage_params, cur)
+            # Collect stage S-1's output on every device (psum-broadcast).
+            mask = (idx == S - 1).astype(y.dtype)
+            out = lax.psum(y * mask, comm.axis_name)
+            # Shift activations one stage forward for the next tick.
+            nxt = send_recv(y, comm, fwd_pairs)
+            return nxt, out
+
+        T = S + M - 1
+        buf0 = jnp.zeros(mb_shape, x.dtype)
+        _, outs = lax.scan(tick, buf0, jnp.arange(T))
+        # Microbatch m leaves the last stage at tick (S - 1 + m).
+        valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
+        return valid.reshape(B, *valid.shape[2:])

@@ -55,10 +55,16 @@ class MultiNodeOptimizer:
         tx: optax.GradientTransformation,
         communicator: CommunicatorBase,
         double_buffering: bool = False,
+        grad_reduce: Optional[Callable] = None,
     ):
         self.tx = tx
         self.comm = communicator
         self.double_buffering = double_buffering
+        # Per-leaf in-graph gradient reduction; defaults to the communicator's
+        # data-axis mean.  Model-parallel setups pass a custom reducer that
+        # also psums owner-localized stage grads over the model axis (see
+        # model_parallel_grad_reduce).
+        self.grad_reduce = grad_reduce or communicator.grad_reduce_leaf
         self._step_cache: dict = {}
 
     # ------------------------------------------------------------------ state
@@ -84,9 +90,9 @@ class MultiNodeOptimizer:
     # ------------------------------------------------------------- allreduce
     def _allreduce_grads(self, grads: Any) -> Any:
         """In-graph gradient mean — the ``allreduce_grad`` hot path, delegated
-        to the communicator's shared per-leaf reducer (wire-dtype aware;
-        identity for DummyCommunicator)."""
-        return jax.tree_util.tree_map(self.comm.grad_reduce_leaf, grads)
+        to the per-leaf reducer (wire-dtype aware; identity for
+        DummyCommunicator; model-axis-aware when ``grad_reduce`` was given)."""
+        return jax.tree_util.tree_map(self.grad_reduce, grads)
 
     # ----------------------------------------------------------- train step
     def make_train_step(
@@ -168,9 +174,32 @@ def create_multi_node_optimizer(
     actual_optimizer: optax.GradientTransformation,
     communicator: CommunicatorBase,
     double_buffering: bool = False,
+    grad_reduce: Optional[Callable] = None,
 ) -> MultiNodeOptimizer:
     """Reference anchor: ``chainermn/optimizers.py — create_multi_node_optimizer
     (opt, comm, double_buffering=False)``."""
     return MultiNodeOptimizer(
-        actual_optimizer, communicator, double_buffering=double_buffering
+        actual_optimizer,
+        communicator,
+        double_buffering=double_buffering,
+        grad_reduce=grad_reduce,
     )
+
+
+def model_parallel_grad_reduce(data_comm, model_comm) -> Callable:
+    """Per-leaf reducer for hybrid DP×MP training with owner-localized stage
+    gradients (e.g. :class:`chainermn_tpu.links.MultiNodeChainList`).
+
+    Assumes the loss is computed identically on every model rank (the usual
+    pattern: ``F.bcast`` the chain output, then loss everywhere).  AD's
+    collective transposes then deliver ``model_size ×`` the true gradient on
+    each stage's owner rank and zero elsewhere, so a PMEAN over the model
+    axis simultaneously (a) restores the owner's update on every shard —
+    without it non-owner shards silently keep stale params — and (b) cancels
+    the replicated-loss multiplicity.  Then the usual mean over data."""
+
+    def reduce_leaf(g):
+        g = lax.pmean(g, model_comm.axis_name)
+        return data_comm.grad_reduce_leaf(g)
+
+    return reduce_leaf
