@@ -28,7 +28,13 @@ from chainermn_tpu.datasets import (  # noqa: E402
     create_empty_dataset,
     scatter_dataset,
 )
-from chainermn_tpu.extensions import create_multi_node_evaluator  # noqa: E402
+from chainermn_tpu.extensions import (  # noqa: E402
+    create_multi_node_checkpointer,
+    create_multi_node_evaluator,
+)
+from chainermn_tpu import global_except_hook  # noqa: E402
+
+global_except_hook._add_hook_if_enabled()
 from chainermn_tpu.iterators import (  # noqa: E402
     create_multi_node_iterator,
     create_synchronized_iterator,
@@ -54,6 +60,7 @@ __all__ = [
     "MultiNodeOptimizer",
     "TrainState",
     "create_multi_node_evaluator",
+    "create_multi_node_checkpointer",
     "scatter_dataset",
     "create_empty_dataset",
     "create_multi_node_iterator",

@@ -4,9 +4,18 @@ Reference anchors: ``chainermn/evaluators.py``,
 ``chainermn/extensions/checkpoint.py``, ``chainermn/global_except_hook.py``.
 """
 
+from chainermn_tpu.extensions.checkpoint import (
+    MultiNodeCheckpointer,
+    create_multi_node_checkpointer,
+)
 from chainermn_tpu.extensions.evaluator import (
     Evaluator,
     create_multi_node_evaluator,
 )
 
-__all__ = ["Evaluator", "create_multi_node_evaluator"]
+__all__ = [
+    "Evaluator",
+    "create_multi_node_evaluator",
+    "MultiNodeCheckpointer",
+    "create_multi_node_checkpointer",
+]

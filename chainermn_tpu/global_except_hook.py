@@ -1,0 +1,76 @@
+"""Global exception hook — crash the whole job instead of deadlocking it.
+
+Reference anchor: ``chainermn/global_except_hook.py — _add_hook_if_enabled``:
+monkeypatches ``sys.excepthook`` so an uncaught exception on any rank prints
+its traceback and calls ``MPI_Abort(MPI_COMM_WORLD)``, killing every process —
+otherwise the surviving ranks hang forever inside a collective waiting for the
+dead one.  Env-var opt-out.
+
+TPU-native: the same failure mode exists multi-host (a host that dies mid-step
+leaves the others blocked in an ICI/DCN collective).  The hook prints a
+process-tagged traceback and tears the job down via ``jax.distributed``
+shutdown + hard exit.  Single-process jobs keep default behavior (nothing to
+deadlock).
+
+Opt-out: set ``CHAINERMN_TPU_NO_EXCEPT_HOOK=1`` (reference analog:
+``CHAINERMN_DISABLE_GLOBAL_EXCEPT_HOOK``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+_hook_installed = False
+
+
+def _global_except_hook(exctype, value, tb):
+    # Traceback FIRST — if jax itself is broken (the exception may be a
+    # backend failure), the process tag is the part we can afford to lose.
+    traceback.print_exception(exctype, value, tb)
+    try:
+        import jax
+
+        nproc = jax.process_count()
+        sys.stderr.write(
+            f"[chainermn_tpu] uncaught exception on process "
+            f"{jax.process_index()}/{nproc}\n"
+        )
+    except Exception:
+        nproc = 1
+    finally:
+        sys.stderr.flush()
+        if nproc > 1:
+            # Tear the whole job down (MPI_Abort analog) — leaving peers
+            # blocked in a collective is worse than a hard exit.
+            try:
+                import jax
+
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            os._exit(1)
+
+
+def add_hook() -> None:
+    global _hook_installed
+    if _hook_installed:
+        return
+    sys.excepthook = _global_except_hook
+    _hook_installed = True
+
+
+def remove_hook() -> None:
+    global _hook_installed
+    if _hook_installed:
+        sys.excepthook = sys.__excepthook__
+        _hook_installed = False
+
+
+def _add_hook_if_enabled() -> None:
+    """Reference anchor: ``_add_hook_if_enabled`` — installed at import time
+    unless opted out."""
+    if os.environ.get("CHAINERMN_TPU_NO_EXCEPT_HOOK"):
+        return
+    add_hook()

@@ -20,6 +20,18 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _global_moments(x: jax.Array, axis_name) -> Tuple[jax.Array, jax.Array]:
+    """Batch mean/variance reduced over the local batch AND the mesh axis —
+    the numerically sensitive core shared by the functional and module APIs."""
+    red = tuple(range(x.ndim - 1))
+    mean = jnp.mean(x, axis=red)
+    mean_sq = jnp.mean(jnp.square(x), axis=red)
+    if axis_name is not None:
+        mean = lax.pmean(mean, axis_name)
+        mean_sq = lax.pmean(mean_sq, axis_name)
+    return mean, mean_sq - jnp.square(mean)
+
+
 def sync_batch_norm(
     x: jax.Array,
     scale: jax.Array,
@@ -28,13 +40,7 @@ def sync_batch_norm(
     eps: float = 1e-5,
 ) -> jax.Array:
     """Functional sync-BN over leading (batch) dim + the mesh axis."""
-    red = tuple(range(x.ndim - 1))
-    mean = jnp.mean(x, axis=red)
-    mean_sq = jnp.mean(jnp.square(x), axis=red)
-    if axis_name is not None:
-        mean = lax.pmean(mean, axis_name)
-        mean_sq = lax.pmean(mean_sq, axis_name)
-    var = mean_sq - jnp.square(mean)
+    mean, var = _global_moments(x, axis_name)
     inv = lax.rsqrt(var + eps)
     return (x - mean) * inv * scale + bias
 
@@ -73,14 +79,9 @@ class MultiNodeBatchNormalization(nn.Module):
             inv = lax.rsqrt(ra_var.value + self.epsilon)
             return (x - ra_mean.value) * inv * scale + bias
 
-        red = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x, axis=red)
-        mean_sq = jnp.mean(jnp.square(x), axis=red)
         # init traces outside shard_map where the mesh axis is unbound
-        if self.axis_name is not None and not self.is_initializing():
-            mean = lax.pmean(mean, self.axis_name)
-            mean_sq = lax.pmean(mean_sq, self.axis_name)
-        var = mean_sq - jnp.square(mean)
+        axis = None if self.is_initializing() else self.axis_name
+        mean, var = _global_moments(x, axis)
         if not self.is_initializing():
             m = self.momentum
             ra_mean.value = m * ra_mean.value + (1 - m) * mean

@@ -82,16 +82,18 @@ class MultiNodeChainList:
                 src = link.rank_in
             if i > 0:
                 prev = self._links[i - 1]
+                # The only valid edge source is the previous link's owner —
+                # validate BOTH declarations against it, whichever is given.
+                if src is not None and src != prev.rank:
+                    raise ValueError(
+                        f"link {i} declares rank_in={src} but link "
+                        f"{i - 1} is owned by rank {prev.rank}"
+                    )
                 if prev.rank_out is not None:
                     if prev.rank_out != link.rank:
                         raise ValueError(
                             f"link {i - 1} declares rank_out={prev.rank_out} "
                             f"but link {i} is owned by rank {link.rank}"
-                        )
-                    if src is not None and src != prev.rank:
-                        raise ValueError(
-                            f"link {i} declares rank_in={src} but link "
-                            f"{i - 1} is owned by rank {prev.rank}"
                         )
                     src = prev.rank
                 if src is None and prev.rank != link.rank:

@@ -27,6 +27,9 @@ def main():
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--force-cpu", action="store_true")
     p.add_argument("--out", default="result/mnist_log.json")
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint dir; resumes from the latest snapshot "
+                        "(restart-based fault tolerance)")
     args = p.parse_args()
 
     if args.force_cpu:
@@ -84,6 +87,15 @@ def main():
         stop=(args.epoch, "epoch"), has_aux=True,
     )
     trainer.extend(LogReport(trigger=(1, "epoch"), out=args.out))
+
+    if args.checkpoint:
+        ckpt = cmn.create_multi_node_checkpointer(
+            "mnist", comm, path=args.checkpoint, trigger=(1, "epoch")
+        )
+        trainer.extend(ckpt)
+        _, resumed = ckpt.maybe_load(trainer.state, trainer)
+        if resumed and jax.process_index() == 0:
+            print(f"resumed from iteration {resumed}")
 
     def run_eval(tr):
         metrics = evaluator.evaluate(tr.state.params)

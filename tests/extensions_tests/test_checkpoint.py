@@ -1,0 +1,118 @@
+"""Checkpointer tests (reference analog:
+``tests/chainermn_tests/extensions_tests``): write to tmpdir, simulate
+restart-by-reconstruction, verify exact resume and gc."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.datasets import make_synthetic_classification
+from chainermn_tpu.extensions import create_multi_node_checkpointer
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models import MLP, classification_loss
+from chainermn_tpu.training import Trainer
+
+
+def _mk(devices, tmpdir, name="ckpt"):
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = MLP(hidden=(16,), n_out=10)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))["params"]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1, momentum=0.9), comm)
+    loss_fn = classification_loss(model)
+    ds = make_synthetic_classification(256, 8)
+    it = SerialIterator(ds, 64, shuffle=True, seed=1)
+    trainer = Trainer(opt, opt.init(params), loss_fn, it, stop=(3, "epoch"),
+                      has_aux=True)
+    ckpt = create_multi_node_checkpointer(
+        name, comm, path=str(tmpdir), trigger=(1, "epoch")
+    )
+    trainer.extend(ckpt)
+    return comm, trainer, ckpt, params, opt, loss_fn
+
+
+def test_save_restore_roundtrip(devices, tmp_path):
+    comm, trainer, ckpt, params, opt, loss_fn = _mk(devices, tmp_path)
+    trainer.run()
+    ckpt.finalize(trainer)
+    assert len(ckpt.all_steps()) == 3  # one per epoch
+
+    # "restart": fresh trainer from init, maybe_load restores latest
+    comm2, trainer2, ckpt2, params2, opt2, loss_fn2 = _mk(devices, tmp_path)
+    state, it_resumed = ckpt2.maybe_load(trainer2.state, trainer2)
+    assert it_resumed == trainer.iteration
+    assert trainer2.iteration == trainer.iteration
+    for a, b in zip(
+        jax.tree_util.tree_leaves(trainer.state.params),
+        jax.tree_util.tree_leaves(trainer2.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+    # optimizer momentum restored too
+    for a, b in zip(
+        jax.tree_util.tree_leaves(trainer.state.opt_state),
+        jax.tree_util.tree_leaves(trainer2.state.opt_state),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+    ckpt2.close()
+
+
+def test_maybe_load_without_checkpoint(devices, tmp_path):
+    comm, trainer, ckpt, *_ = _mk(devices, tmp_path, name="empty")
+    state, it = ckpt.maybe_load(trainer.state, trainer)
+    assert it == 0
+    ckpt.close()
+
+
+def test_resume_continues_training(devices, tmp_path):
+    """Train 3 epochs with a mid-run restart == semantics of continuing."""
+    comm, trainer, ckpt, params, opt, loss_fn = _mk(devices, tmp_path, name="resume")
+    trainer.stop_n = 2
+    trainer.run()
+    ckpt.finalize(trainer)
+
+    comm2, trainer2, ckpt2, *_ = _mk(devices, tmp_path, name="resume")
+    ckpt2.maybe_load(trainer2.state, trainer2)
+    assert trainer2.train_iter.epoch == 2
+    trainer2.stop_n = 3
+    trainer2.run()  # continues from epoch 2 → runs 1 more epoch
+    assert trainer2.iteration > trainer2.train_iter.epoch  # trained further
+    assert int(trainer2.state.step) > int(trainer.state.step)
+    ckpt2.close()
+
+
+def test_gc_max_to_keep(devices, tmp_path):
+    comm = cmn.create_communicator("xla", devices=devices)
+    ckpt = create_multi_node_checkpointer("gc", comm, path=str(tmp_path),
+                                          max_to_keep=2)
+    import chainermn_tpu.optimizers as O
+    import optax as ox
+
+    opt = cmn.create_multi_node_optimizer(ox.sgd(0.1), comm)
+    state = opt.init({"w": np.ones((4,), np.float32)})
+
+    class FakeTrainer:
+        train_iter = None
+
+        def __init__(self, i, s):
+            self.iteration = i
+            self.state = s
+
+    for i in range(1, 6):
+        ckpt.save(state, FakeTrainer(i, state))
+    ckpt.finalize(None)
+    assert ckpt.all_steps() == [4, 5]
+    ckpt.close()
+
+
+def test_except_hook_installed():
+    import sys
+    import chainermn_tpu  # noqa: F401  (import installs the hook)
+    from chainermn_tpu import global_except_hook as geh
+
+    assert sys.excepthook is geh._global_except_hook
+    # single-process: hook must delegate to the default handler, not exit
+    geh.remove_hook()
+    assert sys.excepthook is sys.__excepthook__
+    geh.add_hook()
