@@ -1,0 +1,87 @@
+"""Sequence data utilities: bucketing + padding for static XLA shapes.
+
+The reference's seq2seq example fed ragged per-sentence arrays through eager
+MPI (``examples/seq2seq/seq2seq.py``); XLA requires static shapes, so this
+module provides the TPU-native replacement (SURVEY.md §7): group sentence
+pairs into length buckets, pad each bucket to its ceiling, and emit
+fixed-shape batches whose padding overhead is bounded by the bucket width.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+# Token sentinels — single source of truth (models/seq2seq.py imports these).
+PAD = 0
+BOS = 1
+EOS = 2
+
+
+def pad_to(arr: Sequence[int], length: int) -> np.ndarray:
+    out = np.full(length, PAD, np.int32)
+    out[: len(arr)] = arr
+    return out
+
+
+def bucket_batches(
+    pairs: Sequence[Tuple[Sequence[int], Sequence[int]]],
+    batch_size: int,
+    bucket_width: int = 8,
+    max_len: int = 64,
+    seed: int = 0,
+    drop_incomplete: bool = True,
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Group (src, tgt) token-id pairs into length buckets; return a list of
+    ``(src_batch, tgt_batch)`` int32 arrays, each padded to its bucket
+    ceiling.  Non-pad fraction stays ≥ (width-1)/width per bucket by
+    construction (the BASELINE.md "no pathological padding" target)."""
+    rng = np.random.RandomState(seed)
+    buckets: dict = {}
+    for s, t in pairs:
+        if len(s) > max_len or len(t) > max_len:
+            s, t = s[:max_len], t[:max_len]
+        key = (
+            -(-max(len(s), 1) // bucket_width) * bucket_width,
+            -(-max(len(t), 1) // bucket_width) * bucket_width,
+        )
+        buckets.setdefault(key, []).append((s, t))
+    batches = []
+    for (ls, lt), items in sorted(buckets.items()):
+        order = rng.permutation(len(items))
+        for i in range(0, len(items), batch_size):
+            chunk = [items[j] for j in order[i : i + batch_size]]
+            if len(chunk) < batch_size:
+                if drop_incomplete:
+                    continue
+                # cyclic wrap-fill so even buckets smaller than batch_size
+                # reach the full static shape
+                pool = [items[j] for j in order]
+                need = batch_size - len(chunk)
+                chunk += [pool[j % len(pool)] for j in range(need)]
+            src = np.stack([pad_to(s, ls) for s, _ in chunk])
+            tgt = np.stack([pad_to(t, lt) for _, t in chunk])
+            batches.append((src, tgt))
+    rng.shuffle(batches)
+    return batches
+
+
+def make_synthetic_translation(
+    n: int = 2048,
+    vocab: int = 50,
+    min_len: int = 3,
+    max_len: int = 24,
+    seed: int = 0,
+) -> List[Tuple[List[int], List[int]]]:
+    """Deterministic learnable "translation": target = reversed source with a
+    +3 vocab shift (PAD/BOS/EOS reserved).  Stand-in for the reference's WMT
+    data in the zero-egress environment."""
+    rng = np.random.RandomState(seed)
+    pairs = []
+    for _ in range(n):
+        L = rng.randint(min_len, max_len + 1)
+        src = rng.randint(3, vocab, size=L).tolist()
+        tgt = [((w - 3 + 1) % (vocab - 3)) + 3 for w in reversed(src)]
+        pairs.append((src, tgt))
+    return pairs

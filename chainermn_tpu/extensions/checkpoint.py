@@ -68,18 +68,28 @@ class MultiNodeCheckpointer(Extension):
 
     @staticmethod
     def _loop_state(trainer) -> dict:
-        if trainer is None:
-            return {
-                "iteration": np.zeros((), np.int64),
-                "epoch": np.zeros((), np.int64),
-                "it_pos": np.zeros((), np.int64),
-            }
-        it = trainer.train_iter
-        return {
-            "iteration": np.asarray(trainer.iteration, np.int64),
-            "epoch": np.asarray(getattr(it, "epoch", 0), np.int64),
-            "it_pos": np.asarray(getattr(it, "_pos", 0), np.int64),
+        out = {
+            "iteration": np.zeros((), np.int64),
+            "epoch": np.zeros((), np.int64),
+            "it_pos": np.zeros((), np.int64),
         }
+        if trainer is None:
+            return out
+        it = trainer.train_iter
+        out["iteration"] = np.asarray(trainer.iteration, np.int64)
+        out["epoch"] = np.asarray(getattr(it, "epoch", 0), np.int64)
+        out["it_pos"] = np.asarray(getattr(it, "_pos", 0), np.int64)
+        # Exact mid-epoch resume needs the iterator's in-flight permutation
+        # and RNG state (restoring _pos into a FRESH permutation would skip
+        # and duplicate samples).  SerialIterator-shaped iterators only.
+        if hasattr(it, "_order") and hasattr(it, "_rng"):
+            mt, keys, pos, has_gauss, cached = it._rng.get_state()
+            out["it_order"] = np.asarray(it._order, np.int64)
+            out["rng_keys"] = np.asarray(keys, np.uint32)
+            out["rng_pos"] = np.asarray(pos, np.int64)
+            out["rng_has_gauss"] = np.asarray(has_gauss, np.int64)
+            out["rng_cached"] = np.asarray(cached, np.float64)
+        return out
 
     # -------------------------------------------------------------- restore
     def maybe_load(self, state, trainer=None) -> Tuple[Any, int]:
@@ -113,6 +123,15 @@ class MultiNodeCheckpointer(Extension):
                 it.epoch = int(loop["epoch"])
             if hasattr(it, "_pos"):
                 it._pos = int(loop["it_pos"])
+            if "it_order" in loop and hasattr(it, "_order"):
+                it._order = np.asarray(loop["it_order"]).astype(np.int64)
+                it._rng.set_state((
+                    "MT19937",
+                    np.asarray(loop["rng_keys"]).astype(np.uint32),
+                    int(loop["rng_pos"]),
+                    int(loop["rng_has_gauss"]),
+                    float(loop["rng_cached"]),
+                ))
             # Sync trigger state so interval extensions don't all re-fire on
             # the first post-resume iteration (which would burn a retention
             # slot on a duplicate checkpoint and log a one-iteration window).

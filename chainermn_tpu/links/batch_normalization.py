@@ -22,7 +22,9 @@ from jax import lax
 
 def _global_moments(x: jax.Array, axis_name) -> Tuple[jax.Array, jax.Array]:
     """Batch mean/variance reduced over the local batch AND the mesh axis —
-    the numerically sensitive core shared by the functional and module APIs."""
+    the numerically sensitive core shared by the functional and module APIs.
+    Always accumulated in float32 (bf16 inputs would lose the moments)."""
+    x = x.astype(jnp.float32)
     red = tuple(range(x.ndim - 1))
     mean = jnp.mean(x, axis=red)
     mean_sq = jnp.mean(jnp.square(x), axis=red)
@@ -39,10 +41,12 @@ def sync_batch_norm(
     axis_name,
     eps: float = 1e-5,
 ) -> jax.Array:
-    """Functional sync-BN over leading (batch) dim + the mesh axis."""
+    """Functional sync-BN over leading (batch) dim + the mesh axis.
+    Moments accumulate in fp32; output keeps the input dtype."""
     mean, var = _global_moments(x, axis_name)
     inv = lax.rsqrt(var + eps)
-    return (x - mean) * inv * scale + bias
+    y = (x.astype(jnp.float32) - mean) * inv * scale + bias
+    return y.astype(x.dtype)
 
 
 class MultiNodeBatchNormalization(nn.Module):
@@ -75,9 +79,11 @@ class MultiNodeBatchNormalization(nn.Module):
         ra_var = self.variable(
             "batch_stats", "var", lambda: jnp.ones(self.features)
         )
+        in_dtype = x.dtype
         if use_ra:
             inv = lax.rsqrt(ra_var.value + self.epsilon)
-            return (x - ra_mean.value) * inv * scale + bias
+            y = (x.astype(jnp.float32) - ra_mean.value) * inv * scale + bias
+            return y.astype(in_dtype)
 
         # init traces outside shard_map where the mesh axis is unbound
         axis = None if self.is_initializing() else self.axis_name
@@ -87,4 +93,5 @@ class MultiNodeBatchNormalization(nn.Module):
             ra_mean.value = m * ra_mean.value + (1 - m) * mean
             ra_var.value = m * ra_var.value + (1 - m) * var
         inv = lax.rsqrt(var + self.epsilon)
-        return (x - mean) * inv * scale + bias
+        y = (x.astype(jnp.float32) - mean) * inv * scale + bias
+        return y.astype(in_dtype)

@@ -3,5 +3,23 @@
 ``examples/seq2seq``) as first-class library models."""
 
 from chainermn_tpu.models.mlp import MLP, classification_loss, classification_metrics
+from chainermn_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet50,
+    resnet_loss,
+)
+from chainermn_tpu.models.seq2seq import Seq2Seq, greedy_decode, seq2seq_loss
 
-__all__ = ["MLP", "classification_loss", "classification_metrics"]
+__all__ = [
+    "MLP",
+    "classification_loss",
+    "classification_metrics",
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "resnet_loss",
+    "Seq2Seq",
+    "seq2seq_loss",
+    "greedy_decode",
+]

@@ -1,0 +1,128 @@
+"""ResNet — the benchmark model family.
+
+Reference anchor: ``examples/imagenet/models/resnet50.py`` (the ChainerMN
+ImageNet benchmark model; ``BASELINE.md``'s headline numbers are ResNet-50).
+
+TPU-first design choices:
+  * bf16 compute / fp32 params (``dtype``/``param_dtype``) — convs and the
+    head ride the MXU in bfloat16, the reference's fp16-allreduce analog is
+    the communicator's ``allreduce_grad_dtype``.
+  * NHWC layout (XLA:TPU's native conv layout).
+  * Cross-replica sync-BN via
+    :class:`chainermn_tpu.links.MultiNodeBatchNormalization` when an
+    ``axis_name`` is given (the reference pairs its BN with
+    ``MultiNodeBatchNormalization`` the same way), plain local BN otherwise.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    axis_name: Any = None
+    norm_momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.he_normal(),
+        )
+        norm = partial(
+            MultiNodeBatchNormalization,
+            axis_name=self.axis_name,
+            momentum=self.norm_momentum,
+            use_running_average=not train,
+        )
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = nn.relu(norm(self.features)(y))
+        y = conv(self.features, (3, 3), strides=self.strides)(y)
+        y = nn.relu(norm(self.features)(y))
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm(self.features * 4)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), strides=self.strides,
+                            name="proj")(residual)
+            residual = norm(self.features * 4, name="proj_bn")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet; ``stage_sizes=[3,4,6,3]`` is ResNet-50."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    axis_name: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    kernel_init=nn.initializers.he_normal(), name="conv_init")(x)
+        x = nn.relu(
+            MultiNodeBatchNormalization(
+                self.width, axis_name=self.axis_name,
+                use_running_average=not train, name="bn_init",
+            )(x)
+        )
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    self.width * 2**i,
+                    strides=strides,
+                    dtype=self.dtype,
+                    axis_name=self.axis_name,
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], **kw)
+
+
+def ResNet18(**kw) -> ResNet:
+    """Smaller variant for tests/CI (bottleneck layout retained)."""
+    return ResNet(stage_sizes=[1, 1, 1, 1], **kw)
+
+
+def resnet_loss(model: nn.Module):
+    """Stateful loss for the DP train step:
+    ``loss_fn(params, model_state, (x, y)) -> (loss, (aux, new_model_state))``.
+    """
+    import optax
+
+    def loss_fn(params, model_state, batch):
+        x, y = batch
+        logits, mut = model.apply(
+            {"params": params, "batch_stats": model_state},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y
+        ).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, ({"accuracy": acc}, mut["batch_stats"])
+
+    return loss_fn

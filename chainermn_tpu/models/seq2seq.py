@@ -1,0 +1,88 @@
+"""Seq2seq NMT — LSTM encoder-decoder.
+
+Reference anchor: ``examples/seq2seq/seq2seq.py`` (ChainerMN's NMT example:
+per-sentence LSTMs over ragged minibatches with DP allreduce).
+
+TPU-first re-design of the variable-length story (SURVEY.md §7 "hard parts"):
+eager MPI tolerated ragged arrays; XLA needs static shapes, so sequences are
+**bucketed by length and padded** (see
+``chainermn_tpu.datasets.seq.bucket_batches``) with a masked loss — each
+bucket shape compiles once, and padding overhead is bounded by the bucket
+width.  The recurrences run under ``lax.scan`` (via ``flax.linen.RNN``) so
+the whole step stays one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from chainermn_tpu.datasets.seq import BOS, EOS, PAD  # shared sentinels
+
+
+class Seq2Seq(nn.Module):
+    """Encoder-decoder with teacher forcing.
+
+    ``__call__(src, tgt_in)``: ``src`` (B, Ts) int tokens (PAD-padded),
+    ``tgt_in`` (B, Tt) decoder inputs (BOS-shifted); returns (B, Tt, vocab)
+    logits.
+    """
+
+    vocab_src: int
+    vocab_tgt: int
+    embed: int = 128
+    hidden: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, src, tgt_in):
+        emb_s = nn.Embed(self.vocab_src, self.embed, dtype=self.dtype,
+                         name="embed_src")(src)
+        # encoder scan; final carry summarizes the sentence
+        enc = nn.RNN(nn.OptimizedLSTMCell(self.hidden), return_carry=True,
+                     name="encoder")
+        carry, _ = enc(emb_s)
+        emb_t = nn.Embed(self.vocab_tgt, self.embed, dtype=self.dtype,
+                         name="embed_tgt")(tgt_in)
+        dec = nn.RNN(nn.OptimizedLSTMCell(self.hidden), name="decoder")
+        hs = dec(emb_t, initial_carry=carry)
+        return nn.Dense(self.vocab_tgt, dtype=self.dtype, name="proj")(hs)
+
+
+def seq2seq_loss(model: nn.Module):
+    """Masked token-level cross entropy.  ``batch = (src, tgt)``, both
+    PAD-padded; decoder input is BOS + tgt[:-1]."""
+
+    def loss_fn(params, batch):
+        src, tgt = batch
+        bos = jnp.full((tgt.shape[0], 1), BOS, tgt.dtype)
+        tgt_in = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
+        logits = model.apply({"params": params}, src, tgt_in)
+        mask = (tgt != PAD).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+        loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        correct = ((jnp.argmax(logits, -1) == tgt) * mask).sum()
+        acc = correct / jnp.maximum(mask.sum(), 1.0)
+        return loss, {"token_accuracy": acc}
+
+    return loss_fn
+
+
+def greedy_decode(model: nn.Module, params, src, max_len: int = 32):
+    """Greedy autoregressive decoding with static shapes (``fori_loop`` over
+    positions, full re-apply per step — an eval utility, not a serving path)."""
+    B = src.shape[0]
+    tgt_in = jnp.full((B, max_len), PAD, jnp.int32).at[:, 0].set(BOS)
+
+    def body(i, tgt_in):
+        logits = model.apply({"params": params}, src, tgt_in)
+        nxt = jnp.argmax(logits[:, i], -1).astype(jnp.int32)
+        return tgt_in.at[:, i + 1].set(nxt)
+
+    tgt_in = jax.lax.fori_loop(0, max_len - 1, body, tgt_in)
+    logits = model.apply({"params": params}, src, tgt_in)
+    return jnp.argmax(logits, -1)

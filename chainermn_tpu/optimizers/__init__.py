@@ -40,6 +40,10 @@ class TrainState:
     opt_state: Any
     # Double-buffering carry: previous step's reduced grads (zeros at init).
     pending_grads: Any = None
+    # Mutable model collections (e.g. sync-BN running stats); None when the
+    # model is stateless.  Kept replicated: sync-BN moments are pmean'd
+    # in-graph so every device writes identical stats.
+    model_state: Any = None
 
 
 class MultiNodeOptimizer:
@@ -68,13 +72,17 @@ class MultiNodeOptimizer:
         self._step_cache: dict = {}
 
     # ------------------------------------------------------------------ state
-    def init(self, params: Any) -> TrainState:
+    def init(self, params: Any, model_state: Any = None) -> TrainState:
         # Copy leaves: the train step donates its input state, and device_put
         # aliases (no-copy) when the sharding already matches — without the
         # copy, donation would delete arrays the caller still holds.
         params = jax.tree_util.tree_map(jnp.array, params)
+        if model_state is not None:
+            model_state = jax.tree_util.tree_map(jnp.array, model_state)
         if isinstance(self.comm, XlaCommunicator):
             params = self.comm.replicate(params)
+            if model_state is not None:
+                model_state = self.comm.replicate(model_state)
         pending = (
             jax.tree_util.tree_map(jnp.zeros_like, params)
             if self.double_buffering
@@ -85,6 +93,7 @@ class MultiNodeOptimizer:
             params=params,
             opt_state=self.tx.init(params),
             pending_grads=pending,
+            model_state=model_state,
         )
 
     # ------------------------------------------------------------- allreduce
@@ -96,12 +105,20 @@ class MultiNodeOptimizer:
 
     # ----------------------------------------------------------- train step
     def make_train_step(
-        self, loss_fn: Callable, has_aux: bool = False, donate: bool = True
+        self,
+        loss_fn: Callable,
+        has_aux: bool = False,
+        stateful: bool = False,
+        donate: bool = True,
     ) -> Callable:
         """Build the jitted SPMD train step (reference hot loop §3.2).
 
         Returns ``step(state, batch) -> (state, metrics)`` where ``metrics``
         contains the globally averaged ``loss`` (and aux scalars).
+
+        ``stateful=True`` threads mutable model collections (e.g. BN running
+        stats): ``loss_fn(params, model_state, batch) -> (loss, (aux_dict,
+        new_model_state))``.
         """
         comm = self.comm
         if not isinstance(comm, XlaCommunicator):
@@ -112,7 +129,12 @@ class MultiNodeOptimizer:
         tx = self.tx
 
         def body(state: TrainState, batch):
-            if has_aux:
+            new_model_state = state.model_state
+            if stateful:
+                (loss, (aux, new_model_state)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params, state.model_state, batch)
+            elif has_aux:
                 (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                     state.params, batch
                 )
@@ -140,6 +162,7 @@ class MultiNodeOptimizer:
                     params=params,
                     opt_state=opt_state,
                     pending_grads=pending,
+                    model_state=new_model_state,
                 ),
                 metrics,
             )
@@ -157,17 +180,39 @@ class MultiNodeOptimizer:
 
     # --------------------------------------------------------------- update
     def update(
-        self, state: TrainState, batch: Any, loss_fn: Callable, has_aux: bool = False
+        self,
+        state: TrainState,
+        batch: Any,
+        loss_fn: Callable,
+        has_aux: bool = False,
+        stateful: bool = False,
     ) -> Tuple[TrainState, dict]:
         """Eager-style API mirroring ``_MultiNodeOptimizer.update``: caches the
         jitted step per ``loss_fn``."""
-        key = (id(loss_fn), has_aux)
+        key = (id(loss_fn), has_aux, stateful)
         step = self._step_cache.get(key)
         if step is None:
-            step = self._step_cache[key] = self.make_train_step(loss_fn, has_aux)
+            step = self._step_cache[key] = self.make_train_step(
+                loss_fn, has_aux, stateful
+            )
         if isinstance(self.comm, XlaCommunicator):
             batch = self.comm.shard_batch(batch)
-        return step(state, batch)
+        out = step(state, batch)
+        if self._serialize_steps():
+            # XLA:CPU's in-process collective rendezvous can deadlock when
+            # launches overlap across the virtual device pool (timing races
+            # observed with multiple compiled shapes in flight).  The CPU
+            # mesh exists only to SIMULATE a pod, so serialize there; real
+            # TPU/GPU paths keep async dispatch and compiler overlap.
+            jax.block_until_ready(out[0])
+        return out
+
+    @staticmethod
+    def _serialize_steps() -> bool:
+        try:
+            return jax.devices()[0].platform == "cpu"
+        except Exception:
+            return False
 
 
 def create_multi_node_optimizer(

@@ -109,7 +109,7 @@ class Trainer:
     def __init__(self, optimizer, state, loss_fn, train_iter,
                  stop: Tuple[int, str] = (1, "epoch"),
                  extensions: Optional[List[Extension]] = None,
-                 has_aux: bool = False):
+                 has_aux: bool = False, stateful: bool = False):
         self.optimizer = optimizer
         self.state = state
         self.loss_fn = loss_fn
@@ -118,6 +118,7 @@ class Trainer:
         assert self.stop_unit in ("epoch", "iteration")
         self.extensions = list(extensions or [])
         self.has_aux = has_aux
+        self.stateful = stateful
         self.iteration = 0
         self._observations: List[dict] = []
 
@@ -140,7 +141,8 @@ class Trainer:
         while not self._done():
             batch = next(self.train_iter)
             self.state, metrics = self.optimizer.update(
-                self.state, batch, self.loss_fn, has_aux=self.has_aux
+                self.state, batch, self.loss_fn, has_aux=self.has_aux,
+                stateful=self.stateful,
             )
             self.iteration += 1
             # Keep raw device arrays — no host sync on the hot path.

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Data-parallel ImageNet ResNet-50 — the reference's benchmark config
+(``examples/imagenet/train_imagenet.py`` + ``models/resnet50.py``;
+BASELINE.md's headline numbers).  Exercises: hierarchical/pure_nccl-analog
+communicators, bf16 compute, optional bf16 wire dtype (the fp16-allreduce
+path), sync-BN, double buffering, checkpointing.
+
+Zero-egress environment: ``--synthetic`` (default) generates deterministic
+fake ImageNet-shaped data; point ``--train-npz`` at real data when available.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/imagenet/train_imagenet.py --force-cpu --smoke
+"""
+
+import argparse
+
+import jax
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--communicator", default="pure_nccl")
+    p.add_argument("--batchsize", type=int, default=256, help="global batch")
+    p.add_argument("--epoch", type=int, default=1)
+    p.add_argument("--iters-per-epoch", type=int, default=50)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--wire-dtype", default=None)
+    p.add_argument("--double-buffering", action="store_true")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes for CI (64px, 10 classes, resnet18)")
+    p.add_argument("--force-cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+        # avoid in-process CPU collective rendezvous deadlocks (see tests/conftest.py)
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        from jax.extend import backend as _backend
+
+        _backend.clear_backends()
+    if args.smoke:
+        args.image_size, args.num_classes, args.arch = 32, 10, "resnet18"
+        args.batchsize = min(args.batchsize, 64)
+        args.iters_per_epoch = 4
+
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models import ResNet18, ResNet50, resnet_loss
+    from chainermn_tpu.training import LogReport, Trainer
+
+    comm = cmn.create_communicator(
+        args.communicator, allreduce_grad_dtype=args.wire_dtype
+    )
+    if jax.process_index() == 0:
+        print(f"devices: {comm.size}  arch: {args.arch}  "
+              f"global batch: {args.batchsize}")
+
+    arch = ResNet50 if args.arch == "resnet50" else ResNet18
+    model = arch(num_classes=args.num_classes, axis_name=comm.axis_name)
+    x0 = np.zeros((8, args.image_size, args.image_size, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(args.lr, momentum=0.9, nesterov=True),
+        comm,
+        double_buffering=args.double_buffering,
+    )
+    state = opt.init(variables["params"], model_state=variables["batch_stats"])
+    loss_fn = resnet_loss(model)
+
+    class SyntheticImageNet:
+        """Deterministic fake data iterator with epoch bookkeeping."""
+
+        def __init__(self, n_iters, bs, size, classes):
+            self.n, self.bs, self.size, self.classes = n_iters, bs, size, classes
+            self.epoch = 0
+            self.iteration = 0
+            self._rng = np.random.RandomState(0)
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.iteration += 1
+            # epoch bumps on the batch that COMPLETES the pass (same
+            # convention as SerialIterator — no stray extra batch)
+            if self.iteration % self.n == 0:
+                self.epoch += 1
+            x = self._rng.uniform(size=(self.bs, self.size, self.size, 3))
+            y = (x.mean(axis=(1, 2, 3)) * self.classes).astype(np.int32)
+            return x.astype(np.float32), y.clip(0, self.classes - 1)
+
+    it = SyntheticImageNet(args.iters_per_epoch, args.batchsize,
+                           args.image_size, args.num_classes)
+    trainer = Trainer(opt, state, loss_fn, it, stop=(args.epoch, "epoch"),
+                      stateful=True)
+    trainer.extend(LogReport(trigger=(1, "epoch")))
+    if args.checkpoint:
+        ckpt = cmn.create_multi_node_checkpointer(
+            "imagenet", comm, path=args.checkpoint, trigger=(1, "epoch")
+        )
+        trainer.extend(ckpt)
+        ckpt.maybe_load(trainer.state, trainer)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# In-process CPU collectives deadlock when async dispatch lets several
+# programs' collectives interleave across the 8 virtual devices (thread-pool
+# starvation in the rendezvous) — run the CPU simulation synchronously.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
 try:
     import jax.extend.backend
 

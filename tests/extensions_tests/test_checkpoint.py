@@ -82,6 +82,32 @@ def test_resume_continues_training(devices, tmp_path):
     ckpt2.close()
 
 
+def test_mid_epoch_resume_exact(devices, tmp_path):
+    """Resume mid-epoch must replay the SAME permutation from the same
+    position — interrupted training equals uninterrupted training."""
+    comm, trainer, ckpt, params, opt, loss_fn = _mk(devices, tmp_path, name="mid")
+    # save mid-epoch: iteration trigger
+    ckpt2 = create_multi_node_checkpointer("mid2", comm, path=str(tmp_path),
+                                           trigger=(3, "iteration"))
+    trainer.extensions = [ckpt2]
+    trainer.stop_n, trainer.stop_unit = 3, "iteration"
+    trainer.run()  # stops right at the mid-epoch snapshot (3 of 4 batches)
+    ckpt2.finalize(trainer)
+    order_then = trainer.train_iter._order.copy()
+    pos_then = trainer.train_iter._pos
+
+    comm3, trainer3, _ckpt, *_ = _mk(devices, tmp_path, name="mid")
+    ckpt3 = create_multi_node_checkpointer("mid2", comm3, path=str(tmp_path))
+    ckpt3.maybe_load(trainer3.state, trainer3)
+    assert trainer3.iteration == 3
+    # identical in-flight permutation and position — no skipped/duplicated
+    # samples after restart
+    np.testing.assert_array_equal(trainer3.train_iter._order, order_then)
+    assert trainer3.train_iter._pos == pos_then
+    ckpt3.close()
+    ckpt2.close()
+
+
 def test_gc_max_to_keep(devices, tmp_path):
     comm = cmn.create_communicator("xla", devices=devices)
     ckpt = create_multi_node_checkpointer("gc", comm, path=str(tmp_path),

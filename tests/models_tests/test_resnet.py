@@ -1,0 +1,71 @@
+"""ResNet tests: forward shapes, stateful DP training step, bf16 compute."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models import ResNet18, resnet_loss
+
+
+def test_resnet_forward_shapes(devices):
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = ResNet18(num_classes=10, width=8, axis_name=comm.axis_name)
+    x = np.zeros((8, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (8, 10)
+    assert logits.dtype == jnp.float32  # head in fp32
+
+
+def test_resnet_dp_training_stateful(devices):
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = ResNet18(num_classes=4, width=8, axis_name=comm.axis_name)
+    x0 = np.zeros((8, 16, 16, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05, momentum=0.9), comm)
+    state = opt.init(variables["params"], model_state=variables["batch_stats"])
+    loss_fn = resnet_loss(model)
+
+    rng = np.random.RandomState(0)
+    # overfit one fixed batch: loss must drop monotonically-ish
+    x = rng.uniform(size=(32, 16, 16, 3)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) * 4).astype(np.int32).clip(0, 3)
+    losses = []
+    for i in range(8):
+        state, metrics = opt.update(state, (x, y), loss_fn, stateful=True)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # batch_stats updated and replicated
+    stats = jax.tree_util.tree_leaves(state.model_state)
+    assert any(np.abs(np.asarray(s)).max() > 0 for s in stats)
+    for leaf in stats:
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_allclose(s, shards[0], atol=1e-6)
+
+
+def test_resnet_bf16_compute_path(devices):
+    model = ResNet18(num_classes=4, width=8, dtype=jnp.bfloat16)
+    x = np.zeros((8, 16, 16, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    # params stay fp32 (mixed precision) ...
+    for leaf in jax.tree_util.tree_leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32
+    # ... while the block activations actually run in bf16
+    logits, inter = model.apply(
+        variables, x, train=False, capture_intermediates=True,
+        mutable=["intermediates"],
+    )
+    block_outs = [
+        v for k, v in jax.tree_util.tree_flatten_with_path(inter)[0]
+        if "BottleneckBlock" in str(k)
+    ]
+    assert block_outs, "no block intermediates captured"
+    assert all(b.dtype == jnp.bfloat16 for b in block_outs)
+    assert logits.dtype == jnp.float32  # head stays fp32
