@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synchronous data-parallel training throughput.
+
+Mirrors the reference's benchmark config (``examples/imagenet/train_imagenet.py``
++ ``models/resnet50.py``, run under ``pure_nccl`` with fp16 allreduce —
+SURVEY.md §2.9/§6): full training step (forward, backward, cross-device
+gradient all-reduce, SGD-momentum update) on ResNet-50, bf16 compute / fp32
+params, sync-BN, bf16 gradient wire format.
+
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+``vs_baseline`` is images/sec/chip ÷ 125 — the strongest published per-chip
+throughput of the reference stack (Akiba et al. 2017: ResNet-50/ImageNet in 15
+min on 1024×P100 ⇒ ~125 images/sec/GPU; BASELINE.md).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models.resnet import ResNet50, resnet_loss
+
+
+REFERENCE_IMAGES_PER_SEC_PER_CHIP = 125.0
+
+
+def main():
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_cpu = devices[0].platform == "cpu"
+    if on_cpu:
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    # Smaller footprint on the CPU fallback so the bench always terminates.
+    per_chip_batch = 8 if on_cpu else 128
+    image_size = 64 if on_cpu else 224
+    warmup, iters = (1, 2) if on_cpu else (3, 10)
+
+    comm = cmn.create_communicator("xla", allreduce_grad_dtype=jnp.bfloat16)
+    model = ResNet50(num_classes=1000, axis_name=comm.axis_name)
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm
+    )
+
+    rng = jax.random.PRNGKey(0)
+    x1 = jnp.ones((1, image_size, image_size, 3), jnp.float32)
+    # Init without the cross-device axis in scope (plain eval-mode trace).
+    init_model = ResNet50(num_classes=1000)
+    variables = init_model.init(rng, x1, train=False)
+    state = opt.init(variables["params"], model_state=variables["batch_stats"])
+    step = opt.make_train_step(resnet_loss(model), stateful=True)
+
+    global_batch = per_chip_batch * n_dev
+    host_rng = np.random.RandomState(0)
+    batch = comm.shard_batch(
+        (
+            host_rng.normal(size=(global_batch, image_size, image_size, 3)).astype(
+                np.float32
+            ),
+            host_rng.randint(0, 1000, size=(global_batch,)).astype(np.int32),
+        )
+    )
+
+    # NB: sync every step via an actual device→host transfer of the loss —
+    # ``block_until_ready`` on donated-aliased outputs (and on deeply queued
+    # steps over the axon device tunnel) can report ready early; a value
+    # materialization cannot lie.
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+        _ = float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+        _ = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = global_batch * iters / dt
+    per_chip = images_per_sec / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(
+                    per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

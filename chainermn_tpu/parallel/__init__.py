@@ -1,0 +1,32 @@
+"""Parallelism strategies beyond the reference's scope.
+
+The reference (2018-era ChainerMN) ships DP, coarse model parallelism and the
+``alltoall`` primitive (SURVEY.md §2.3); long-context sequence/context
+parallelism postdates it.  This package supplies the TPU-native versions as
+first-class citizens:
+
+* :mod:`ring_attention` — ring/context parallelism: blockwise attention with
+  K/V rotating around the mesh ring via ``ppermute`` (Liu et al., Ring
+  Attention; flash-style online softmax).
+* :mod:`ulysses` — all-to-all sequence parallelism (DeepSpeed-Ulysses style):
+  re-shard sequence↔heads with ``all_to_all`` around any local attention.
+* :mod:`moe` — expert parallelism: capacity-based top-k token dispatch over an
+  ``expert`` mesh axis via ``all_to_all`` (built on the same primitive the
+  reference exposed as ``chainermn.functions.alltoall``).
+"""
+
+from chainermn_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_self_attention,
+)
+from chainermn_tpu.parallel.ulysses import ulysses_attention
+from chainermn_tpu.parallel.moe import MoELayer, moe_combine, moe_dispatch
+
+__all__ = [
+    "ring_attention",
+    "ring_self_attention",
+    "ulysses_attention",
+    "moe_dispatch",
+    "moe_combine",
+    "MoELayer",
+]

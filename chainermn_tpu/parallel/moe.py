@@ -1,0 +1,164 @@
+"""Expert parallelism — capacity-based top-k MoE dispatch over an ``expert``
+mesh axis.
+
+The reference shipped the building block (``chainermn.functions.alltoall`` —
+``chainermn/functions/collective_communication.py — class AllToAll``; SURVEY.md
+§2.3 notes EP itself is absent).  This module is the GShard/Switch-style layer
+built on it, TPU-native: all tensors static-shaped (token→slot routing is an
+einsum against one-hot dispatch masks, not gather/scatter), the only
+cross-device exchange is a pair of ``lax.all_to_all``s over the ``expert``
+axis, and everything lives inside one jitted ``shard_map``.
+
+Layout: tokens are sharded over the ``expert`` axis (each device holds ``N``
+local tokens AND one expert shard).  Each device routes its tokens into an
+``(E, C, D)`` send buffer (slot ``e`` → device ``e``), the all-to-all turns it
+into the ``(E, C, D)`` batch of tokens *for my expert* (row ``s`` = from
+device ``s``), the local expert MLP runs, and the reverse all-to-all +
+combine-weights einsum puts results back on the owning tokens.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _topk_dispatch(
+    probs: jax.Array, capacity: int, k: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Greedy top-k routing with per-expert capacity.
+
+    probs: (N, E) router probabilities.  Returns ``(dispatch, combine,
+    first_choice)``: dispatch (N, E, C) one-hot token→(expert, slot)
+    assignments; combine = dispatch weighted by renormalized gates;
+    first_choice (N, E) one-hot of each token's top-1 expert (for the
+    load-balance loss).
+    """
+    N, E = probs.shape
+    C = capacity
+    dispatch = jnp.zeros((N, E, C), probs.dtype)
+    gate_sum = jnp.zeros((N,), probs.dtype)
+    gates = jnp.zeros((N, E, C), probs.dtype)
+    fill = jnp.zeros((E,), jnp.int32)
+    remaining = probs
+    first_choice = None
+    for i in range(k):
+        idx = jnp.argmax(remaining, axis=-1)  # (N,)
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # (N, E)
+        if first_choice is None:
+            first_choice = onehot
+        # Slot within the expert's capacity buffer: earlier tokens first,
+        # continuing after slots consumed by previous rounds.
+        pos = jnp.cumsum(onehot, axis=0) - onehot + fill[None, :].astype(
+            probs.dtype
+        )
+        pos_tok = jnp.sum(pos * onehot, axis=1).astype(jnp.int32)  # (N,)
+        keep = (pos_tok < C).astype(probs.dtype)
+        slot = jax.nn.one_hot(pos_tok, C, dtype=probs.dtype)  # (N, C)
+        d_i = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        gate = jnp.sum(probs * onehot, axis=1)  # (N,)
+        dispatch = dispatch + d_i
+        gates = gates + gate[:, None, None] * d_i
+        gate_sum = gate_sum + gate * keep
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+    # Renormalize the selected gates to sum to 1 per token (top-k softmax
+    # renormalization; dropped tokens keep 0 and fall through on the combine).
+    denom = jnp.maximum(gate_sum, jnp.finfo(probs.dtype).tiny)
+    combine = gates / denom[:, None, None]
+    return dispatch, combine, first_choice
+
+
+def moe_dispatch(
+    x: jax.Array,
+    gate_logits: jax.Array,
+    axis_name,
+    capacity: int,
+    k: int = 2,
+):
+    """Route local tokens to their experts across the ``expert`` axis.
+
+    x: (N, D) local tokens; gate_logits: (N, E).  Returns ``(expert_batch,
+    combine, aux)`` where ``expert_batch`` is the (E·C, D) token batch for
+    THIS device's expert, ``combine`` the (N, E, C) weights to un-dispatch
+    with :func:`moe_combine`, and ``aux`` the local Switch load-balance loss.
+    """
+    E = lax.axis_size(axis_name)
+    if gate_logits.shape[-1] != E:
+        raise ValueError(
+            f"router width {gate_logits.shape[-1]} != expert axis size {E}"
+        )
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    dispatch, combine, first = _topk_dispatch(probs, capacity, k)
+    # Switch load-balance loss: E * Σ_e fraction_dispatched_e · mean_prob_e.
+    f_e = jnp.mean(first, axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f_e * p_e)
+    # Dispatch einsum in fp32 for exact slot selection, but ship the wire in
+    # the activation dtype — fp32 on the all_to_all would double EP traffic
+    # for bf16 models (cf. the allreduce_grad_dtype wire-format design).
+    send = jnp.einsum(
+        "nec,nd->ecd", dispatch, x.astype(jnp.float32)
+    ).astype(x.dtype)
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    C = capacity
+    return recv.reshape(E * C, x.shape[-1]), combine, aux
+
+
+def moe_combine(
+    expert_out: jax.Array, combine: jax.Array, axis_name
+) -> jax.Array:
+    """Inverse of :func:`moe_dispatch`: (E·C, F) expert outputs → (N, F)."""
+    N, E, C = combine.shape
+    # Wire in the expert-output dtype; upcast locally for the combine einsum.
+    back = lax.all_to_all(
+        expert_out.reshape(E, C, -1),
+        axis_name, split_axis=0, concat_axis=0, tiled=True,
+    )
+    out = jnp.einsum("nec,ecf->nf", combine, back.astype(jnp.float32))
+    return out.astype(expert_out.dtype)
+
+
+class MoELayer:
+    """Mixture-of-experts layer over an ``expert`` mesh axis.
+
+    ``expert_apply(expert_params, tokens) -> tokens`` is the local expert
+    (e.g. an MLP); ``expert_params`` is this device's shard (leading axis 1 of
+    the expert-stacked params).  Call inside ``shard_map`` with local tokens
+    ``(N, D)`` and a replicated router weight ``(D, E)``; returns ``(out,
+    aux_loss)``.
+    """
+
+    def __init__(
+        self,
+        expert_apply: Callable,
+        axis_name,
+        k: int = 2,
+        capacity_factor: float = 1.25,
+    ):
+        self.expert_apply = expert_apply
+        self.axis_name = axis_name
+        self.k = k
+        self.capacity_factor = capacity_factor
+
+    def capacity(self, n_tokens: int, n_experts: int) -> int:
+        import math
+
+        return max(
+            1, math.ceil(self.k * self.capacity_factor * n_tokens / n_experts)
+        )
+
+    def __call__(self, router_w, expert_params, x):
+        E = lax.axis_size(self.axis_name)
+        N = x.shape[0]
+        C = self.capacity(N, E)
+        logits = x @ router_w
+        expert_batch, combine, aux = moe_dispatch(
+            x, logits, self.axis_name, C, self.k
+        )
+        h = self.expert_apply(expert_params, expert_batch)
+        return moe_combine(h, combine, self.axis_name), aux

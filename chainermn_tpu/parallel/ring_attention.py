@@ -1,0 +1,151 @@
+"""Ring attention — context parallelism over a ``seq`` mesh axis.
+
+Long-context support the reference lacked (SURVEY.md §2.3: SP/CP "ABSENT …
+note for roadmap: shard_map ring attention over a seq mesh axis").  Design:
+
+Q, K, V are sharded over the sequence axis: each of the S devices holds a
+``(batch, seq/S, heads, head_dim)`` block.  K/V blocks rotate around the mesh
+ring with ``lax.ppermute`` while every device accumulates attention of its
+local Q block against each visiting K/V block using the flash-attention
+online-softmax recurrence (running max ``m``, normalizer ``l``, weighted
+accumulator ``o`` in fp32).  S ring steps later every device holds its exact
+attention output — no device ever materializes the full sequence, so context
+length scales linearly with the ring size at O(block²) memory.
+
+Causal masking uses global positions derived from ``lax.axis_index`` and the
+ring step, so fully-masked visiting blocks contribute zeros (their
+``exp(-inf)`` rows are neutralized by the running-max recurrence).
+
+Backward is JAX AD through the ``lax.scan`` — the transposed ``ppermute``
+rotates gradients the opposite way around the ring, which is exactly the ring
+attention backward pass.  Each ring step is wrapped in ``jax.checkpoint`` so
+the backward rematerializes per-block scores instead of storing S score
+matrices.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, m, l, o, mask):
+    """One flash-style online-softmax accumulation of a visiting K/V block.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D); m/l: (B, H, Tq); o: (B, Tq, H, D)
+    mask: (Tq, Tk) boolean (True = attend) or None.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    # scores: (B, H, Tq, Tk) in fp32.
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)  # (B, H, Tq)
+    m_new = jnp.maximum(m, m_blk)
+    # Fully-masked rows keep m_new == -inf; shift by a finite surrogate so
+    # exp() sees -inf - finite = -inf → 0 contributions, not NaN.
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])  # (B, H, Tq, Tk)
+    corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_safe))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name,
+    causal: bool = False,
+    remat: bool = True,
+) -> jax.Array:
+    """Exact self-attention over a sequence sharded on ``axis_name``.
+
+    Call inside ``shard_map``; arguments are the local sequence blocks
+    ``(batch, block_len, heads, head_dim)``.  Returns the local output block
+    in ``q.dtype``.
+    """
+    B, T, H, D = q.shape
+    S = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+
+    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    rel = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]  # q_pos - k_pos (local)
+
+    def body(carry, step):
+        k_cur, v_cur, m, l, o = carry
+        if causal:
+            # Visiting block originated at rank (my - step) mod S; global
+            # positions differ by (my - src) * T.
+            src = (my - step) % S
+            offset = (my - src) * T
+            mask = (rel + offset) >= 0
+        else:
+            mask = None
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, mask)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm=perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm=perm)
+        return (k_nxt, v_nxt, m, l, o), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    (_, _, m, l, o), _ = lax.scan(
+        body, (k, v, m0, l0, o0), jnp.arange(S)
+    )
+    # Rows with zero mass (can't happen for causal self-attention, where a
+    # query always sees itself) would divide 0/0; guard anyway.
+    l = jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    comm,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+) -> jax.Array:
+    """Eager convenience wrapper: global ``(B, T, H, D)`` arrays in, attention
+    out, sequence-sharded over ``comm``'s mesh axes.
+
+    ``comm`` is an :class:`~chainermn_tpu.comm.XlaCommunicator` whose axes
+    form the sequence ring (e.g. ``XlaCommunicator(hybrid_mesh({"seq": 8}))``).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, comm.axes)  # shard dim 1 (sequence)
+
+    def build():
+        return jax.jit(
+            comm.spmd(
+                partial(
+                    ring_self_attention,
+                    axis_name=comm.axis_name,
+                    causal=causal,
+                ),
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )
+
+    # Reuse the communicator's jit cache — a fresh jit per call would
+    # retrace/recompile the ring program every invocation.
+    f = comm._jitted(("ring_attention", causal), build)
+    return f(q, k, v)

@@ -1,0 +1,71 @@
+"""All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+The complementary long-context strategy to :mod:`ring_attention`: instead of
+rotating K/V, two ``all_to_all``s re-shard the activations sequence↔heads so
+any *local* attention implementation (including a Pallas flash kernel) runs
+unmodified on full-length sequences with ``heads/S`` heads per device.
+
+Built on the same collective the reference exposed eagerly as
+``chainermn.functions.alltoall`` (``chainermn/functions/
+collective_communication.py — class AllToAll``); here it is an in-graph op
+whose AD transpose is the reverse all-to-all.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _default_attention(q, k, v, causal):
+    import math
+
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name,
+    causal: bool = False,
+    attn_fn: Optional[Callable] = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Call inside ``shard_map`` with local blocks ``(B, T/S, H, D)``; requires
+    ``H % S == 0``.  ``attn_fn(q, k, v, causal) -> out`` runs on full-length
+    sequences with ``H/S`` heads (default: XLA softmax attention; drop in a
+    flash/Pallas kernel here).
+    """
+    S = lax.axis_size(axis_name)
+    B, T, H, D = q.shape
+    if H % S != 0:
+        raise ValueError(f"heads {H} not divisible by sequence shards {S}")
+    attn_fn = attn_fn or _default_attention
+
+    def seq_to_heads(x):
+        # (B, T/S, H, D) → (B, T, H/S, D): gather sequence, scatter heads.
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal)
+    return heads_to_seq(out)
