@@ -1,0 +1,115 @@
+"""Native runtime build + ctypes bindings.
+
+The reference's native layer was a Cython NCCL binding plus C MPI
+(``chainermn/nccl/nccl.pyx``, mpi4py — SURVEY.md §2.1).  On TPU the data
+plane is XLA collectives (no binding needed); what remains native here is the
+host runtime: the TCP object-plane transport (``hostcomm.cpp``) and the
+threaded batch assembler (``dataloader.cpp``).  Compiled on first use with
+``g++`` (no pybind11 in the image — plain C ABI + ctypes), cached under
+``_native/build/`` keyed by source hash.  Every consumer has a pure-Python
+fallback, so a missing toolchain degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "build")
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_DIR, f"{name}.cpp")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_BUILD, f"{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        src, "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeBuildError(f"g++ unavailable/failed: {e}") from e
+    if proc.returncode != 0:
+        raise NativeBuildError(f"g++ failed:\n{proc.stderr}")
+    os.replace(tmp, out)
+    return out
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unbuildable."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        try:
+            lib = ctypes.CDLL(_build(name))
+        except NativeBuildError:
+            lib = None
+        _cache[name] = lib
+        return lib
+
+
+def load_hostcomm() -> Optional[ctypes.CDLL]:
+    lib = load("hostcomm")
+    if lib is None:
+        return None
+    lib.hostcomm_init.restype = ctypes.c_void_p
+    lib.hostcomm_init.argtypes = [
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+    ]
+    lib.hostcomm_send.restype = ctypes.c_int
+    lib.hostcomm_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+    ]
+    lib.hostcomm_recv.restype = ctypes.c_int64
+    lib.hostcomm_recv.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.hostcomm_destroy.restype = None
+    lib.hostcomm_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load_dataloader() -> Optional[ctypes.CDLL]:
+    lib = load("dataloader")
+    if lib is None:
+        return None
+    lib.loader_create.restype = ctypes.c_void_p
+    lib.loader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int,
+    ]
+    lib.loader_submit.restype = ctypes.c_int64
+    lib.loader_submit.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
+    ]
+    lib.loader_next.restype = ctypes.c_int
+    lib.loader_next.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.loader_slot_ptr.restype = ctypes.c_void_p
+    lib.loader_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.loader_release.restype = None
+    lib.loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.loader_destroy.restype = None
+    lib.loader_destroy.argtypes = [ctypes.c_void_p]
+    return lib

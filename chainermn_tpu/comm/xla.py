@@ -375,23 +375,35 @@ class XlaCommunicator(CommunicatorBase):
     def allreduce_obj(self, obj: Any, op: str = "sum") -> Any:
         return self._reduce_objs(self.allgather_obj(obj), op)
 
+    @property
+    def _hostcomm(self):
+        """Native TCP object plane for multi-process point-to-point
+        (``chainermn_tpu.hostcomm.HostComm``), bootstrapped from the
+        ``CMN_TPU_HOSTS``/``CMN_TPU_RANK`` env, lazily."""
+        hc = getattr(self, "_hostcomm_cached", None)
+        if hc is None:
+            from chainermn_tpu.hostcomm import HostComm
+
+            hc = self._hostcomm_cached = HostComm()
+        return hc
+
     def send_obj(self, obj: Any, dest: int) -> None:
-        if self._nproc == 1:
+        dest_proc = self._root_proc(dest) if self._nproc > 1 else 0
+        if self._nproc == 1 or dest_proc == jax.process_index():
+            # Ranks co-located in this process deliver through the local
+            # queue — the transport refuses self-sends by design.
             self._self_queue.setdefault(dest, _queue.SimpleQueue()).put(
                 pickle.dumps(obj)
             )
             return
-        raise NotImplementedError(
-            "multi-process object send/recv goes through the hostcomm runtime"
-        )
+        self._hostcomm.send_obj(obj, dest_proc)
 
     def recv_obj(self, source: int) -> Any:
-        if self._nproc == 1:
+        src_proc = self._root_proc(source) if self._nproc > 1 else 0
+        if self._nproc == 1 or src_proc == jax.process_index():
             q = self._self_queue.setdefault(self.rank, _queue.SimpleQueue())
             return pickle.loads(q.get_nowait())
-        raise NotImplementedError(
-            "multi-process object send/recv goes through the hostcomm runtime"
-        )
+        return self._hostcomm.recv_obj(src_proc)
 
     # ----------------------------------------------------------- structuring
     def sub(self, axes: Sequence[str] | str) -> "XlaCommunicator":

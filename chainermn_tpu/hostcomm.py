@@ -1,0 +1,143 @@
+"""Host object-plane communicator over the native TCP transport.
+
+Fills the role the reference gave to mpi4py's pickled-object operations
+(``CommunicatorBase.send_obj/recv_obj/bcast_obj/gather_obj/allreduce_obj`` —
+SURVEY.md §2.2): control-plane exchange of arbitrary Python objects between
+host processes.  The TPU tensor plane never goes through here — that is XLA
+collectives; this carries filenames, metric dicts, dataset orders,
+checkpoint-iteration votes.
+
+Topology comes from env (``CMN_TPU_HOSTS`` = comma-separated ``ip:port``,
+``CMN_TPU_RANK``) or explicit arguments, mirroring how ``jax.distributed``
+is bootstrapped.  Composite ops (barrier/bcast/gather/allgather/allreduce)
+are built from framed point-to-point in Python; the wire is native C++
+(`_native/hostcomm.cpp`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from chainermn_tpu import _native
+
+
+class HostComm:
+    """Point-to-point + composed collectives between host processes."""
+
+    def __init__(
+        self,
+        rank: Optional[int] = None,
+        hosts: Optional[Sequence[Tuple[str, int]]] = None,
+        timeout_ms: int = 30000,
+    ):
+        if hosts is None:
+            spec = os.environ.get("CMN_TPU_HOSTS", "")
+            if not spec:
+                raise ValueError(
+                    "HostComm needs hosts=[(ip, port), ...] or CMN_TPU_HOSTS"
+                )
+            hosts = []
+            for part in spec.split(","):
+                ip, port = part.rsplit(":", 1)
+                hosts.append((ip, int(port)))
+        if rank is None:
+            rank = int(os.environ.get("CMN_TPU_RANK", "-1"))
+        if not (0 <= rank < len(hosts)):
+            raise ValueError(f"bad rank {rank} for {len(hosts)} hosts")
+        self.rank = int(rank)
+        self.size = len(hosts)
+        self._lib = _native.load_hostcomm()
+        if self._lib is None:
+            raise RuntimeError("native hostcomm unavailable (g++ missing?)")
+        c_hosts = (ctypes.c_char_p * self.size)(
+            *[h.encode() for h, _ in hosts]
+        )
+        c_ports = (ctypes.c_int * self.size)(*[p for _, p in hosts])
+        self._h = self._lib.hostcomm_init(
+            self.rank, self.size, c_hosts, c_ports, timeout_ms
+        )
+        if not self._h:
+            raise RuntimeError(
+                f"hostcomm rank {rank}: failed to establish the peer mesh"
+            )
+
+    # ------------------------------------------------------- point-to-point
+    def send_obj(self, obj: Any, dest: int) -> None:
+        payload = pickle.dumps(obj)
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        rc = self._lib.hostcomm_send(self._h, dest, buf, len(payload))
+        if rc != 0:
+            raise RuntimeError(f"send to {dest} failed (rc={rc})")
+
+    def recv_obj(self, source: int, timeout_ms: int = -1) -> Any:
+        n = self._lib.hostcomm_recv(self._h, source, None, 0, timeout_ms)
+        if n == -1:
+            raise TimeoutError(f"recv from {source} timed out")
+        if n < 0:
+            raise RuntimeError(f"recv from {source} failed (rc={n})")
+        buf = (ctypes.c_uint8 * max(int(n), 1))()
+        got = self._lib.hostcomm_recv(self._h, source, buf, int(n), timeout_ms)
+        if got != n:
+            raise RuntimeError(f"recv from {source}: length changed {n}->{got}")
+        return pickle.loads(bytes(buf[: int(n)]))
+
+    # ----------------------------------------------------------- composites
+    def barrier(self) -> None:
+        """Dissemination barrier: log2(size) rounds of paired send/recv."""
+        k = 1
+        while k < self.size:
+            self.send_obj((), (self.rank + k) % self.size)
+            self.recv_obj((self.rank - k) % self.size)
+            k *= 2
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        """Binomial-tree broadcast rooted at ``root`` (log2(size) depth)."""
+        rel = (self.rank - root) % self.size
+        mask = 1
+        while mask < self.size:
+            if rel & mask:
+                obj = self.recv_obj((self.rank - mask) % self.size)
+                break
+            mask <<= 1
+        mask >>= 1
+        while mask >= 1:
+            if rel + mask < self.size:
+                self.send_obj(obj, (self.rank + mask) % self.size)
+            mask >>= 1
+        return obj
+
+    def gather_obj(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
+        if self.rank == root:
+            out: List[Any] = [None] * self.size
+            out[self.rank] = obj
+            for r in range(self.size):
+                if r != root:
+                    out[r] = self.recv_obj(r)
+            return out
+        self.send_obj(obj, root)
+        return None
+
+    def allgather_obj(self, obj: Any) -> List[Any]:
+        gathered = self.gather_obj(obj, root=0)
+        return self.bcast_obj(gathered, root=0)
+
+    def allreduce_obj(self, obj: Any, op: Callable[[Any, Any], Any]) -> Any:
+        vals = self.allgather_obj(obj)
+        acc = vals[0]
+        for v in vals[1:]:
+            acc = op(acc, v)
+        return acc
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.hostcomm_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

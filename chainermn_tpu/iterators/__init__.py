@@ -108,3 +108,6 @@ def create_synchronized_iterator(actual_iterator, communicator):
     the same stream, so synchronization reduces to broadcasting the master's
     RNG-driven batches; we reuse the multi-node iterator mechanism."""
     return _MultiNodeIterator(actual_iterator, communicator, rank_master=0)
+
+
+from chainermn_tpu.iterators.prefetch import PrefetchIterator  # noqa: E402

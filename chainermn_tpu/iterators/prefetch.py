@@ -1,0 +1,210 @@
+"""Prefetching batch iterator over the native threaded batch assembler.
+
+Reference analog: the ImageNet example's multiprocess data loading
+(SURVEY.md §2.9 — Chainer ``MultiprocessIterator``) plus the pinned staging
+buffers of ``_memory_utility.py``.  Worker threads in C++
+(``_native/dataloader.cpp``) gather dataset rows into a ring of preassembled
+batch buffers while the TPU runs the previous step; Python just wraps the
+ready slot in numpy and hands it to ``device_put``.
+
+Falls back to synchronous assembly when the native library can't build, so
+the API is always available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from chainermn_tpu import _native
+
+
+class PrefetchIterator:
+    """Epoch-aware iterator with native background batch assembly.
+
+    Drop-in for :class:`~chainermn_tpu.iterators.SerialIterator` over
+    array-backed datasets (anything exposing ``.arrays``: a tuple of
+    row-major numpy arrays sharing their leading dim).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        repeat: bool = True,
+        shuffle: bool = True,
+        seed: Optional[int] = None,
+        depth: int = 4,
+        n_workers: int = 4,
+        copy: bool = True,
+    ):
+        arrays = tuple(np.ascontiguousarray(a) for a in dataset.arrays)
+        self._arrays = arrays  # keep alive: native loader reads these bases
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self._repeat = repeat
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self._depth = depth
+        self._copy = copy
+        self._n = len(arrays[0])
+
+        lib = _native.load_dataloader()
+        self._lib = lib
+        self._h = None
+        if lib is not None:
+            bases = (ctypes.c_void_p * len(arrays))(
+                *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays]
+            )
+            row_bytes = (ctypes.c_uint64 * len(arrays))(
+                *[a.strides[0] for a in arrays]
+            )
+            strides = (ctypes.c_uint64 * len(arrays))(
+                *[a.strides[0] for a in arrays]
+            )
+            self._h = lib.loader_create(
+                bases, row_bytes, strides, len(arrays), batch_size,
+                depth, n_workers,
+            )
+        self.reset()
+
+    # ------------------------------------------------------------- ordering
+    def reset(self):
+        # Recycle the zero-copy held slot, then drain in-flight slots from a
+        # previous run of the ring.
+        if getattr(self, "_held_slot", None) is not None:
+            self._lib.loader_release(self._h, self._held_slot)
+        self._held_slot: Optional[int] = None
+        if getattr(self, "_h", None) and getattr(self, "_pending", None):
+            while self._pending:
+                if self._pending.pop(0)[1] is None:  # native-assembled
+                    slot = self._lib.loader_next(self._h, -1)
+                    if slot >= 0:
+                        self._lib.loader_release(self._h, slot)
+        self.epoch = 0
+        self.iteration = 0
+        self.is_new_epoch = False
+        self._consumed = 0  # samples consumed this epoch (not submitted)
+        self._order = self._new_order()
+        self._pos = 0
+        # Per submitted batch: (epoch_completing, short_tail_indices_or_None).
+        self._pending: list = []
+        if self._h:
+            for _ in range(self._depth):
+                self._submit_next()
+
+    def _new_order(self):
+        return (
+            self._rng.permutation(self._n)
+            if self._shuffle
+            else np.arange(self._n)
+        )
+
+    def _next_indices(self) -> Optional[Tuple[np.ndarray, bool]]:
+        """Next batch's row indices + whether it completes an epoch."""
+        if self._pos >= self._n:
+            if not self._repeat:
+                return None
+            self._order = self._new_order()
+            self._pos = 0
+        idx = self._order[self._pos : self._pos + self.batch_size]
+        if len(idx) < self.batch_size and self._repeat:
+            idx = np.concatenate([idx, self._order[: self.batch_size - len(idx)]])
+        self._pos += self.batch_size
+        completes = self._pos >= self._n and self._repeat
+        return np.asarray(idx, np.int64), completes
+
+    def _submit_next(self) -> bool:
+        nxt = self._next_indices()
+        if nxt is None:
+            return False
+        idx, completes = nxt
+        if len(idx) < self.batch_size:
+            # repeat=False short tail: the native ring is fixed-batch, so
+            # assemble this one in Python at consume time.
+            self._pending.append((completes, idx))
+            return True
+        buf = idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        seq = self._lib.loader_submit(self._h, buf, len(idx))
+        if seq < 0:
+            raise RuntimeError(f"loader_submit failed (rc={seq})")
+        self._pending.append((completes, None))
+        return True
+
+    # ------------------------------------------------------------ iteration
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._h:
+            return self._next_native()
+        return self._next_sync()
+
+    def _next_native(self):
+        if not self._pending:
+            raise StopIteration
+        # zero-copy mode hands out views into the slot: recycle the previous
+        # slot only now, once the caller is done with its views.
+        if self._held_slot is not None:
+            self._lib.loader_release(self._h, self._held_slot)
+            self._held_slot = None
+        completes, tail_idx = self._pending.pop(0)
+        if tail_idx is not None:  # Python-assembled short tail (repeat=False)
+            self._finish_tick(completes, len(tail_idx))
+            return tuple(a[tail_idx] for a in self._arrays)
+        slot = self._lib.loader_next(self._h, -1)
+        if slot < 0:
+            raise RuntimeError(f"loader_next failed (rc={slot})")
+        out = []
+        for f, a in enumerate(self._arrays):
+            ptr = self._lib.loader_slot_ptr(self._h, slot, f)
+            shape = (self.batch_size,) + a.shape[1:]
+            arr = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(int(np.prod(shape)) * a.dtype.itemsize,),
+            ).view(a.dtype).reshape(shape)
+            out.append(arr.copy() if self._copy else arr)
+        if self._copy:
+            self._lib.loader_release(self._h, slot)
+        else:
+            self._held_slot = slot
+        self._finish_tick(completes, self.batch_size)
+        self._submit_next()  # keep the ring full
+        return tuple(out)
+
+    def _next_sync(self):  # pure-Python fallback
+        nxt = self._next_indices()
+        if nxt is None:
+            raise StopIteration
+        idx, completes = nxt
+        self._finish_tick(completes, len(idx))
+        return tuple(a[idx] for a in self._arrays)
+
+    def _finish_tick(self, completes: bool, n_samples: int):
+        self.iteration += 1
+        self._consumed += n_samples
+        if completes:
+            self.epoch += 1
+            self.is_new_epoch = True
+            self._consumed = 0
+        else:
+            self.is_new_epoch = False
+
+    @property
+    def epoch_detail(self):
+        # Consumption-based (the submission cursor runs `depth` batches ahead
+        # in native mode and must not leak into schedules keyed on progress).
+        return self.epoch + min(self._consumed / max(self._n, 1), 1.0)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.loader_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

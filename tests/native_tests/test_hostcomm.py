@@ -1,0 +1,165 @@
+"""Native object-plane tests: a REAL multi-process exchange over the TCP
+transport — the analog of the reference's ``mpiexec -n N pytest`` runs
+(SURVEY.md §4 mechanism 1), with no JAX involved (control plane only)."""
+
+import multiprocessing as mp
+import pickle
+import socket
+
+import numpy as np
+import pytest
+
+from chainermn_tpu import _native
+
+
+pytestmark = pytest.mark.skipif(
+    _native.load_hostcomm() is None, reason="native toolchain unavailable"
+)
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _worker(rank, ports, q):
+    try:
+        from chainermn_tpu.hostcomm import HostComm
+
+        hosts = [("127.0.0.1", p) for p in ports]
+        comm = HostComm(rank=rank, hosts=hosts, timeout_ms=20000)
+        size = comm.size
+        out = {}
+
+        # point-to-point ring: r -> r+1
+        comm.send_obj({"from": rank, "data": np.arange(3) + rank},
+                      (rank + 1) % size)
+        got = comm.recv_obj((rank - 1) % size)
+        out["ring_from"] = got["from"]
+        out["ring_sum"] = int(got["data"].sum())
+
+        comm.barrier()
+
+        root = 2 % size
+        out["bcast"] = comm.bcast_obj(
+            {"payload": "hello", "rank": rank} if rank == root else None,
+            root=root,
+        )
+        gathered = comm.gather_obj(rank * 10, root=0)
+        out["gather"] = gathered
+        out["allgather"] = comm.allgather_obj((rank, rank**2))
+        out["allreduce"] = comm.allreduce_obj(rank + 1, lambda a, b: a + b)
+
+        comm.barrier()
+        comm.close()
+        q.put((rank, out))
+    except Exception as e:  # surface failures to the parent
+        q.put((rank, {"error": repr(e)}))
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_hostcomm_multiprocess(size):
+    ports = _free_ports(size)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker, args=(r, ports, q)) for r in range(size)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(size):
+        rank, out = q.get(timeout=120)
+        results[rank] = out
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+
+    for rank in range(size):
+        out = results[rank]
+        assert "error" not in out, f"rank {rank}: {out}"
+        assert out["ring_from"] == (rank - 1) % size
+        assert out["ring_sum"] == 3 + 3 * ((rank - 1) % size)
+        assert out["bcast"] == {"payload": "hello", "rank": 2 % size}
+        assert out["allgather"] == [(r, r**2) for r in range(size)]
+        assert out["allreduce"] == size * (size + 1) // 2
+    assert results[0]["gather"] == [r * 10 for r in range(size)]
+    for rank in range(1, size):
+        assert results[rank]["gather"] is None
+
+
+def _big_worker(rank, ports, q):
+    from chainermn_tpu.hostcomm import HostComm
+
+    comm = HostComm(
+        rank=rank, hosts=[("127.0.0.1", p) for p in ports], timeout_ms=20000
+    )
+    rng = np.random.RandomState(7)
+    blob = rng.bytes(8 << 20)  # 8 MiB
+    if rank == 0:
+        comm.send_obj(blob, 1)
+        echoed = comm.recv_obj(1)
+        q.put(("check", echoed == blob))
+    else:
+        comm.send_obj(comm.recv_obj(0), 0)
+        q.put(("echoed", True))
+    comm.close()
+
+
+def test_hostcomm_large_payload():
+    """Multi-megabyte frames survive the framed transport intact."""
+    ports = _free_ports(2)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_big_worker, args=(r, ports, q)) for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    outs = dict(q.get(timeout=120) for _ in range(2))
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    assert outs["check"] is True
+
+
+def _timeout_worker(rank, ports, q):
+    from chainermn_tpu.hostcomm import HostComm
+
+    comm = HostComm(
+        rank=rank, hosts=[("127.0.0.1", p) for p in ports], timeout_ms=20000
+    )
+    if rank == 0:
+        try:
+            comm.recv_obj(1, timeout_ms=200)
+            q.put(("timeout_raised", False))
+        except TimeoutError:
+            q.put(("timeout_raised", True))
+        comm.send_obj("done", 1)
+    else:
+        comm.recv_obj(0)  # waits past rank 0's timeout window
+        q.put(("peer_done", True))
+    comm.close()
+
+
+def test_recv_timeout():
+    ports = _free_ports(2)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_timeout_worker, args=(r, ports, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    outs = dict(q.get(timeout=120) for _ in range(2))
+    for p in procs:
+        p.join(timeout=30)
+    assert outs["timeout_raised"] is True
