@@ -10,6 +10,15 @@ from chainermn_tpu.models.resnet import (
     resnet_loss,
 )
 from chainermn_tpu.models.seq2seq import Seq2Seq, greedy_decode, seq2seq_loss
+from chainermn_tpu.models.transformer import (
+    ParallelLM,
+    ParallelLMConfig,
+    TransformerLM,
+    dense_lm_reference,
+    init_parallel_lm,
+    lm_loss,
+    parallel_lm_specs,
+)
 
 __all__ = [
     "MLP",
@@ -22,4 +31,11 @@ __all__ = [
     "Seq2Seq",
     "seq2seq_loss",
     "greedy_decode",
+    "TransformerLM",
+    "lm_loss",
+    "ParallelLM",
+    "ParallelLMConfig",
+    "init_parallel_lm",
+    "parallel_lm_specs",
+    "dense_lm_reference",
 ]

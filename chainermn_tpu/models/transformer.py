@@ -1,0 +1,348 @@
+"""Transformer LM — the long-context flagship of the model zoo.
+
+Two tiers:
+
+* :class:`TransformerLM` — Flax decoder-only LM for single-chip / pure-DP
+  use, attention running on the Pallas flash kernel
+  (:func:`chainermn_tpu.ops.flash_attention`).
+
+* The functional *parallel* LM (`init_parallel_lm` / `ParallelLM`) — the
+  5-way-parallel SPMD program composed from the framework's own pieces:
+  data parallel over ``data``, GPipe microbatch pipelining over ``stage``
+  (:class:`~chainermn_tpu.links.PipelineChain`), tensor-parallel attention
+  heads + expert-parallel MoE FFN over ``model``
+  (:class:`~chainermn_tpu.parallel.MoELayer`), and ring-attention context
+  parallelism over ``seq``
+  (:func:`~chainermn_tpu.parallel.ring_self_attention`).  This is the shape
+  the reference could not express (its model parallelism was coarse
+  rank-placement — ``multi_node_chain_list.py``; SP/EP absent, SURVEY.md
+  §2.3) and the program `__graft_entry__.dryrun_multichip` exercises.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import flax.linen as nn
+
+from chainermn_tpu.links.chain_list import PipelineChain
+from chainermn_tpu.parallel.moe import MoELayer
+from chainermn_tpu.parallel.ring_attention import ring_self_attention
+
+
+# =====================================================================
+# Flax tier (single-chip / DP)
+# =====================================================================
+class TransformerLM(nn.Module):
+    """Decoder-only LM; attention runs on the Pallas flash kernel."""
+
+    vocab: int
+    n_layers: int = 4
+    d_model: int = 256
+    n_heads: int = 4
+    d_ff: int = 1024
+    max_len: int = 1024
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):  # (B, T) int32 -> (B, T, vocab) f32
+        from chainermn_tpu.ops import flash_attention
+
+        B, T = tokens.shape
+        D, H = self.d_model, self.n_heads
+        h = nn.Embed(self.vocab, D, dtype=self.dtype, name="embed")(tokens)
+        pos = self.param(
+            "pos", nn.initializers.normal(0.02), (self.max_len, D), jnp.float32
+        )
+        h = h + pos[None, :T].astype(self.dtype)
+        for i in range(self.n_layers):
+            x = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(h)
+            qkv = nn.DenseGeneral(
+                (3, H, D // H), dtype=self.dtype, name=f"qkv_{i}"
+            )(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            # Largest power-of-two block that divides T (flash needs T %
+            # block == 0); natural lengths work without upstream padding.
+            block = 128
+            while block > 1 and T % block:
+                block //= 2
+            a = flash_attention(q, k, v, causal=True, block_q=block,
+                                block_k=block)
+            o = nn.DenseGeneral(
+                D, axis=(-2, -1), dtype=self.dtype, name=f"proj_{i}"
+            )(a)
+            h = h + o
+            x = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(h)
+            y = nn.Dense(self.d_ff, dtype=self.dtype, name=f"ff1_{i}")(x)
+            y = nn.Dense(D, dtype=self.dtype, name=f"ff2_{i}")(nn.gelu(y))
+            h = h + y
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
+        return nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(h)
+
+
+def lm_loss(model: nn.Module):
+    """``loss_fn(params, (tokens, targets)) -> (loss, aux)`` for the DP
+    optimizer (targets = next tokens, -1 = padding/ignore)."""
+    import optax
+
+    def loss_fn(params, batch):
+        tokens, targets = batch
+        logits = model.apply({"params": params}, tokens)
+        mask = (targets >= 0).astype(jnp.float32)
+        safe = jnp.maximum(targets, 0)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+        loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return loss, {"ppl_log": loss}
+
+    return loss_fn
+
+
+# =====================================================================
+# Functional tier: DP x PP x TP x SP x EP parallel LM
+# =====================================================================
+class ParallelLMConfig(NamedTuple):
+    vocab: int
+    n_stages: int  # one transformer block per pipeline stage
+    d_model: int
+    n_heads: int  # global head count; sharded over `model`
+    d_ff: int  # per-expert hidden size
+    max_len: int
+    n_experts: int  # == size of the `model` axis
+    moe_k: int = 2
+    capacity_factor: float = 0.0  # 0 → ample (no drops; exact vs dense oracle)
+
+
+def init_parallel_lm(rng: np.random.RandomState, cfg: ParallelLMConfig) -> Dict:
+    """Host-side init of the stage-stacked parameter pytree."""
+    S, D, H, F, E = (
+        cfg.n_stages, cfg.d_model, cfg.n_heads, cfg.d_ff, cfg.n_experts
+    )
+    Dh = D // H
+
+    def g(*shape, scale=None):
+        scale = scale if scale is not None else 1.0 / math.sqrt(shape[0])
+        return (rng.normal(size=shape) * scale).astype(np.float32)
+
+    return {
+        "embed": g(cfg.vocab, D, scale=0.02),
+        "pos": g(cfg.max_len, D, scale=0.02),
+        "stages": {
+            "ln1_scale": np.ones((S, D), np.float32),
+            "ln1_bias": np.zeros((S, D), np.float32),
+            "wqkv": g(S, D, 3, H, Dh, scale=1.0 / math.sqrt(D)),
+            "wo": g(S, H, Dh, D, scale=1.0 / math.sqrt(D)),
+            "ln2_scale": np.ones((S, D), np.float32),
+            "ln2_bias": np.zeros((S, D), np.float32),
+            "router": g(S, D, E, scale=1.0 / math.sqrt(D)),
+            "w1": g(S, E, D, F, scale=1.0 / math.sqrt(D)),
+            "w2": g(S, E, F, D, scale=1.0 / math.sqrt(F)),
+        },
+        "ln_f_scale": np.ones((D,), np.float32),
+        "ln_f_bias": np.zeros((D,), np.float32),
+        "lm_head": g(D, cfg.vocab, scale=1.0 / math.sqrt(D)),
+    }
+
+
+def parallel_lm_specs(cfg: ParallelLMConfig):
+    """PartitionSpecs matching :func:`init_parallel_lm`'s pytree."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "embed": P(),
+        "pos": P(),
+        "stages": {
+            "ln1_scale": P("stage", None),
+            "ln1_bias": P("stage", None),
+            "wqkv": P("stage", None, None, "model", None),  # heads TP-sharded
+            "wo": P("stage", "model", None, None),
+            "ln2_scale": P("stage", None),
+            "ln2_bias": P("stage", None),
+            "router": P("stage", None, None),
+            "w1": P("stage", "model", None, None),  # experts EP-sharded
+            "w2": P("stage", "model", None, None),
+        },
+        "ln_f_scale": P(),
+        "ln_f_bias": P(),
+        "lm_head": P(),
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+class ParallelLM:
+    """The 5-way-parallel LM program.  Call :meth:`apply` inside a
+    ``shard_map`` over a mesh with axes ``("data", "stage", "model",
+    "seq")``; parameter leaves follow :func:`parallel_lm_specs`, tokens /
+    targets are ``P("data", "seq")``.
+    """
+
+    def __init__(self, cfg: ParallelLMConfig, stage_comm, n_microbatches: int):
+        self.cfg = cfg
+        self.scomm = stage_comm
+        self.n_micro = n_microbatches
+
+    # --------------------------------------------------- stage (one block)
+    def _stage_apply(self, p, h):
+        # p: this device's (stage, model) shard of the stacked stage params
+        # (leading stage axis 1; expert/head axes local).  h: (B, Tl, D).
+        cfg = self.cfg
+        B, Tl, D = h.shape
+        x = _layer_norm(h, p["ln1_scale"][0], p["ln1_bias"][0])
+        qkv = jnp.einsum("btd,dche->btche", x, p["wqkv"][0])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        a = ring_self_attention(q, k, v, "seq", causal=True)  # SP ring
+        o = jnp.einsum("bthe,hed->btd", a, p["wo"][0])
+        o = lax.psum(o, "model")  # TP contraction over head shards
+        h = h + o
+
+        x = _layer_norm(h, p["ln2_scale"][0], p["ln2_bias"][0])
+        E = cfg.n_experts
+        N = B * Tl
+        toks = x.reshape(N, D)
+        # After the TP psum the activations are replicated over `model`; MoE
+        # expects tokens SHARDED over the expert axis (moe.py layout), so
+        # each rank dispatches only its 1/E slice and the outputs are
+        # re-assembled with an all_gather — identical numerics, E× less
+        # expert compute and dispatch traffic than routing the full set
+        # everywhere.
+        if N % E:
+            raise ValueError(f"local tokens {N} not divisible by experts {E}")
+        mrank = lax.axis_index("model")
+        mine = lax.dynamic_slice_in_dim(toks, mrank * (N // E), N // E, axis=0)
+
+        def expert_apply(ep, t):
+            w1, w2 = ep  # local shards (1, D, F), (1, F, D)
+            return jax.nn.gelu(t @ w1[0]) @ w2[0]
+
+        cap_f = cfg.capacity_factor if cfg.capacity_factor > 0 else float(E)
+        moe = MoELayer(expert_apply, "model", k=cfg.moe_k,
+                       capacity_factor=cap_f)
+        y, aux = moe(p["router"][0], (p["w1"][0], p["w2"][0]), mine)
+        y = lax.all_gather(y, "model", axis=0, tiled=True)  # (N, D)
+        h = h + y.reshape(B, Tl, D)
+        return h
+
+    # ------------------------------------------------------------ forward
+    def apply(self, params, tokens):
+        """tokens: (B_local, T_local) int32 → logits (B_local, T_local, V)."""
+        cfg = self.cfg
+        B, Tl = tokens.shape
+        seq_rank = lax.axis_index("seq")
+        h = params["embed"][tokens]
+        pos = lax.dynamic_slice_in_dim(
+            params["pos"], seq_rank * Tl, Tl, axis=0
+        )
+        h = h + pos[None]
+        pipe = PipelineChain(self._stage_apply, self.scomm, self.n_micro)
+        h = pipe(params["stages"], h)
+        h = _layer_norm(h, params["ln_f_scale"], params["ln_f_bias"])
+        return h @ params["lm_head"]
+
+    def loss(self, params, batch):
+        """This rank's SHARE of the global masked CE.
+
+        Two normalizations make shard_map AD produce the exact global
+        gradient with no fudge factors:
+
+        * numerator is local but the denominator is the GLOBAL valid-token
+          count (shards hold unequal mask counts, so a mean-of-local-means
+          would be biased);
+        * divided by the stage×model replica count — those ranks compute
+          IDENTICAL loss copies, and ``value_and_grad`` seeds a cotangent
+          per rank, so without the division the total seeded mass would be
+          ``stage·model × L`` instead of ``L``.
+
+        The global loss value is the psum of shares over ALL mesh axes.
+        """
+        tokens, targets = batch
+        logits = self.apply(params, tokens)
+        mask = (targets >= 0).astype(jnp.float32)
+        safe = jnp.maximum(targets, 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        n_total = lax.psum(jnp.sum(mask), ("data", "seq"))
+        replicas = lax.axis_size("stage") * lax.axis_size("model")
+        return jnp.sum(ce * mask) / jnp.maximum(n_total, 1.0) / replicas
+
+    # ------------------------------------------------------ grad reduction
+    def grad_reduce(self, grads, axes=("data", "stage", "model", "seq")):
+        """Per-leaf cross-device gradient reduction.
+
+        With :meth:`loss` seeding the global loss exactly once across the
+        mesh, shard_map AD already yields ∂L/∂(this copy) for every
+        parameter copy; a tied (replicated) parameter's gradient is then the
+        SUM of its copies' gradients.  So each leaf psums over exactly the
+        axes its PartitionSpec does NOT shard — e.g. ``embed`` (fully
+        replicated; grads live only on stage-0 ranks where the pipeline
+        consumes its input) sums over all axes, while ``wqkv`` (sharded over
+        stage and model) sums over data/seq only.
+        """
+        specs = parallel_lm_specs(self.cfg)
+
+        def reduce_leaf(g, spec):
+            used = set()
+            for entry in spec:
+                if entry is None:
+                    continue
+                if isinstance(entry, (tuple, list)):
+                    used.update(entry)
+                else:
+                    used.add(entry)
+            free = tuple(a for a in axes if a not in used)
+            return lax.psum(g, free) if free else g
+
+        # NB: is_leaf keys on the grads tree (arrays), so the matching specs
+        # subtree (a PartitionSpec, itself a tuple) is passed through whole.
+        return jax.tree_util.tree_map(
+            reduce_leaf, grads, specs, is_leaf=lambda x: hasattr(x, "shape")
+        )
+
+
+def dense_lm_reference(params_host: Dict, cfg: ParallelLMConfig, tokens):
+    """Single-device oracle: identical math, no parallelism (for tests and
+    parity checks).  ``params_host`` is the :func:`init_parallel_lm` pytree.
+    """
+    p = jax.tree_util.tree_map(jnp.asarray, params_host)
+    B, T = tokens.shape
+    D = cfg.d_model
+    h = p["embed"][tokens] + p["pos"][None, :T]
+    for s in range(cfg.n_stages):
+        st = {k: v[s] for k, v in p["stages"].items()}
+        x = _layer_norm(h, st["ln1_scale"], st["ln1_bias"])
+        qkv = jnp.einsum("btd,dche->btche", x, st["wqkv"])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        s_ = jnp.einsum("bqhe,bkhe->bhqk", q, k) * scale
+        s_ = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s_, -jnp.inf)
+        a = jnp.einsum("bhqk,bkhe->bqhe", jax.nn.softmax(s_, axis=-1), v)
+        h = h + jnp.einsum("bthe,hed->btd", a, st["wo"])
+
+        x = _layer_norm(h, st["ln2_scale"], st["ln2_bias"])
+        toks = x.reshape(B * T, D)
+        probs = jax.nn.softmax(toks @ st["router"], axis=-1)
+        # dense top-k with renormalized gates (matches MoELayer w/ ample cap)
+        k_ = cfg.moe_k
+        top = jax.lax.top_k(probs, k_)[1]
+        sel = jax.nn.one_hot(top, cfg.n_experts).sum(axis=1)  # (N, E)
+        gates = probs * sel
+        gates = gates / jnp.maximum(
+            gates.sum(-1, keepdims=True), jnp.finfo(jnp.float32).tiny
+        )
+        expert_out = jnp.stack(
+            [jax.nn.gelu(toks @ st["w1"][e]) @ st["w2"][e]
+             for e in range(cfg.n_experts)], axis=1,
+        )  # (N, E, D)
+        y = jnp.einsum("ne,ned->nd", gates, expert_out)
+        h = h + y.reshape(B, T, D)
+    h = _layer_norm(h, p["ln_f_scale"], p["ln_f_bias"])
+    return h @ p["lm_head"]

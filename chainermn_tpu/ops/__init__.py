@@ -1,0 +1,12 @@
+"""TPU kernels (Pallas) for the hot ops.
+
+The reference's hot-op layer was CUDA-side: cupy kernels fused into NCCL
+pack/unpack (``pure_nccl_communicator.py``'s fp16 cast-pack) and cuDNN conv/
+attention under Chainer.  Here the hot ops are Pallas TPU kernels; everything
+has an XLA fallback so the package stays portable (CPU tests run the same
+code in interpret mode).
+"""
+
+from chainermn_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

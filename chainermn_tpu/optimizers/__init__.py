@@ -231,6 +231,31 @@ def create_multi_node_optimizer(
     )
 
 
+def optimizer_state_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
+    """PartitionSpecs for an optax state, mirroring the params' specs.
+
+    Assumes the state's leaf sequence is param-structure-periodic (each
+    momentum/variance buffer repeats the params' leaf order) — true for
+    sgd/momentum/adamw-style transforms whose per-param buffers dominate;
+    the assert trips for states with stray scalar leaves (wrap those
+    transforms with their own spec handling).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    flat, treedef = jax.tree_util.tree_flatten(opt_state)
+    spec_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    n = len(jax.tree_util.tree_leaves(params))
+    if not flat:
+        return opt_state
+    assert len(flat) % n == 0, (
+        f"optimizer state has {len(flat)} leaves, not a multiple of the "
+        f"{n} param leaves — build its specs explicitly"
+    )
+    return jax.tree_util.tree_unflatten(treedef, spec_leaves * (len(flat) // n))
+
+
 def model_parallel_grad_reduce(data_comm, model_comm) -> Callable:
     """Per-leaf reducer for hybrid DP×MP training with owner-localized stage
     gradients (e.g. :class:`chainermn_tpu.links.MultiNodeChainList`).
