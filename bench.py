@@ -14,15 +14,48 @@ min on 1024×P100 ⇒ ~125 images/sec/GPU; BASELINE.md).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
-import chainermn_tpu as cmn
-from chainermn_tpu.models.resnet import ResNet50, resnet_loss
+def _device_alive(timeout_s: int = 180) -> bool:
+    """Probe the default backend in a SUBPROCESS: a wedged device tunnel
+    hangs client creation forever, which would otherwise hang the bench."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "print(float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()))"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+_FORCE_CPU = os.environ.get("CMN_BENCH_FORCE_CPU") == "1" or not _device_alive()
+
+import jax  # noqa: E402
+
+if _FORCE_CPU:
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import chainermn_tpu as cmn  # noqa: E402
+from chainermn_tpu.models.resnet import ResNet50, resnet_loss  # noqa: E402
 
 
 REFERENCE_IMAGES_PER_SEC_PER_CHIP = 125.0

@@ -1,0 +1,97 @@
+"""Scaling-efficiency harness: per-chip throughput retention across pod sizes.
+
+The reference's headline claim is near-linear ResNet-50 scaling (BASELINE.md);
+this harness measures the same quantity for any model/step on whatever
+devices are present — real chips on a pod, or the forced-CPU simulation:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python benchmarks/scaling.py
+
+Also runs the ``DummyCommunicator`` ablation (upper-bound scaling with
+communication removed — the reference's stated purpose for that class),
+so the printed efficiency gap attributes directly to comm cost.
+Prints one JSON line per (size, communicator) config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-per-chip", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+
+    from chainermn_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+    if jax.default_backend() == "cpu":
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.utils import benchmark, scaling_efficiency
+
+    all_devices = jax.devices()
+    sizes = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= len(all_devices)]
+    rng = np.random.RandomState(0)
+
+    results = {}
+    for dummy in (False, True):
+        throughputs = []
+        for n in sizes:
+            devs = all_devices[:n]
+            comm = (
+                cmn.DummyCommunicator(cmn.flat_mesh(devs))
+                if dummy
+                else cmn.XlaCommunicator(cmn.flat_mesh(devs))
+            )
+            model = MLP([args.dim, args.dim], 10)
+            B = args.batch_per_chip * n
+            x = rng.normal(size=(B, args.dim)).astype(np.float32)
+            y = rng.randint(0, 10, size=(B,)).astype(np.int32)
+            params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+            opt = cmn.create_multi_node_optimizer(
+                optax.sgd(0.1, momentum=0.9), comm
+            )
+            state = opt.init(params)
+            step = opt.make_train_step(
+                classification_loss(model), has_aux=True, donate=False
+            )
+            batch = comm.shard_batch((x, y))
+            holder = {"state": state}
+
+            def run():
+                holder["state"], m = step(holder["state"], batch)
+                return m
+
+            t = benchmark(run, warmup=2, iters=args.iters)
+            ips = B / t["mean_s"]
+            throughputs.append(ips)
+            print(json.dumps({
+                "config": "dummy" if dummy else "xla",
+                "devices": n,
+                "samples_per_sec": round(ips, 1),
+                "per_chip": round(ips / n, 1),
+            }), flush=True)
+        effs = scaling_efficiency(throughputs, sizes)
+        results["dummy" if dummy else "xla"] = effs
+        print(json.dumps({
+            "config": "dummy" if dummy else "xla",
+            "scaling_efficiency": [round(e, 3) for e in effs],
+            "sizes": sizes,
+        }), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Profiling, timing, and scaling-measurement utilities.
+
+SURVEY.md §5: the reference's observability was minimal — `DummyCommunicator`
+for comm-cost ablation, Chainer's TimerHook, rank-0-gated `LogReport`.  Here:
+`jax.profiler` traces (ICI collective timeline in xprof), a benchmark harness
+with honest device syncing, and scaling-efficiency accounting against
+`BASELINE.md`'s ≥90%-linear target.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def respect_jax_platforms_env() -> None:
+    """Make the ``JAX_PLATFORMS`` env var authoritative even when a
+    site-customization preconfigured another platform via ``jax.config``
+    (observed here: a preinstalled TPU-tunnel plugin registers itself ahead
+    of env vars).  Call BEFORE any computation; drops initialized backends."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        if jax.config.jax_platforms == want:
+            return
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", want)
+    try:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+
+
+def sync(tree: Any) -> None:
+    """Wait for device work by MATERIALIZING a value, not just
+    ``block_until_ready`` — readiness can report early on donated-aliased
+    outputs and deeply queued steps over tunneled devices; a device→host
+    transfer cannot lie."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "addressable_shards"):
+            np.asarray(leaf.addressable_shards[0].data.ravel()[:1])
+        else:
+            np.asarray(leaf).ravel()[:1]
+
+
+def benchmark(
+    step: Callable,
+    *args,
+    warmup: int = 3,
+    iters: int = 10,
+    sync_out: Optional[Callable] = None,
+) -> Dict[str, float]:
+    """Time ``step(*args)`` honestly: per-iteration transfer-based sync.
+
+    ``sync_out`` picks what to sync from the step's return value (default:
+    the whole thing).  Returns mean/min/max seconds per iteration.
+    """
+    pick = sync_out or (lambda out: out)
+    for _ in range(warmup):
+        sync(pick(step(*args)))
+    times: List[float] = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(pick(step(*args)))
+        times.append(time.perf_counter() - t0)
+    return {
+        "mean_s": float(np.mean(times)),
+        "min_s": float(np.min(times)),
+        "max_s": float(np.max(times)),
+        "iters": float(iters),
+    }
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """``jax.profiler`` trace scope — view the collective/compute timeline in
+    tensorboard/xprof (the TPU analog of nvprof-on-NCCL the reference era
+    used)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def scaling_efficiency(
+    throughputs: Sequence[float], sizes: Sequence[int]
+) -> List[float]:
+    """Linear-scaling efficiency per pod size vs the smallest measured size:
+    ``eff[i] = (T_i / n_i) / (T_0 / n_0)`` (per-chip throughput retention —
+    the metric of BASELINE.md's ≥90% target)."""
+    base = throughputs[0] / sizes[0]
+    return [float((t / n) / base) for t, n in zip(throughputs, sizes)]
+
+
+class StepTimer:
+    """Trainer extension: logs steps/sec over each interval (rank 0)."""
+
+    def __init__(self, trigger=(1, "epoch")):
+        from chainermn_tpu.training import Extension
+
+        self._last_t = time.perf_counter()
+        self._last_iter = 0
+
+        def fire(trainer):
+            now = time.perf_counter()
+            d_iter = trainer.iteration - self._last_iter
+            dt = now - self._last_t
+            if d_iter and jax.process_index() == 0:
+                print(f"[timer] {d_iter / dt:.2f} iters/sec", flush=True)
+            self._last_t, self._last_iter = now, trainer.iteration
+
+        self.extension = Extension(fire, trigger=trigger, name="StepTimer")
