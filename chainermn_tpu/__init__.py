@@ -18,6 +18,11 @@ from chainermn_tpu.comm import (
     hybrid_mesh,
     topology_mesh,
 )
+from chainermn_tpu.distributed import (
+    init_distributed,
+    is_initialized,
+    shutdown_distributed,
+)
 
 __version__ = "0.1.0"
 
@@ -50,6 +55,9 @@ __all__ = [
     "DummyCommunicator",
     "XlaCommunicator",
     "create_communicator",
+    "init_distributed",
+    "shutdown_distributed",
+    "is_initialized",
     "flat_mesh",
     "hybrid_mesh",
     "topology_mesh",

@@ -52,6 +52,7 @@ struct Loader {
   std::deque<int> free_slots;
   uint64_t next_submit_seq = 0;
   uint64_t next_consume_seq = 0;
+  uint64_t next_slot_seq = 0;  // next seq allowed to claim a free slot
   std::mutex mu;
   std::condition_variable cv_work;   // workers wait for work
   std::condition_variable cv_ready;  // consumer waits for published slots
@@ -69,17 +70,32 @@ void worker_loop(Loader* L) {
       // hold one slot (zero-copy views) while `depth` batches are queued, so
       // a submit-side wait could deadlock against a consumer that only
       // releases on its next call.
+      //
+      // Slots are granted in SUBMISSION-SEQ order (next_slot_seq): workers
+      // pop work FIFO but can wake in arbitrary order, and if a later-seq
+      // batch took the last free slot ahead of the earliest-seq one, a
+      // consumer calling loader_next before loader_release (allowed by the
+      // "at most depth in flight" contract) would block on the starved
+      // lowest seq while holding a slot — deadlock.  Because pops are FIFO,
+      // the popped-but-unslotted seqs are contiguous, so the worker holding
+      // next_slot_seq always exists and always gets the next free slot.
       std::unique_lock<std::mutex> lk(L->mu);
       L->cv_work.wait(lk, [&] { return L->stop || !L->work.empty(); });
       if (L->stop) return;
       w = std::move(L->work.front());
       L->work.pop_front();
-      L->cv_free.wait(lk, [&] { return L->stop || !L->free_slots.empty(); });
+      L->cv_free.wait(lk, [&] {
+        return L->stop ||
+               (!L->free_slots.empty() && w.seq == L->next_slot_seq);
+      });
       if (L->stop) return;
       slot = L->free_slots.front();
       L->free_slots.pop_front();
+      L->next_slot_seq++;
       L->slots[slot].ready = false;
     }
+    // Other workers may be waiting for their seq's turn on cv_free.
+    L->cv_free.notify_all();
     Slot& s = L->slots[slot];
     for (size_t f = 0; f < L->features.size(); ++f) {
       const Feature& ft = L->features[f];

@@ -168,9 +168,12 @@ void* hostcomm_init(int rank, int size, const char** hosts, const int* ports,
         c->failed = true;
         return;
       }
+      // Only HIGHER ranks dial us, each exactly once: a hello from a rank
+      // ≤ ours, out of range, or already connected would overwrite (and
+      // leak) an established fd — reject it and fail init.
       int32_t peer = -1;
-      if (!recv_all(fd, &peer, sizeof(peer)) || peer < 0 ||
-          peer >= c->size) {
+      if (!recv_all(fd, &peer, sizeof(peer)) || peer <= c->rank ||
+          peer >= c->size || c->fds[peer] != -1) {
         c->failed = true;
         ::close(fd);
         return;

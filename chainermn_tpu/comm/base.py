@@ -39,7 +39,14 @@ class CommunicatorBase:
 
     Properties ``rank``/``size``/``intra_rank``/``inter_rank``/``intra_size``/
     ``inter_size`` mirror the reference's bootstrap output
-    (``_communication_utility.init_ranks``).
+    (``_communication_utility.init_ranks``) — with one documented semantic
+    shift: the reference is MPMD (one rank per OS process), this framework is
+    single-controller SPMD (one process drives many devices).  A *rank* is a
+    device position along the communicator's mesh axes (``lax.axis_index``
+    in-graph); the scalar ``rank``/``intra_rank``/``inter_rank`` properties
+    describe the *calling process* (its first owned rank), and exact per-rank
+    maps live on ``XlaCommunicator``'s topology (``Topology.proc_of_rank`` /
+    ``intra_rank_of`` / ``inter_rank_of``).
     """
 
     # ------------------------------------------------------------------ sizes
@@ -131,17 +138,33 @@ class CommunicatorBase:
         ``allreduce_obj``)."""
         raise NotImplementedError
 
-    def send_obj(self, obj: Any, dest: int) -> None:
+    def send_obj(self, obj: Any, dest: int, source: Optional[int] = None) -> None:
+        """Rank-addressed object send (reference anchor
+        ``MpiCommunicatorBase.send_obj``).  ``source`` defaults to this
+        process's rank; explicit ``source`` lets a single-controller process
+        speak for a co-located rank.  Delivery matches on the exact
+        ``(source, dest)`` pair."""
         raise NotImplementedError
 
-    def recv_obj(self, source: int) -> Any:
+    def recv_obj(
+        self, source: int, dest: Optional[int] = None, timeout: float = 60.0
+    ) -> Any:
+        """Blocking rank-addressed receive (MPI-recv-like); raises
+        ``TimeoutError`` after ``timeout`` seconds rather than deadlocking."""
         raise NotImplementedError
 
     # ----------------------------------------------------------- structuring
-    def split(self, color: int, key: int) -> "CommunicatorBase":
+    def split(self, color, key=None) -> Any:
         """Reference anchor: ``CommunicatorBase.split`` (MPI_Comm_split) —
-        builds the hybrid DP×MP process grids of the reference.  On a mesh this
-        returns a sub-communicator over a sub-axis or device subset."""
+        builds the hybrid DP×MP process grids of the reference.
+
+        **Documented deviation**: the reference's MPMD form takes this rank's
+        scalar ``(color, key)`` and returns this rank's sub-communicator.
+        Under a single controller there is no "this rank", so the SPMD form
+        takes *per-rank sequences* ``color``/``key`` (length ``size``) and
+        returns ``{color: sub_communicator}`` — every group, because the one
+        controller drives them all.  ``sub(axes)`` is the idiomatic mesh-axis
+        slicing for hybrid grids."""
         raise NotImplementedError
 
     # --------------------------------------------------------- in-graph plane

@@ -91,7 +91,21 @@ def hybrid_mesh(
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
-    """Rank/size bookkeeping mirroring the reference's ``init_ranks`` output."""
+    """Rank/size bookkeeping mirroring the reference's ``init_ranks`` output.
+
+    Honest single-controller semantics (the reference is MPMD — one rank per
+    OS process; here one controller drives many devices):
+
+    * A **rank** is a device position along the communicator's collapsed mesh
+      axes — the same number ``lax.axis_index`` yields in-graph.
+    * The scalar fields describe the *calling process*: ``rank`` is the lowest
+      rank whose device this process owns, ``intra_rank`` that rank's position
+      among this process's ranks (0 by construction), ``inter_rank`` this
+      process's position among participating processes.
+    * Full per-rank queries go through the maps: ``proc_of_rank`` (exact
+      owning process of every rank — this is what the object plane routes on)
+      and the ``intra_rank_of``/``inter_rank_of`` methods.
+    """
 
     rank: int
     size: int
@@ -99,35 +113,76 @@ class Topology:
     intra_size: int
     inter_rank: int
     inter_size: int
+    #: proc_of_rank[r] = process index owning rank r's canonical device
+    #: (non-participating mesh axes at index 0).
+    proc_of_rank: Tuple[int, ...] = ()
+    #: distinct processes in rank order (inter_rank_of = index into this).
+    procs: Tuple[int, ...] = ()
+
+    def proc_of(self, rank: int) -> int:
+        """Owning process of ``rank`` (exact map, any rank)."""
+        return self.proc_of_rank[rank]
+
+    def inter_rank_of(self, rank: int) -> int:
+        """Position of ``rank``'s process among participating processes."""
+        return self.procs.index(self.proc_of_rank[rank])
+
+    def intra_rank_of(self, rank: int) -> int:
+        """Position of ``rank`` among the ranks co-located on its process."""
+        p = self.proc_of_rank[rank]
+        return [r for r in range(self.size) if self.proc_of_rank[r] == p].index(rank)
+
+    def ranks_of_proc(self, proc: int) -> Tuple[int, ...]:
+        return tuple(
+            r for r in range(self.size) if self.proc_of_rank[r] == proc
+        )
 
 
 def topology_from_mesh(mesh: Mesh, axes: Tuple[str, ...]) -> Topology:
-    """Derive process-plane topology numbers for a communicator over ``axes``.
+    """Derive topology for a communicator over ``axes`` of ``mesh``.
 
-    ``size`` is the total number of participants (mesh extent over ``axes``).
-    ``rank`` is this *process*'s first participating device position — under
-    single-controller SPMD every device participates; per-device rank inside a
-    traced program comes from ``lax.axis_index`` instead.
+    Ranks are collapsed positions along ``axes`` in row-major order — exactly
+    ``lax.axis_index(axes)`` in-graph.  When ``axes`` is a strict subset of
+    the mesh, a rank names a *group* of devices (one per position of the
+    non-participating axes); its canonical device (all other axes at 0)
+    defines the owning process for object-plane routing.
     """
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    names = list(mesh.axis_names)
+    part = [names.index(a) for a in axes]
+    rest = [i for i in range(len(names)) if i not in part]
     size = 1
-    for a in axes:
-        size *= sizes[a]
-    if INTER_AXIS in axes and INTRA_AXIS in axes:
-        inter_size = sizes[INTER_AXIS]
-        intra_size = sizes[INTRA_AXIS]
-    else:
-        inter_size = jax.process_count()
-        intra_size = max(size // max(inter_size, 1), 1)
-    proc = jax.process_index()
-    intra_rank = 0
-    inter_rank = proc if inter_size > 1 else 0
-    rank = inter_rank * intra_size + intra_rank
+    for i in part:
+        size *= mesh.devices.shape[i]
+    flat = np.transpose(mesh.devices, part + rest).reshape(size, -1)
+    my = jax.process_index()
+    # Canonical group = the column (fixed non-participating-axes position)
+    # containing THIS process's devices, falling back to column 0.  A subset
+    # communicator (e.g. ``sub("intra")`` on an (inter, intra) mesh) names a
+    # *family* of disjoint groups — one per rest-axes position; each process
+    # must do its rank bookkeeping and object-plane routing within its own
+    # group, otherwise a host whose devices all sit in a later column would
+    # silently impersonate the column-0 host's ranks.
+    col = 0
+    for j in range(flat.shape[1]):
+        if any(int(d.process_index) == my for d in flat[:, j]):
+            col = j
+            break
+    proc_of_rank = tuple(int(d.process_index) for d in flat[:, col])
+    procs = tuple(dict.fromkeys(proc_of_rank))
+    mine = [r for r, p in enumerate(proc_of_rank) if p == my]
+    rank = mine[0] if mine else 0
+    inter_size = len(procs)
+    inter_rank = procs.index(my) if my in procs else 0
+    intra_size = max(
+        sum(1 for p in proc_of_rank if p == q) for q in procs
+    )
     return Topology(
         rank=rank,
         size=size,
-        intra_rank=intra_rank,
+        intra_rank=0,  # `rank` is this process's first rank by construction
         intra_size=intra_size,
         inter_rank=inter_rank,
         inter_size=inter_size,
+        proc_of_rank=proc_of_rank,
+        procs=procs,
     )

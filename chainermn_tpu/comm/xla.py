@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import pickle
 import queue as _queue
+import threading
+import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -34,6 +36,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
 from .base import CommunicatorBase
+
+
+class _Parked:
+    """A cross-process frame parked for another (source, dest) pair.  Already
+    deserialized (the wire serialized it at send time, so snapshot isolation
+    is already guaranteed) — wrapping avoids a re-pickle/re-unpickle round."""
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj):
+        self.obj = obj
+
+
+def _unqueue(item):
+    return item.obj if isinstance(item, _Parked) else pickle.loads(item)
 
 
 class XlaCommunicator(CommunicatorBase):
@@ -72,7 +89,11 @@ class XlaCommunicator(CommunicatorBase):
             jnp.dtype(allreduce_grad_dtype) if allreduce_grad_dtype else None
         )
         self._fn_cache: Dict[Any, Callable] = {}
-        self._self_queue: Dict[int, _queue.SimpleQueue] = {}
+        # Object-plane p2p: one FIFO per (source_rank, dest_rank) pair, so
+        # interleaved senders can never cross-deliver and co-located ranks
+        # (several ranks per process is the TPU norm) stay distinguishable.
+        self._self_queue: Dict[Tuple[int, int], _queue.SimpleQueue] = {}
+        self._demux_mu = threading.Lock()
 
     # ------------------------------------------------------------------ sizes
     @property
@@ -158,12 +179,38 @@ class XlaCommunicator(CommunicatorBase):
         return NamedSharding(self._mesh, self._spec)
 
     def shard_rankwise(self, tree: Any) -> Any:
-        """Place a host pytree (leading axis ``size``) into rankwise layout."""
+        """Place a host pytree into rankwise layout (leading axis = rank).
+
+        Single-process: pass the full ``(size, ...)`` array.  Multi-process:
+        every process passes *its own rows* (leading dim = its rank count, in
+        rank order — what ``scatter_dataset`` hands each host); the global
+        array is assembled without any host gathering the whole thing, the
+        SPMD form of the reference's MPI scatter."""
         sh = self.rankwise_sharding()
         size = self.size
+        nproc = self._nproc
+
+        my_ranks = (
+            len(self._topo.ranks_of_proc(jax.process_index()))
+            if nproc > 1
+            else size
+        )
 
         def put(x):
+            x = np.asarray(x)
             shape = np.shape(x)
+            if nproc > 1:
+                # Each process passes rows for ITS ranks; the global leading
+                # dim scales by rows-per-rank × size (correct even when rank
+                # ownership is ragged across processes).
+                if my_ranks == 0 or shape[0] % my_ranks != 0:
+                    raise ValueError(
+                        f"local leading dim {shape[0]} is not a multiple of "
+                        f"this process's rank count {my_ranks}"
+                    )
+                rows_per_rank = shape[0] // my_ranks
+                gshape = (rows_per_rank * size,) + tuple(shape[1:])
+                return jax.make_array_from_process_local_data(sh, x, gshape)
             if shape and shape[0] % size != 0:
                 raise ValueError(
                     f"leading dim {shape[0]} is not divisible by the "
@@ -182,13 +229,32 @@ class XlaCommunicator(CommunicatorBase):
 
     def replicate(self, tree: Any) -> Any:
         sh = NamedSharding(self._mesh, P())
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+        def put(x):
+            if self._nproc > 1:
+                # Every process holds the full (identical) value; assemble the
+                # globally-replicated array from local shards.
+                x = np.asarray(x)
+                return jax.make_array_from_callback(
+                    np.shape(x), sh, lambda idx: x[idx]
+                )
+            return jax.device_put(x, sh)
+
+        return jax.tree_util.tree_map(put, tree)
 
     def tile_rankwise(self, tree: Any) -> Any:
         """Stack ``size`` copies of a local pytree into rankwise layout."""
+        # Multi-process: each process contributes only its own ranks' rows.
+        local_rows = (
+            len(self._topo.ranks_of_proc(jax.process_index()))
+            if self._nproc > 1
+            else self.size
+        )
         return self.shard_rankwise(
             jax.tree_util.tree_map(
-                lambda x: np.broadcast_to(np.asarray(x)[None], (self.size,) + np.shape(x)),
+                lambda x: np.broadcast_to(
+                    np.asarray(x)[None], (local_rows,) + np.shape(x)
+                ),
                 tree,
             )
         )
@@ -332,22 +398,28 @@ class XlaCommunicator(CommunicatorBase):
             return obj
         from jax.experimental import multihost_utils
 
-        payload = pickle.dumps(obj) if jax.process_index() == self._root_proc(root) else b""
+        is_src = jax.process_index() == self._root_proc(root)
+        payload = pickle.dumps(obj) if is_src else b""
         nbytes = int(
             multihost_utils.broadcast_one_to_all(
-                np.int64(len(payload)), is_source=jax.process_index() == self._root_proc(root)
+                np.int64(len(payload)), is_source=is_src
             )
         )
         buf = np.frombuffer(payload.ljust(nbytes, b"\0"), dtype=np.uint8) if payload else np.zeros(nbytes, np.uint8)
-        out = multihost_utils.broadcast_one_to_all(
-            buf, is_source=jax.process_index() == self._root_proc(root)
-        )
+        out = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
         return pickle.loads(np.asarray(out).tobytes())
 
     def _root_proc(self, root_rank: int) -> int:
-        # Map a communicator rank to its owning process.
-        per = max(self.size // max(self._nproc, 1), 1)
-        return min(root_rank // per, self._nproc - 1)
+        """Owning process of a communicator rank — the exact per-rank map from
+        the mesh topology (``Topology.proc_of_rank``), not a division guess."""
+        self._check_rank(root_rank, "rank")
+        return self._topo.proc_of(root_rank)
+
+    def _check_rank(self, r: int, what: str) -> None:
+        if not (0 <= int(r) < self.size):
+            raise ValueError(
+                f"{what} {r} out of range for communicator size {self.size}"
+            )
 
     def allgather_obj(self, obj: Any) -> List[Any]:
         if self._nproc == 1:
@@ -387,23 +459,79 @@ class XlaCommunicator(CommunicatorBase):
             hc = self._hostcomm_cached = HostComm()
         return hc
 
-    def send_obj(self, obj: Any, dest: int) -> None:
-        dest_proc = self._root_proc(dest) if self._nproc > 1 else 0
-        if self._nproc == 1 or dest_proc == jax.process_index():
-            # Ranks co-located in this process deliver through the local
-            # queue — the transport refuses self-sends by design.
-            self._self_queue.setdefault(dest, _queue.SimpleQueue()).put(
-                pickle.dumps(obj)
+    def _self_q(self, source: int, dest: int) -> _queue.SimpleQueue:
+        with self._demux_mu:
+            return self._self_queue.setdefault(
+                (int(source), int(dest)), _queue.SimpleQueue()
             )
-            return
-        self._hostcomm.send_obj(obj, dest_proc)
 
-    def recv_obj(self, source: int) -> Any:
-        src_proc = self._root_proc(source) if self._nproc > 1 else 0
-        if self._nproc == 1 or src_proc == jax.process_index():
-            q = self._self_queue.setdefault(self.rank, _queue.SimpleQueue())
-            return pickle.loads(q.get_nowait())
-        return self._hostcomm.recv_obj(src_proc)
+    def send_obj(self, obj: Any, dest: int, source: Optional[int] = None) -> None:
+        """Point-to-point object send addressed by *rank* (reference anchor
+        ``MpiCommunicatorBase.send_obj``).
+
+        ``source`` defaults to :attr:`rank` (this process's first rank); pass
+        it explicitly when acting for a co-located rank — under
+        single-controller SPMD one process legitimately speaks for several
+        ranks, where each MPMD reference process spoke only for itself.
+        Messages are framed ``(source, dest, obj)`` and demultiplexed on the
+        exact pair, so interleaved senders can never cross-deliver.
+        """
+        src = self.rank if source is None else int(source)
+        self._check_rank(src, "source")
+        self._check_rank(dest, "dest")
+        if self._nproc > 1 and self._topo.proc_of(dest) != jax.process_index():
+            self._hostcomm.send_obj((src, int(dest), obj), self._topo.proc_of(dest))
+            return
+        self._self_q(src, dest).put(pickle.dumps(obj))
+
+    def recv_obj(
+        self,
+        source: int,
+        dest: Optional[int] = None,
+        timeout: float = 60.0,
+    ) -> Any:
+        """Blocking receive of the next object sent from rank ``source`` to
+        rank ``dest`` (default: :attr:`rank`), like an MPI recv — raises
+        :class:`TimeoutError` after ``timeout`` seconds instead of deadlocking
+        a wedged job."""
+        dst = self.rank if dest is None else int(dest)
+        self._check_rank(source, "source")
+        self._check_rank(dst, "dest")
+        q = self._self_q(source, dst)
+        if self._nproc == 1 or self._topo.proc_of(source) == jax.process_index():
+            try:
+                return _unqueue(q.get(timeout=timeout))
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"recv_obj(source={source}, dest={dst}) timed out "
+                    f"after {timeout}s"
+                ) from None
+        # Cross-process: drain frames from the source's process, delivering
+        # ours and parking frames addressed to other co-located pairs.
+        src_proc = self._topo.proc_of(source)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return _unqueue(q.get_nowait())
+            except _queue.Empty:
+                pass
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"recv_obj(source={source}, dest={dst}) timed out "
+                    f"after {timeout}s"
+                )
+            with self._demux_mu:
+                try:
+                    frame = self._hostcomm.recv_obj(
+                        src_proc, timeout_ms=int(min(remaining, 0.25) * 1000)
+                    )
+                except TimeoutError:
+                    continue
+            s, d, payload = frame
+            if (s, d) == (int(source), dst):
+                return payload
+            self._self_q(s, d).put(_Parked(payload))
 
     # ----------------------------------------------------------- structuring
     def sub(self, axes: Sequence[str] | str) -> "XlaCommunicator":

@@ -1,0 +1,92 @@
+"""Multi-host bootstrap — the ``jax.distributed`` control plane.
+
+TPU-native equivalent of the reference's MPI process bootstrap
+(``chainermn/communicators/_communication_utility.py`` — ``init_ranks`` /
+``init_intra_mpi_comm`` / ``init_inter_mpi_comm``; SURVEY.md §2.1 "MPI
+binding" and §3.1 ``create_communicator`` call stack).  Where the reference
+relied on ``mpiexec`` to spawn N processes and ``MPI_COMM_WORLD`` to find
+them, a TPU pod job runs one process per host and finds its peers through
+the JAX coordination service (a gRPC server on process 0, reached over DCN).
+
+``init_distributed()`` must run before any other JAX call, exactly like
+``MPI_Init`` had to run before any MPI call.  After it, ``jax.devices()``
+is the *global* device list, ``jax.process_index()``/``process_count()``
+play the role of MPI rank/size on the control plane, and every communicator
+built afterwards spans the whole job.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+    cpu_collectives: Optional[str] = None,
+) -> None:
+    """Initialize the multi-process JAX runtime (reference: MPI bootstrap).
+
+    On Cloud TPU pods all arguments are auto-detected from the TPU metadata
+    environment — call with no arguments, once, at program start.  Off-pod
+    (CI, CPU simulation, bring-your-own cluster) pass them explicitly or via
+    env: ``CMN_COORDINATOR`` (``ip:port``), ``CMN_NUM_PROCESSES``,
+    ``CMN_PROCESS_ID``.
+
+    Args:
+      coordinator_address: ``ip:port`` of process 0's coordination service.
+      num_processes: total process count (the ``mpiexec -n`` analog).
+      process_id: this process's id (the MPI rank analog).
+      local_device_ids: restrict this process to a subset of local devices.
+      cpu_collectives: cross-process collective implementation for the CPU
+        backend (``"gloo"`` or ``"mpi"``) — the CI analog of the reference
+        running its whole test suite under ``mpiexec -n 2`` on one box
+        (SURVEY.md §4).
+    """
+    global _initialized
+    if _initialized:
+        return
+
+    coordinator_address = coordinator_address or os.environ.get("CMN_COORDINATOR")
+    if num_processes is None and os.environ.get("CMN_NUM_PROCESSES"):
+        num_processes = int(os.environ["CMN_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("CMN_PROCESS_ID"):
+        process_id = int(os.environ["CMN_PROCESS_ID"])
+
+    import jax
+
+    if cpu_collectives:
+        jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = list(local_device_ids)
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def shutdown_distributed() -> None:
+    """Tear down the coordination service connection (MPI_Finalize analog)."""
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized

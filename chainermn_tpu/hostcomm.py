@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import os
 import pickle
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from chainermn_tpu import _native
@@ -73,11 +74,18 @@ class HostComm:
             raise RuntimeError(f"send to {dest} failed (rc={rc})")
 
     def recv_obj(self, source: int, timeout_ms: int = -1) -> Any:
+        t0 = time.monotonic()
         n = self._lib.hostcomm_recv(self._h, source, None, 0, timeout_ms)
         if n == -1:
             raise TimeoutError(f"recv from {source} timed out")
         if n < 0:
             raise RuntimeError(f"recv from {source} failed (rc={n})")
+        if timeout_ms >= 0:
+            # The peek already consumed part of the budget; the pop gets the
+            # remainder (the frame is already queued, so this is just the
+            # memcpy — but keep the total wait ≤ timeout_ms, not 2×).
+            elapsed_ms = int((time.monotonic() - t0) * 1000)
+            timeout_ms = max(timeout_ms - elapsed_ms, 0)
         buf = (ctypes.c_uint8 * max(int(n), 1))()
         got = self._lib.hostcomm_recv(self._h, source, buf, int(n), timeout_ms)
         if got != n:

@@ -13,6 +13,38 @@ from typing import Optional
 import numpy as np
 
 
+def _next_epoch_indices(it):
+    """Advance an epoch-ordered iterator one batch.
+
+    Shared by :class:`SerialIterator` and
+    :class:`~chainermn_tpu.iterators.prefetch.PrefetchIterator` (duck-typed on
+    ``_pos``/``_order``/``_n``/``batch_size``/``_repeat``/``_new_order``) so
+    their epoch semantics cannot drift apart.  Returns ``(indices,
+    completes_epoch)`` or ``None`` when a non-repeating pass is exhausted.
+
+    Semantics: epoch bookkeeping belongs to the batch that COMPLETES a pass
+    (also with ``repeat=False``, so ``(N, 'epoch')``-triggered extensions fire
+    on the final batch of a finite pass); a batch spanning the boundary wraps
+    with the NEXT epoch's freshly shuffled order — wrapping with the head of
+    the old permutation would repeat those samples in the coming pass.
+    """
+    n = it._n
+    if it._pos >= n:
+        if not it._repeat:
+            return None
+        it._order = it._new_order()
+        it._pos = 0
+    idx = it._order[it._pos : it._pos + it.batch_size]
+    it._pos += it.batch_size
+    completes = it._pos >= n
+    if len(idx) < it.batch_size and it._repeat:
+        it._order = it._new_order()
+        extra = it._order[: it.batch_size - len(idx)]
+        idx = np.concatenate([idx, extra])
+        it._pos = len(extra)
+    return np.asarray(idx, np.int64), completes
+
+
 class SerialIterator:
     """Minimal epoch-aware batch iterator (the Chainer ``SerialIterator``
     shape the trainer loop consumes).  Yields tuples of stacked numpy arrays
@@ -25,6 +57,7 @@ class SerialIterator:
         self._repeat = repeat
         self._shuffle = shuffle
         self._rng = np.random.RandomState(seed)
+        self._n = len(dataset)
         self.reset()
 
     def reset(self):
@@ -42,22 +75,12 @@ class SerialIterator:
         return self
 
     def __next__(self):
-        n = len(self.dataset)
-        if self._pos >= n:
-            if not self._repeat:
-                raise StopIteration
-            self._order = self._new_order()
-            self._pos = 0
-        idx = self._order[self._pos : self._pos + self.batch_size]
-        if len(idx) < self.batch_size and self._repeat:
-            # wrap to keep static batch shapes (XLA needs them)
-            extra = self._order[: self.batch_size - len(idx)]
-            idx = np.concatenate([idx, extra])
-        self._pos += self.batch_size
+        nxt = _next_epoch_indices(self)
+        if nxt is None:
+            raise StopIteration
+        idx, completes = nxt
         self.iteration += 1
-        # Epoch bookkeeping happens on the batch that COMPLETES the pass, so
-        # stop=(N, 'epoch') sees exactly N passes with no stray extra batch.
-        if self._pos >= n and self._repeat:
+        if completes:
             self.epoch += 1
             self.is_new_epoch = True
         else:

@@ -103,18 +103,12 @@ class PrefetchIterator:
         )
 
     def _next_indices(self) -> Optional[Tuple[np.ndarray, bool]]:
-        """Next batch's row indices + whether it completes an epoch."""
-        if self._pos >= self._n:
-            if not self._repeat:
-                return None
-            self._order = self._new_order()
-            self._pos = 0
-        idx = self._order[self._pos : self._pos + self.batch_size]
-        if len(idx) < self.batch_size and self._repeat:
-            idx = np.concatenate([idx, self._order[: self.batch_size - len(idx)]])
-        self._pos += self.batch_size
-        completes = self._pos >= self._n and self._repeat
-        return np.asarray(idx, np.int64), completes
+        """Next batch's row indices + whether it completes an epoch — the
+        exact semantics shared with SerialIterator (one implementation, so
+        the two iterators cannot drift)."""
+        from chainermn_tpu.iterators import _next_epoch_indices
+
+        return _next_epoch_indices(self)
 
     def _submit_next(self) -> bool:
         nxt = self._next_indices()

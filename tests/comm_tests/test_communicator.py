@@ -135,6 +135,65 @@ def test_obj_plane_single_process(devices):
     assert comm.recv_obj(source=comm.rank) == "hi"
 
 
+def test_obj_plane_interleaved_senders(devices):
+    """Messages demux on the exact (source, dest) pair: two senders feeding
+    one destination can't cross-deliver, and per-pair order is FIFO."""
+    comm = make_comm("xla", devices)
+    comm.send_obj("from-1-a", dest=5, source=1)
+    comm.send_obj("from-3", dest=5, source=3)
+    comm.send_obj("from-1-b", dest=5, source=1)
+    comm.send_obj("other-dest", dest=6, source=1)
+    assert comm.recv_obj(source=3, dest=5) == "from-3"
+    assert comm.recv_obj(source=1, dest=5) == "from-1-a"
+    assert comm.recv_obj(source=1, dest=5) == "from-1-b"
+    assert comm.recv_obj(source=1, dest=6) == "other-dest"
+
+
+def test_obj_plane_recv_blocks_with_timeout(devices):
+    """recv_obj is MPI-recv-like: blocks, raises TimeoutError when nothing
+    arrives (not queue.Empty the instant the queue is empty)."""
+    import threading
+    import time as _time
+
+    comm = make_comm("xla", devices)
+    with pytest.raises(TimeoutError):
+        comm.recv_obj(source=2, dest=4, timeout=0.1)
+
+    def late_send():
+        _time.sleep(0.15)
+        comm.send_obj("late", dest=4, source=2)
+
+    t = threading.Thread(target=late_send)
+    t.start()
+    assert comm.recv_obj(source=2, dest=4, timeout=5.0) == "late"
+    t.join()
+
+
+def test_obj_plane_rank_range_checked(devices):
+    comm = make_comm("xla", devices)
+    with pytest.raises(ValueError):
+        comm.send_obj("x", dest=8)
+    with pytest.raises(ValueError):
+        comm.recv_obj(source=-1)
+
+
+def test_topology_maps(devices):
+    """Honest rank bookkeeping: exact per-rank process/intra/inter maps."""
+    comm = make_comm("xla", devices)
+    topo = comm._topo
+    assert topo.size == 8
+    # Single process owns every rank.
+    assert topo.proc_of_rank == (0,) * 8
+    assert topo.procs == (0,)
+    for r in range(8):
+        assert topo.proc_of(r) == 0
+        assert topo.inter_rank_of(r) == 0
+        assert topo.intra_rank_of(r) == r
+    assert topo.ranks_of_proc(0) == tuple(range(8))
+    # Scalar properties describe this process: first owned rank.
+    assert comm.rank == 0 and comm.intra_rank == 0 and comm.inter_rank == 0
+
+
 def test_split(devices):
     comm = make_comm("xla", devices)
     colors = [r % 2 for r in range(8)]

@@ -1,0 +1,149 @@
+"""Worker body for the real 2-OS-process integration test.
+
+The reference ran every distributed test as a *real multi-process run*
+(``mpiexec -n 2 python -m pytest`` — SURVEY.md §4).  This is that, TPU-style:
+two OS processes, a localhost JAX coordinator (``init_distributed``), the CPU
+backend with gloo cross-process collectives, and the native TCP object plane.
+Each worker runs the same body (SPMD, like an mpiexec rank) and writes a JSON
+verdict the parent test asserts on.
+
+Launched by ``test_two_process.py`` with env:
+  CMN_COORDINATOR / CMN_NUM_PROCESSES / CMN_PROCESS_ID  — bootstrap
+  CMN_TPU_HOSTS / CMN_TPU_RANK                          — hostcomm object plane
+  CMN_TEST_OUT                                          — result file
+  CMN_TEST_TMP                                          — shared scratch dir
+"""
+
+import json
+import os
+import sys
+import traceback
+
+import numpy as np
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid}
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+
+    comm = cmn.create_communicator("flat")
+    topo = comm._topo
+    # --- honest topology: exact per-rank process map --------------------
+    assert comm.size == 2
+    assert topo.proc_of_rank == (0, 1), topo.proc_of_rank
+    assert comm.rank == pid, (comm.rank, pid)
+    assert comm.inter_rank == pid and comm.inter_size == 2
+    out["topology"] = "ok"
+
+    # --- object plane collectives (the process_count>1 branches) --------
+    got = comm.bcast_obj({"payload": [1, 2, 3], "from": "p0"}, root=0)
+    assert got == {"payload": [1, 2, 3], "from": "p0"}, got
+    gathered = comm.allgather_obj(("proc", pid))
+    assert gathered == [("proc", 0), ("proc", 1)], gathered
+    g = comm.gather_obj(pid * 10, root=0)
+    if pid == 0:
+        assert g == [0, 10], g
+    else:
+        assert g is None, g
+    red = comm.allreduce_obj({"loss": float(pid + 1)}, op="mean")
+    assert abs(red["loss"] - 1.5) < 1e-9, red
+    out["obj_collectives"] = "ok"
+
+    # --- rank-addressed p2p over the native TCP transport ---------------
+    other = 1 - pid
+    comm.send_obj({"hello_from": pid, "n": 1}, dest=other)
+    comm.send_obj({"hello_from": pid, "n": 2}, dest=other)
+    m1 = comm.recv_obj(source=other, timeout=30.0)
+    m2 = comm.recv_obj(source=other, timeout=30.0)
+    assert m1 == {"hello_from": other, "n": 1}, m1
+    assert m2 == {"hello_from": other, "n": 2}, m2
+    out["p2p"] = "ok"
+
+    # --- data plane across processes: eager rankwise allreduce ----------
+    local_row = np.full((1, 3), float(pid + 1), np.float32)  # my rank's row
+    summed = comm.allreduce(comm.shard_rankwise(local_row), op="sum")
+    mine = np.asarray(
+        [s.data for s in summed.addressable_shards][0]
+    )
+    np.testing.assert_allclose(mine, np.full((1, 3), 3.0))
+    out["eager_allreduce"] = "ok"
+
+    # --- in-graph train-step-style psum over the 2-process mesh ---------
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        return comm.psum(x)
+
+    step = jax.jit(
+        comm.spmd(body, in_specs=P(comm.axes), out_specs=P(comm.axes))
+    )
+    res = step(comm.shard_rankwise(np.float32([[pid + 1.0]])))
+    got = float(np.asarray([s.data for s in res.addressable_shards][0])[0, 0])
+    assert got == 3.0, got
+    out["in_graph_psum"] = "ok"
+
+    # --- scatter_dataset shards by process, disjoint and complete -------
+    ds = cmn.datasets.ArrayDataset(np.arange(20, dtype=np.int64))
+    shard = cmn.scatter_dataset(ds, comm, shuffle=True, seed=11)
+    my_items = [int(shard[i][0]) for i in range(len(shard))]
+    assert len(my_items) == 10
+    both = comm.allgather_obj(my_items)
+    union = sorted(both[0] + both[1])
+    assert union == list(range(20)), union
+    out["scatter_dataset"] = "ok"
+
+    # --- checkpoint save/restore with cross-host atomicity --------------
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+
+    ckdir = os.path.join(os.environ["CMN_TEST_TMP"], "ck")
+    state = {
+        "w": comm.replicate(np.arange(6, dtype=np.float32).reshape(2, 3)),
+        "step": comm.replicate(np.int64(7)),
+    }
+    cp = create_multi_node_checkpointer("two_proc", comm, path=ckdir)
+
+    class _T:  # minimal trainer-shaped object for save()
+        iteration = 7
+        state = None
+        train_iter = None
+        extensions = ()
+
+    cp.save(state, _T())
+    cp.finalize()
+    assert cp.all_steps() == [7], cp.all_steps()
+    blank = {
+        "w": comm.replicate(np.zeros((2, 3), np.float32)),
+        "step": comm.replicate(np.int64(0)),
+    }
+    restored, it = cp.maybe_load(blank)
+    assert it == 7
+    np.testing.assert_allclose(
+        np.asarray(restored["w"]), np.arange(6, dtype=np.float32).reshape(2, 3)
+    )
+    cp.close()
+    out["checkpoint"] = "ok"
+
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    result_path = os.environ["CMN_TEST_OUT"]
+    try:
+        verdict = main()
+    except BaseException:
+        verdict = {"status": "fail", "traceback": traceback.format_exc()}
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)
