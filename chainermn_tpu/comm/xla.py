@@ -394,8 +394,29 @@ class XlaCommunicator(CommunicatorBase):
         return jax.process_count()
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
-        if self._nproc == 1:
+        procs = self._topo.procs
+        if self._nproc == 1 or len(procs) == 1:
+            # Single process, or a group living entirely on this process
+            # (e.g. ``sub("intra")`` on a pod host) — identity.
             return obj
+        if len(procs) < self._nproc:
+            # Group spans a strict SUBSET of processes (e.g. ``sub``/``split``
+            # over one replica of a 3-level mesh).  multihost_utils spans ALL
+            # processes and would elect one source per group — wrong; fan out
+            # over the rank-addressed p2p plane inside the group instead.
+            # (Groups partition processes, so cross-group frames can't mix.)
+            me = jax.process_index()
+            root_proc = self._topo.proc_of(root)
+            if me == root_proc:
+                for p in procs:
+                    if p != me:
+                        self.send_obj(
+                            obj,
+                            dest=self._topo.ranks_of_proc(p)[0],
+                            source=root,
+                        )
+                return obj
+            return self.recv_obj(source=root, dest=self.rank, timeout=120.0)
         from jax.experimental import multihost_utils
 
         is_src = jax.process_index() == self._root_proc(root)
@@ -422,8 +443,36 @@ class XlaCommunicator(CommunicatorBase):
             )
 
     def allgather_obj(self, obj: Any) -> List[Any]:
-        if self._nproc == 1:
-            return [obj] * max(jax.process_count(), 1)
+        """One object per participating *process*, in ``Topology.procs``
+        order (the reference gathered per MPMD rank = per process)."""
+        procs = self._topo.procs
+        if self._nproc == 1 or len(procs) == 1:
+            return [obj]
+        if len(procs) < self._nproc:
+            # Subset group: linear gather to the group's first process over
+            # the rank-addressed p2p plane, then group-internal bcast.
+            me = jax.process_index()
+            root_proc = procs[0]
+            root_rank = self._topo.ranks_of_proc(root_proc)[0]
+            if me == root_proc:
+                objs = [obj]
+                for p in procs[1:]:
+                    objs.append(
+                        self.recv_obj(
+                            source=self._topo.ranks_of_proc(p)[0],
+                            dest=root_rank,
+                            timeout=120.0,
+                        )
+                    )
+                for p in procs[1:]:
+                    self.send_obj(
+                        objs,
+                        dest=self._topo.ranks_of_proc(p)[0],
+                        source=root_rank,
+                    )
+                return objs
+            self.send_obj(obj, dest=root_rank, source=self.rank)
+            return self.recv_obj(source=root_rank, dest=self.rank, timeout=120.0)
         from jax.experimental import multihost_utils
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)

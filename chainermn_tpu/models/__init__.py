@@ -6,6 +6,7 @@ from chainermn_tpu.models.mlp import MLP, classification_loss, classification_me
 from chainermn_tpu.models.resnet import (
     ResNet,
     ResNet18,
+    ResNetTiny,
     ResNet50,
     resnet_loss,
 )
@@ -26,6 +27,7 @@ __all__ = [
     "classification_metrics",
     "ResNet",
     "ResNet18",
+    "ResNetTiny",
     "ResNet50",
     "resnet_loss",
     "Seq2Seq",

@@ -34,9 +34,11 @@ def respect_jax_platforms_env() -> None:
         pass
     jax.config.update("jax_platforms", want)
     try:
-        import jax.extend.backend
+        # NB: ``import jax.extend.backend`` here would shadow the module-level
+        # ``jax`` binding for this whole function scope — use a from-import.
+        from jax.extend import backend as _backend
 
-        jax.extend.backend.clear_backends()
+        _backend.clear_backends()
     except Exception:
         pass
 

@@ -8,12 +8,12 @@ import jax
 import jax.numpy as jnp
 
 import chainermn_tpu as cmn
-from chainermn_tpu.models import ResNet18, resnet_loss
+from chainermn_tpu.models import ResNetTiny, resnet_loss
 
 
 def test_resnet_forward_shapes(devices):
     comm = cmn.create_communicator("xla", devices=devices)
-    model = ResNet18(num_classes=10, width=8, axis_name=comm.axis_name)
+    model = ResNetTiny(num_classes=10, width=8, axis_name=comm.axis_name)
     x = np.zeros((8, 32, 32, 3), np.float32)
     variables = model.init(jax.random.PRNGKey(0), x, train=True)
     logits = model.apply(variables, x, train=False)
@@ -23,7 +23,7 @@ def test_resnet_forward_shapes(devices):
 
 def test_resnet_dp_training_stateful(devices):
     comm = cmn.create_communicator("xla", devices=devices)
-    model = ResNet18(num_classes=4, width=8, axis_name=comm.axis_name)
+    model = ResNetTiny(num_classes=4, width=8, axis_name=comm.axis_name)
     x0 = np.zeros((8, 16, 16, 3), np.float32)
     variables = model.init(jax.random.PRNGKey(0), x0, train=True)
     opt = cmn.create_multi_node_optimizer(optax.sgd(0.05, momentum=0.9), comm)
@@ -51,7 +51,7 @@ def test_resnet_dp_training_stateful(devices):
 
 
 def test_resnet_bf16_compute_path(devices):
-    model = ResNet18(num_classes=4, width=8, dtype=jnp.bfloat16)
+    model = ResNetTiny(num_classes=4, width=8, dtype=jnp.bfloat16)
     x = np.zeros((8, 16, 16, 3), np.float32)
     variables = model.init(jax.random.PRNGKey(0), x, train=True)
     # params stay fp32 (mixed precision) ...
