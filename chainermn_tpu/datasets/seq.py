@@ -32,11 +32,18 @@ def bucket_batches(
     max_len: int = 64,
     seed: int = 0,
     drop_incomplete: bool = True,
+    keep_tail: bool = False,
 ) -> List[Tuple[np.ndarray, np.ndarray]]:
     """Group (src, tgt) token-id pairs into length buckets; return a list of
     ``(src_batch, tgt_batch)`` int32 arrays, each padded to its bucket
     ceiling.  Non-pad fraction stays ≥ (width-1)/width per bucket by
-    construction (the BASELINE.md "no pathological padding" target)."""
+    construction (the BASELINE.md "no pathological padding" target).
+
+    Tail policy for a bucket's final short chunk: drop it
+    (``drop_incomplete=True``, training default — keeps one compiled shape),
+    wrap-fill with duplicates (``drop_incomplete=False``), or emit it short
+    (``keep_tail=True``, overrides both) for evaluation flows whose masking
+    must see each sentence exactly once (corpus BLEU)."""
     rng = np.random.RandomState(seed)
     buckets: dict = {}
     for s, t in pairs:
@@ -52,7 +59,7 @@ def bucket_batches(
         order = rng.permutation(len(items))
         for i in range(0, len(items), batch_size):
             chunk = [items[j] for j in order[i : i + batch_size]]
-            if len(chunk) < batch_size:
+            if len(chunk) < batch_size and not keep_tail:
                 if drop_incomplete:
                     continue
                 # cyclic wrap-fill so even buckets smaller than batch_size
@@ -75,13 +82,16 @@ def make_synthetic_translation(
     seed: int = 0,
 ) -> List[Tuple[List[int], List[int]]]:
     """Deterministic learnable "translation": target = reversed source with a
-    +3 vocab shift (PAD/BOS/EOS reserved).  Stand-in for the reference's WMT
-    data in the zero-egress environment."""
+    +3 vocab shift (PAD/BOS/EOS reserved), terminated with EOS so the decoder
+    LEARNS to stop — without a trained EOS, greedy decoding runs to the
+    bucket ceiling with unconstrained logits and BLEU is deflated by
+    padding-length garbage.  Stand-in for the reference's WMT data in the
+    zero-egress environment."""
     rng = np.random.RandomState(seed)
     pairs = []
     for _ in range(n):
         L = rng.randint(min_len, max_len + 1)
         src = rng.randint(3, vocab, size=L).tolist()
-        tgt = [((w - 3 + 1) % (vocab - 3)) + 3 for w in reversed(src)]
+        tgt = [((w - 3 + 1) % (vocab - 3)) + 3 for w in reversed(src)] + [EOS]
         pairs.append((src, tgt))
     return pairs

@@ -12,10 +12,18 @@ from chainermn_tpu.extensions.evaluator import (
     Evaluator,
     create_multi_node_evaluator,
 )
+from chainermn_tpu.extensions.bleu import (
+    bleu_finalize,
+    bleu_from_stats,
+    bleu_stats,
+)
 
 __all__ = [
     "Evaluator",
     "create_multi_node_evaluator",
     "MultiNodeCheckpointer",
     "create_multi_node_checkpointer",
+    "bleu_stats",
+    "bleu_from_stats",
+    "bleu_finalize",
 ]

@@ -1,0 +1,113 @@
+"""Corpus BLEU from exactly-summable per-sentence statistics.
+
+Reference anchor: the ChainerMN seq2seq example's "BLEU eval via multi-node
+evaluator" (``examples/seq2seq/seq2seq.py`` — SURVEY.md §2.9).  BLEU is a
+*corpus-level* metric: clipped n-gram match counts, n-gram totals, and
+candidate/reference lengths are summed over the whole corpus and only then
+combined through the nonlinear BLEU formula — averaging per-sentence BLEU
+(what a naive per-example evaluator would do) is a different, wrong number.
+
+Split accordingly:
+
+* :func:`bleu_stats` — traced, in-graph: per-sentence stat vectors, safe to
+  mask-sum across devices (``lax.psum``) and batches.  Fully vectorized
+  (window-comparison counting, no Python loops over tokens) so it runs inside
+  the jitted eval step.
+* :func:`bleu_from_stats` — host-side finalize on the summed stats.
+
+Used through :class:`chainermn_tpu.extensions.Evaluator`'s ``finalize``
+hook, which the multi-node wrapper sum-reduces across processes before
+finalizing — bitwise the same result as a single-process pass over the whole
+corpus.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from chainermn_tpu.datasets.seq import BOS, EOS, PAD
+
+MAX_N = 4
+
+
+def _clipped_ngram_counts(cand, cand_mask, ref, ref_mask, n):
+    """Vectorized clipped n-gram matching.
+
+    For every valid candidate window i with gram g_i: its contribution is
+    ``min(c_i, r_i) / c_i`` where c_i / r_i count occurrences of g_i among
+    valid candidate / reference windows — summing over the c_i instances of a
+    gram yields the standard clipped count ``min(c, r)`` per distinct gram.
+    """
+    T = cand.shape[1]
+    W = T - n + 1
+    if W <= 0:
+        B = cand.shape[0]
+        return jnp.zeros((B,), jnp.float32), jnp.zeros((B,), jnp.float32)
+    idx = jnp.arange(W)[:, None] + jnp.arange(n)[None, :]
+    cg = cand[:, idx]  # (B, W, n)
+    rg = ref[:, idx]
+    cm = cand_mask[:, idx].min(-1)  # (B, W): window fully inside the sentence
+    rm = ref_mask[:, idx].min(-1)
+    eq_cr = (cg[:, :, None, :] == rg[:, None, :, :]).all(-1)  # (B, W, W)
+    eq_cc = (cg[:, :, None, :] == cg[:, None, :, :]).all(-1)
+    r_i = (eq_cr * rm[:, None, :]).sum(-1)
+    c_i = (eq_cc * cm[:, None, :]).sum(-1)
+    contrib = jnp.where(cm > 0, jnp.minimum(c_i, r_i) / jnp.maximum(c_i, 1.0), 0.0)
+    return contrib.sum(-1), cm.sum(-1)
+
+
+def bleu_stats(pred, ref) -> Dict[str, jnp.ndarray]:
+    """Per-sentence BLEU statistics (each a float32 ``(B,)`` vector).
+
+    ``pred``: decoded token ids (B, T) — the candidate runs until its first
+    EOS/PAD/BOS.  ``ref``: PAD-padded reference ids (B, T).  Keys:
+    ``bleu_match_n`` / ``bleu_total_n`` for n = 1..4, ``bleu_cand_len``,
+    ``bleu_ref_len``.
+    """
+    pred = pred.astype(jnp.int32)
+    ref = ref.astype(jnp.int32)
+    stop = (pred == EOS) | (pred == PAD) | (pred == BOS)
+    cand_mask = jnp.cumprod(1 - stop.astype(jnp.float32), axis=1)
+    # References may carry a trained EOS terminator; BLEU compares content
+    # tokens only (the candidate is likewise truncated BEFORE its EOS).
+    ref_mask = ((ref != PAD) & (ref != EOS) & (ref != BOS)).astype(jnp.float32)
+    out = {
+        "bleu_cand_len": cand_mask.sum(-1),
+        "bleu_ref_len": ref_mask.sum(-1),
+    }
+    for n in range(1, MAX_N + 1):
+        m, t = _clipped_ngram_counts(pred, cand_mask, ref, ref_mask, n)
+        out[f"bleu_match_{n}"] = m
+        out[f"bleu_total_{n}"] = t
+    return out
+
+
+def bleu_from_stats(sums: Dict[str, float], smooth: float = 1e-9) -> float:
+    """Corpus BLEU (0..100) from summed statistics: geometric mean of the
+    clipped n-gram precisions with the brevity penalty."""
+    logs = []
+    for n in range(1, MAX_N + 1):
+        match = float(sums[f"bleu_match_{n}"])
+        total = float(sums[f"bleu_total_{n}"])
+        if total <= 0:
+            continue
+        logs.append(np.log(max(match, smooth) / total))
+    if not logs:
+        return 0.0
+    cand = max(float(sums["bleu_cand_len"]), smooth)
+    ref = float(sums["bleu_ref_len"])
+    bp = min(1.0, np.exp(1.0 - ref / cand))
+    return float(100.0 * bp * np.exp(np.mean(logs)))
+
+
+def bleu_finalize(sums: Dict[str, float], count: float) -> Dict[str, float]:
+    """``Evaluator.finalize`` hook: corpus BLEU plus the raw corpus sizes."""
+    return {
+        "bleu": bleu_from_stats(sums),
+        "bleu_cand_len": float(sums["bleu_cand_len"]),
+        "bleu_ref_len": float(sums["bleu_ref_len"]),
+        "n_sentences": float(count),
+    }

@@ -18,7 +18,7 @@ contribute.
 
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,14 +31,29 @@ from chainermn_tpu.comm.xla import XlaCommunicator
 
 class Evaluator:
     """Runs ``metric_fn(params, batch) -> {name: per-example values}`` over an
-    iterator, exactly averaging across devices and batches (mask-weighted)."""
+    iterator, exactly averaging across devices and batches (mask-weighted).
+
+    Corpus-level metrics (BLEU-style, where statistics must be SUMMED over
+    the whole corpus and only then combined nonlinearly) pass ``finalize``:
+    ``finalize(sums, count) -> {name: value}`` receives the mask-exact summed
+    stat dict instead of the default per-example mean.
+
+    Multi-host contract: every process must iterate the SAME global batch
+    stream (lockstep — same seed/order); the evaluator slices each padded
+    global batch to this process's block itself, and the in-graph
+    ``lax.psum`` over the communicator's mesh already makes every stat
+    global, so the distributed result equals a single-process pass over the
+    full corpus with no further host-side reduction.
+    """
 
     def __init__(self, iterator_factory, metric_fn: Callable,
-                 communicator: XlaCommunicator):
+                 communicator: XlaCommunicator,
+                 finalize: Optional[Callable] = None):
         # iterator_factory: callable returning a fresh non-repeating iterator
         self.iterator_factory = iterator_factory
         self.metric_fn = metric_fn
         self.comm = communicator
+        self.finalize = finalize
         self._step = None
 
     def _eval_step(self):
@@ -79,23 +94,42 @@ class Evaluator:
         )
         return jax.tree_util.tree_map(pad, batch), mask
 
-    def evaluate(self, params) -> Dict[str, float]:
+    def evaluate_stats(self, params) -> Tuple[Dict[str, float], float]:
+        """Mask-exact summed statistics + valid-example count over the
+        iterator (the raw material both the mean and finalize paths share)."""
         step = self._eval_step()
         it = self.iterator_factory()
         size = getattr(it, "batch_size", None)
         sums: Dict[str, float] = {}
         count = 0.0
+        nproc = jax.process_count()
+        pidx = jax.process_index()
         for batch in it:
             n = jax.tree_util.tree_leaves(batch)[0].shape[0]
             target = size or n
+            # Pad the GLOBAL batch to a multiple of lcm-friendly size, then
+            # take this process's contiguous block — every process sees the
+            # same global stream (lockstep) but contributes only its rows,
+            # so no sentence is counted process_count times.
             target = -(-target // self.comm.size) * self.comm.size
             batch, mask = self._pad(batch, target)
+            if nproc > 1:
+                per = target // nproc
+                blk = lambda a: a[pidx * per : (pidx + 1) * per]
+                batch = jax.tree_util.tree_map(blk, batch)
+                mask = blk(mask)
             batch = self.comm.shard_batch(batch)
             mask = self.comm.shard_batch(mask)
             m, nvalid = step(params, batch, mask)
             for k, v in m.items():
                 sums[k] = sums.get(k, 0.0) + float(v)
             count += float(nvalid)
+        return sums, count
+
+    def evaluate(self, params) -> Dict[str, float]:
+        sums, count = self.evaluate_stats(params)
+        if self.finalize is not None:
+            return self.finalize(sums, count)
         return {k: v / max(count, 1.0) for k, v in sums.items()}
 
 
@@ -105,9 +139,18 @@ class _MultiNodeEvaluator:
         self.comm = communicator
 
     def evaluate(self, *args, **kw) -> Dict[str, float]:
+        if getattr(self.actual, "finalize", None) is not None:
+            # Corpus-level metric: the eval step's in-graph lax.psum spans
+            # the communicator's whole mesh (all processes' devices), so the
+            # summed stats are ALREADY global and identical on every process
+            # — summing them again host-side would multiply every stat by
+            # process_count.  Finalize directly.
+            return self.actual.evaluate(*args, **kw)
         local = self.actual.evaluate(*args, **kw)
-        # Cross-process average (identity single-process) — reference's
-        # pickled allreduce_obj of the metric dict.
+        # Cross-process average of per-example means: identical values on
+        # every process for the same reason, so this is an identity that
+        # doubles as a cheap lockstep barrier — reference shape:
+        # ``allreduce_obj`` of the metric dict.
         return self.comm.allreduce_obj(local, op="mean")
 
     def __call__(self, *args, **kw):

@@ -68,10 +68,39 @@ def main():
                   f"token_acc {np.mean([float(a) for a in accs]):.4f}",
                   flush=True)
 
-    # sample a greedy decode (reference: BLEU eval via multi-node evaluator)
-    out = greedy_decode(model, jax.device_get(state.params), src0[:4],
-                        max_len=src0.shape[1])
+    # Corpus BLEU via the multi-node evaluator (reference: "BLEU eval via
+    # multi-node evaluator", SURVEY.md §2.9): greedy-decode inside the jitted
+    # eval step, sum the clipped n-gram stats exactly across devices/batches
+    # (and processes), finalize once.
+    from chainermn_tpu.extensions import (
+        Evaluator,
+        bleu_finalize,
+        bleu_stats,
+        create_multi_node_evaluator,
+    )
+
+    val_pairs = make_synthetic_translation(512, vocab=args.vocab, min_len=4,
+                                           max_len=16, seed=99)
+    val_batches = bucket_batches(val_pairs, args.batchsize,
+                                 bucket_width=args.bucket_width,
+                                 keep_tail=True)
+
+    def bleu_metric(params, batch):
+        src, tgt = batch
+        pred = greedy_decode(model, params, src, max_len=tgt.shape[1])
+        return bleu_stats(pred, tgt)
+
+    ev = create_multi_node_evaluator(
+        Evaluator(lambda: iter(val_batches), bleu_metric, comm,
+                  finalize=bleu_finalize),
+        comm,
+    )
+    scores = ev.evaluate(state.params)
     if jax.process_index() == 0:
+        print(f"corpus BLEU {scores['bleu']:.2f}  "
+              f"({int(scores['n_sentences'])} sentences)", flush=True)
+        out = greedy_decode(model, jax.device_get(state.params), src0[:4],
+                            max_len=src0.shape[1])
         print("sample src :", src0[0][src0[0] != 0])
         print("sample pred:", np.asarray(out[0]))
 

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# CI entry point — the shape of the reference's Travis scripts (SURVEY.md §4:
+# "CPU/naive subset with mpiexec -n 2"): the whole suite runs GPU-free on a
+# forced 8-virtual-device CPU mesh, including a REAL 2-OS-process
+# distributed run (tests/multiprocess_tests, the mpiexec analog).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# The conftest forces JAX_PLATFORMS=cpu + an 8-device host pool itself, but
+# exporting here keeps non-pytest invocations honest too.
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+python -m pytest tests/ -q "$@"

@@ -1,0 +1,144 @@
+"""Corpus BLEU oracle tests (VERDICT r1 item 8: the evaluator must cover a
+non-per-example metric).
+
+Oracle: a plain-Python Counter implementation of clipped n-gram corpus BLEU.
+The traced `bleu_stats` + masked-sum + `bleu_from_stats` pipeline — including
+batch splitting with a short tail and the multi-node evaluator wrapper —
+must reproduce it exactly (same stats, same formula)."""
+
+import collections
+import math
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.datasets.seq import EOS, PAD
+from chainermn_tpu.extensions import (
+    Evaluator,
+    bleu_finalize,
+    bleu_from_stats,
+    bleu_stats,
+    create_multi_node_evaluator,
+)
+
+
+def oracle_stats(cands, refs):
+    """Counter-based clipped n-gram statistics — the single source of truth
+    both the stat-level and score-level tests validate against."""
+    m = [0.0] * 5
+    t = [0.0] * 5
+    clen = rlen = 0
+    for c, r in zip(cands, refs):
+        clen += len(c)
+        rlen += len(r)
+        for n in range(1, 5):
+            cc = collections.Counter(
+                tuple(c[i : i + n]) for i in range(len(c) - n + 1)
+            )
+            rc = collections.Counter(
+                tuple(r[i : i + n]) for i in range(len(r) - n + 1)
+            )
+            m[n] += sum(min(v, rc[g]) for g, v in cc.items())
+            t[n] += max(len(c) - n + 1, 0)
+    return m, t, clen, rlen
+
+
+def oracle_corpus_bleu(cands, refs, smooth=1e-9):
+    m, t, clen, rlen = oracle_stats(cands, refs)
+    logs = [
+        math.log(max(m[n], smooth) / t[n]) for n in range(1, 5) if t[n] > 0
+    ]
+    if not logs:
+        return 0.0
+    bp = min(1.0, math.exp(1.0 - rlen / max(clen, smooth)))
+    return 100.0 * bp * math.exp(sum(logs) / len(logs))
+
+
+def _pad_ids(seqs, T, eos=True):
+    out = np.full((len(seqs), T), PAD, np.int32)
+    for i, s in enumerate(seqs):
+        out[i, : len(s)] = s
+        if eos and len(s) < T:
+            out[i, len(s)] = EOS
+    return out
+
+
+def _corpus(n=37, vocab=20, seed=0):
+    rng = np.random.RandomState(seed)
+    cands, refs = [], []
+    for _ in range(n):
+        lr = rng.randint(3, 12)
+        ref = rng.randint(3, vocab, size=lr).tolist()
+        # candidate: reference with random corruptions + length jitter
+        cand = [
+            (w if rng.rand() > 0.3 else int(rng.randint(3, vocab)))
+            for w in ref
+        ][: rng.randint(2, lr + 1)]
+        cands.append(cand)
+        refs.append(ref)
+    return cands, refs
+
+
+def test_perfect_match_is_100(devices):
+    refs = [[3, 4, 5, 6, 7], [8, 9, 10, 11]]
+    T = 8
+    stats = bleu_stats(_pad_ids(refs, T), _pad_ids(refs, T, eos=False))
+    sums = {k: float(np.sum(v)) for k, v in stats.items()}
+    assert abs(bleu_from_stats(sums) - 100.0) < 1e-6
+
+
+def test_disjoint_is_zero(devices):
+    cand = [[3, 4, 5, 6]]
+    ref = [[10, 11, 12, 13]]
+    stats = bleu_stats(_pad_ids(cand, 6), _pad_ids(ref, 6, eos=False))
+    sums = {k: float(np.sum(v)) for k, v in stats.items()}
+    assert bleu_from_stats(sums) < 1e-6
+
+
+def test_stats_match_counter_oracle(devices):
+    cands, refs = _corpus()
+    T = 14
+    stats = bleu_stats(_pad_ids(cands, T), _pad_ids(refs, T, eos=False))
+    sums = {k: float(np.sum(v)) for k, v in stats.items()}
+    # Stat-level agreement (stronger than the final score agreeing).
+    m, t, _, _ = oracle_stats(cands, refs)
+    for n in range(1, 5):
+        np.testing.assert_allclose(sums[f"bleu_match_{n}"], m[n], atol=1e-4)
+        np.testing.assert_allclose(sums[f"bleu_total_{n}"], t[n], atol=1e-4)
+    np.testing.assert_allclose(
+        bleu_from_stats(sums), oracle_corpus_bleu(cands, refs), rtol=1e-6
+    )
+
+
+def test_evaluator_aggregates_corpus_bleu_exactly(devices):
+    """Batched + short-tail + multi-node-wrapped evaluation == one-shot
+    oracle over the whole corpus (sum-then-finalize, not mean-of-BLEUs)."""
+    cands, refs = _corpus(n=53, seed=7)
+    T = 14
+    pred_arr = _pad_ids(cands, T)
+    ref_arr = _pad_ids(refs, T, eos=False)
+    bs = 16  # 53 = 3*16 + 5 → exercises the masked partial tail
+
+    def batches():
+        for i in range(0, len(cands), bs):
+            yield (pred_arr[i : i + bs], ref_arr[i : i + bs])
+
+    comm = cmn.create_communicator("xla")
+
+    def metric_fn(params, batch):
+        pred, ref = batch
+        return bleu_stats(pred, ref)
+
+    ev = create_multi_node_evaluator(
+        Evaluator(batches, metric_fn, comm, finalize=bleu_finalize), comm
+    )
+    scores = ev.evaluate(params={})
+    oracle = oracle_corpus_bleu(cands, refs)
+    np.testing.assert_allclose(scores["bleu"], oracle, rtol=1e-6)
+    assert scores["n_sentences"] == len(cands)
+    # Mean-of-per-sentence-BLEU is a DIFFERENT number — guard the distinction.
+    per_sentence = np.mean(
+        [oracle_corpus_bleu([c], [r]) for c, r in zip(cands, refs)]
+    )
+    assert abs(per_sentence - oracle) > 0.5

@@ -103,5 +103,6 @@ def test_two_process_integration(tmp_path):
             "in_graph_psum",
             "scatter_dataset",
             "checkpoint",
+            "corpus_evaluator",
         ):
             assert v.get(key) == "ok", (pid, key, v)

@@ -132,6 +132,43 @@ def main() -> dict:
     cp.close()
     out["checkpoint"] = "ok"
 
+    # --- corpus-metric evaluator: no per-process double counting ---------
+    # Both processes iterate the SAME global stream (lockstep contract); the
+    # evaluator slices per-process blocks and the in-graph psum makes stats
+    # global — n_sentences must equal the corpus size, not 2x it.
+    from chainermn_tpu.extensions import (
+        Evaluator,
+        bleu_finalize,
+        bleu_stats,
+        create_multi_node_evaluator,
+    )
+
+    rng = np.random.RandomState(5)
+    n_sent, T = 12, 8
+    refs = np.full((n_sent, T), 0, np.int32)
+    for i in range(n_sent):
+        L = rng.randint(3, 7)
+        refs[i, :L] = rng.randint(3, 20, size=L)
+    preds = refs.copy()  # perfect candidates → BLEU 100
+
+    def batches():
+        for i in range(0, n_sent, 4):
+            yield (preds[i : i + 4], refs[i : i + 4])
+
+    ev = create_multi_node_evaluator(
+        Evaluator(
+            batches,
+            lambda params, b: bleu_stats(b[0], b[1]),
+            comm,
+            finalize=bleu_finalize,
+        ),
+        comm,
+    )
+    scores = ev.evaluate(params={})
+    assert abs(scores["bleu"] - 100.0) < 1e-6, scores
+    assert scores["n_sentences"] == n_sent, scores
+    out["corpus_evaluator"] = "ok"
+
     comm.barrier()
     cmn.shutdown_distributed()
     out["status"] = "ok"
