@@ -48,7 +48,13 @@ def bucket_batches(
     buckets: dict = {}
     for s, t in pairs:
         if len(s) > max_len or len(t) > max_len:
-            s, t = s[:max_len], t[:max_len]
+            trunc_t = list(t[:max_len])
+            # Truncation must not strip a trained EOS terminator — losing it
+            # reintroduces the untrained-termination/deflated-BLEU failure
+            # (see make_synthetic_translation).
+            if len(t) > max_len and t[-1] == EOS:
+                trunc_t[-1] = EOS
+            s, t = s[:max_len], trunc_t
         key = (
             -(-max(len(s), 1) // bucket_width) * bucket_width,
             -(-max(len(t), 1) // bucket_width) * bucket_width,

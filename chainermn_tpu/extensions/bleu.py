@@ -67,6 +67,14 @@ def bleu_stats(pred, ref) -> Dict[str, jnp.ndarray]:
     ``bleu_match_n`` / ``bleu_total_n`` for n = 1..4, ``bleu_cand_len``,
     ``bleu_ref_len``.
     """
+    if pred.shape != ref.shape:
+        # The shared window index is built from pred's width; JAX clamps
+        # out-of-bounds gathers silently, which would fabricate (or drop)
+        # reference n-grams instead of erroring — pad both to one width.
+        raise ValueError(
+            f"pred {pred.shape} and ref {ref.shape} must be padded to the "
+            "same shape"
+        )
     pred = pred.astype(jnp.int32)
     ref = ref.astype(jnp.int32)
     stop = (pred == EOS) | (pred == PAD) | (pred == BOS)

@@ -114,6 +114,15 @@ class Evaluator:
             target = -(-target // self.comm.size) * self.comm.size
             batch, mask = self._pad(batch, target)
             if nproc > 1:
+                # The block split needs ranks spread evenly over processes;
+                # a sub-communicator smaller than the process count would
+                # silently drop rows — refuse instead.  (size % nproc == 0
+                # also makes target, a multiple of size, divide by nproc.)
+                if self.comm.size % nproc != 0:
+                    raise ValueError(
+                        f"evaluator communicator size {self.comm.size} must "
+                        f"be a multiple of process_count {nproc}"
+                    )
                 per = target // nproc
                 blk = lambda a: a[pidx * per : (pidx + 1) * per]
                 batch = jax.tree_util.tree_map(blk, batch)

@@ -11,6 +11,14 @@ from chainermn_tpu.models.resnet import (
     resnet_loss,
 )
 from chainermn_tpu.models.seq2seq import Seq2Seq, greedy_decode, seq2seq_loss
+from chainermn_tpu.models.vgg import (
+    VGGHead,
+    VGGStage,
+    apply_sequential,
+    build_chain,
+    init_stage_params,
+    vgg_stage_modules,
+)
 from chainermn_tpu.models.transformer import (
     ParallelLM,
     ParallelLMConfig,
@@ -30,6 +38,12 @@ __all__ = [
     "ResNetTiny",
     "ResNet50",
     "resnet_loss",
+    "VGGStage",
+    "VGGHead",
+    "vgg_stage_modules",
+    "init_stage_params",
+    "apply_sequential",
+    "build_chain",
     "Seq2Seq",
     "seq2seq_loss",
     "greedy_decode",

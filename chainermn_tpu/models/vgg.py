@@ -1,0 +1,134 @@
+"""VGG — the model-parallel example family.
+
+Reference anchor: the ChainerMN model-parallel example models
+(``examples/mnist/train_mnist_model_parallel.py`` splits an MLP;
+the parallel-convnet/VGG variant splits conv blocks across ranks —
+SURVEY.md §2.9).  BASELINE.md tracks "model-parallel VGG via
+MultiNodeChainList analog: correctness vs single-device run — exact".
+
+Design: the network is a flat list of ops (conv/pool/head) partitioned into
+contiguous *stages*; each stage is a flax module.  The same stage modules
+compose into the single-device oracle (:func:`apply_sequential`) and into a
+:class:`~chainermn_tpu.links.MultiNodeChainList` placement (one stage per
+rank, ``ppermute`` edges), so distributed-vs-oracle comparisons share
+parameters exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: op lists: ("conv", width) | ("pool", 0); the classifier head is appended
+#: automatically as its own op.
+VGG_CFGS = {
+    "vgg11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+              512, 512, "M"],
+    "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+              "M", 512, 512, 512, "M"],
+}
+
+
+class VGGStage(nn.Module):
+    """A contiguous run of conv/relu/pool ops (one pipeline stage)."""
+
+    ops: Tuple[Tuple[str, int], ...]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for kind, w in self.ops:
+            if kind == "conv":
+                x = nn.Conv(w, (3, 3), padding="SAME", dtype=self.dtype,
+                            param_dtype=jnp.float32)(x)
+                x = nn.relu(x)
+            elif kind == "pool":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                raise ValueError(kind)
+        return x
+
+
+class VGGHead(nn.Module):
+    """Global-pool + MLP classifier (the dense tail)."""
+
+    num_classes: int
+    hidden: int = 512
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = jnp.mean(x, axis=(1, 2))  # global average pool (TPU-friendly
+        # vs the reference-era 7x7 flatten: no huge dense layer)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def vgg_stage_modules(
+    cfg: str | Sequence = "vgg11",
+    num_classes: int = 10,
+    n_stages: int = 4,
+    width_mult: float = 1.0,
+    dtype: Any = jnp.float32,
+) -> List[nn.Module]:
+    """Partition a VGG config into ``n_stages`` stage modules (+ head fused
+    into the last stage's successor): returns ``n_stages`` modules whose
+    sequential composition is the full network."""
+    ops_cfg = VGG_CFGS[cfg] if isinstance(cfg, str) else list(cfg)
+    ops: List[Tuple[str, int]] = []
+    for w in ops_cfg:
+        if w == "M":
+            ops.append(("pool", 0))
+        else:
+            ops.append(("conv", max(int(w * width_mult), 1)))
+    if n_stages < 2:
+        raise ValueError("need at least 2 stages (conv stages + head)")
+    conv_stages = n_stages - 1
+    chunks = np.array_split(np.arange(len(ops)), conv_stages)
+    modules: List[nn.Module] = []
+    for c in chunks:
+        modules.append(VGGStage(tuple(ops[i] for i in c), dtype=dtype))
+    modules.append(VGGHead(num_classes, dtype=dtype))
+    return modules
+
+
+def init_stage_params(modules: Sequence[nn.Module], rng, x) -> List[Any]:
+    """Initialize each stage against the activation shape flowing into it."""
+    params = []
+    for i, m in enumerate(modules):
+        key = jax.random.fold_in(rng, i)
+        variables = m.init(key, x)
+        params.append(variables["params"])
+        x = m.apply({"params": variables["params"]}, x)
+    return params
+
+
+def apply_sequential(modules: Sequence[nn.Module], params: Sequence[Any], x):
+    """Single-device oracle: the stages applied back-to-back."""
+    for m, p in zip(modules, params):
+        x = m.apply({"params": p}, x)
+    return x
+
+
+def build_chain(modules: Sequence[nn.Module], comm):
+    """Place stage ``s`` on rank ``s`` of ``comm`` via MultiNodeChainList
+    (reference: ``add_link(link, rank_in, rank_out)`` chains)."""
+    from chainermn_tpu.links import MultiNodeChainList
+
+    S = len(modules)
+    if S > comm.size:
+        raise ValueError(f"{S} stages > {comm.size} ranks")
+    chain = MultiNodeChainList(comm)
+    for s, m in enumerate(modules):
+        chain.add_link(
+            (lambda mod: lambda p, x: mod.apply({"params": p}, x))(m),
+            rank=s,
+            rank_in=s - 1 if s > 0 else None,
+            rank_out=s + 1 if s < S - 1 else None,
+        )
+    return chain

@@ -20,7 +20,9 @@ def main():
     p.add_argument("--vocab", type=int, default=50)
     p.add_argument("--embed", type=int, default=64)
     p.add_argument("--hidden", type=int, default=128)
-    p.add_argument("--bucket-width", type=int, default=8)
+    # width 4 keeps non-pad fraction ≥ 0.85 on the synthetic task (the
+    # BASELINE.md "> 80% non-pad tokens" target) at ~the same batch count.
+    p.add_argument("--bucket-width", type=int, default=4)
     p.add_argument("--force-cpu", action="store_true")
     args = p.parse_args()
 
