@@ -207,6 +207,16 @@ def main():
     final_loss = float(metrics["loss"])  # true data dependency on all steps
     dt = time.perf_counter() - t0
 
+    # Optional xprof capture of a few steady-state steps (profile artifact
+    # for the where-does-step-time-go analysis; not part of the timed loop).
+    profile_dir = os.environ.get("CMN_BENCH_PROFILE")
+    if profile_dir:
+        os.makedirs(profile_dir, exist_ok=True)
+        with jax.profiler.trace(profile_dir):
+            for _ in range(3):
+                state, metrics = step(state, batch)
+            _ = float(metrics["loss"])
+
     images_per_sec = global_batch * iters / dt
     per_chip = images_per_sec / n_dev
     step_ms = dt / iters * 1000.0
