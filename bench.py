@@ -149,11 +149,15 @@ def _aot_compile(step, state, batch):
     return compiled, flops
 
 
+def _is_oom(e: Exception) -> bool:
+    s = str(e)
+    return any(t in s for t in ("RESOURCE_EXHAUSTED", "Out of memory", "OOM"))
+
+
 def main():
     devices = jax.devices()
     n_dev = len(devices)
     platform = devices[0].platform
-    device_kind = devices[0].device_kind
     on_cpu = platform == "cpu"
     if on_cpu:
         jax.config.update("jax_cpu_enable_async_dispatch", False)
@@ -162,6 +166,35 @@ def main():
     per_chip_batch = int(
         os.environ.get("CMN_BENCH_BATCH", 8 if on_cpu else 256)
     )
+    # The driver runs this unattended at round end: if the headline batch
+    # OOMs on the chip, degrade (halving) rather than record nothing.
+    while True:
+        try:
+            _run(per_chip_batch, n_dev, platform, on_cpu)
+            return
+        except Exception as e:
+            if _is_oom(e):
+                if per_chip_batch > 16:
+                    print(
+                        f"# per-chip batch {per_chip_batch} OOM'd; retrying "
+                        f"at {per_chip_batch // 2}",
+                        file=sys.stderr,
+                    )
+                    per_chip_batch //= 2
+                    continue
+                # Floor reached: the driver contract is one JSON line —
+                # record the failure loudly rather than dying with a
+                # traceback (and no record at all).
+                _fail(
+                    f"OOM persisted down to per-chip batch {per_chip_batch} "
+                    f"on {platform}: {str(e)[:300]}"
+                )
+            raise
+
+
+def _run(per_chip_batch, n_dev, platform, on_cpu):
+    devices = jax.devices()
+    device_kind = devices[0].device_kind
     image_size = 64 if on_cpu else 224
     warmup, iters = (1, 2) if on_cpu else (5, 20)
 

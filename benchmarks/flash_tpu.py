@@ -22,24 +22,6 @@ import json
 import time
 
 
-def xla_attention(q, k, v, causal):
-    import jax.numpy as jnp
-    import math
-
-    B, T, H, D = q.shape
-    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
-    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
-    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / math.sqrt(D)
-    if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask, s, -1e30)
-    p = jnp.exp(s - s.max(-1, keepdims=True))
-    p = p / p.sum(-1, keepdims=True)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
-    return o.transpose(0, 2, 1, 3).astype(q.dtype)
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=4)
@@ -47,7 +29,8 @@ def main():
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--dtype", default="bfloat16")
-    ap.add_argument("--causal", action="store_true", default=True)
+    ap.add_argument("--causal", action=argparse.BooleanOptionalAction,
+                    default=True)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--blocks", default="128x128,256x256,128x512,512x128,256x512")
     ap.add_argument("--interpret-smoke", action="store_true")
@@ -62,7 +45,7 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from chainermn_tpu.ops import flash_attention
+    from chainermn_tpu.ops import flash_attention, reference_attention
     from chainermn_tpu.utils import sync
 
     platform = jax.devices()[0].platform
@@ -103,7 +86,7 @@ def main():
         )
 
     def xla_loss(q, k, v):
-        return jnp.sum(xla_attention(q, k, v, args.causal).astype(jnp.float32) ** 2)
+        return jnp.sum(reference_attention(q, k, v, args.causal).astype(jnp.float32) ** 2)
 
     gf = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)), static_argnums=(3, 4))
     gx = jax.jit(jax.grad(xla_loss, argnums=(0, 1, 2)))
@@ -113,7 +96,7 @@ def main():
             interpret=interpret,
         )
     )(q, k, v)
-    o_x = jax.jit(lambda q, k, v: xla_attention(q, k, v, args.causal))(q, k, v)
+    o_x = jax.jit(lambda q, k, v: reference_attention(q, k, v, args.causal))(q, k, v)
     fwd_err = float(
         jnp.max(jnp.abs(o_f.astype(jnp.float32) - o_x.astype(jnp.float32)))
     )
@@ -125,8 +108,16 @@ def main():
     )
     out["fwd_max_abs_err_vs_xla"] = fwd_err
     out["bwd_max_abs_err_vs_xla"] = bwd_err
-    tol = 0.05 if dtype == jnp.bfloat16 else 2e-3  # scaled by sum-of-squares grads
-    out["numerics_ok"] = bool(fwd_err < tol)
+    # Gate on BOTH directions — a Mosaic-compiled backward with wrong
+    # dq/dk/dv is exactly the failure this harness exists to catch.  The
+    # grads of sum(o²) scale with values ~O(1)·T-ish accumulations, so the
+    # bwd tolerance is relative to the oracle grad magnitude.
+    g_scale = max(
+        float(jnp.max(jnp.abs(g.astype(jnp.float32)))) for g in g_x
+    )
+    fwd_tol = 0.05 if dtype == jnp.bfloat16 else 2e-3
+    bwd_tol = (0.05 if dtype == jnp.bfloat16 else 2e-3) * max(g_scale, 1.0)
+    out["numerics_ok"] = bool(fwd_err < fwd_tol and bwd_err < bwd_tol)
 
     def bench(fn, *a):
         fn(*a)  # compile
@@ -138,7 +129,7 @@ def main():
         return (time.perf_counter() - t0) / args.iters * 1000.0
 
     # ---- XLA baseline ----------------------------------------------------
-    xla_fwd_ms = bench(jax.jit(lambda q, k, v: xla_attention(q, k, v, args.causal)), q, k, v)
+    xla_fwd_ms = bench(jax.jit(lambda q, k, v: reference_attention(q, k, v, args.causal)), q, k, v)
     xla_bwd_ms = bench(gx, q, k, v)
     out["xla_fwd_ms"] = round(xla_fwd_ms, 3)
     out["xla_fwdbwd_ms"] = round(xla_bwd_ms, 3)

@@ -40,6 +40,25 @@ def _use_interpret() -> bool:
         return True
 
 
+def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
+    """Plain-XLA softmax attention over ``(B, T, H, D)`` — the single
+    correctness oracle every flash test/benchmark compares against (one
+    implementation, so the CPU interpret tests and the on-chip harness can
+    never validate against diverging references).  Computed in fp32, cast
+    back to the input dtype."""
+    B, T, H, D = q.shape
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / math.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return o.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 # --------------------------------------------------------------------- fwd
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
     # q_ref: (1, BQ, D); k/v_ref: (1, T, D); o_ref: (1, BQ, D); lse: (1, BQ)

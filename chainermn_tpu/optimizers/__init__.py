@@ -83,8 +83,17 @@ class MultiNodeOptimizer:
             params = self.comm.replicate(params)
             if model_state is not None:
                 model_state = self.comm.replicate(model_state)
+        # Pending grads carry in the WIRE dtype when one is set: the
+        # reference's fp16 pipeline likewise kept reduced grads in fp16, and
+        # the half-width carry halves the extra state the dbuf mode streams
+        # through HBM every step.
+        wire = getattr(self.comm, "allreduce_grad_dtype", None)
         pending = (
-            jax.tree_util.tree_map(jnp.zeros_like, params)
+            # zeros_like keeps each leaf's (replicated) sharding — a plain
+            # jnp.zeros would come up process-local and break multi-host.
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=wire or p.dtype), params
+            )
             if self.double_buffering
             else None
         )
@@ -145,9 +154,18 @@ class MultiNodeOptimizer:
             if dbuf:
                 # 1-step-stale semantics: apply the PREVIOUS reduced grads,
                 # carry the fresh ones (reference: _DoubleBufferingOptimizer
-                # swap/update logic).
-                apply_grads = state.pending_grads
-                pending = grads
+                # swap/update logic).  The carry lives in the wire dtype;
+                # cast per-leaf at the boundary.
+                apply_grads = jax.tree_util.tree_map(
+                    lambda p, g: g.astype(p.dtype),
+                    state.params,
+                    state.pending_grads,
+                )
+                pending = jax.tree_util.tree_map(
+                    lambda s, g: g.astype(s.dtype),
+                    state.pending_grads,
+                    grads,
+                )
             else:
                 apply_grads = grads
                 pending = state.pending_grads

@@ -13,26 +13,35 @@ this conftest.
 
 import os
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+#: Escape hatch for real-hardware tests (tests/ops_tests/test_flash_tpu.py):
+#: CMN_TESTS_TPU=1 leaves the platform alone so the TPU-gated module can
+#: actually see the chip — everything else in the suite still passes there
+#: only if the chip-backed mesh behaves like the CPU simulation.
+_USE_TPU = os.environ.get("CMN_TESTS_TPU") == "1"
+
+if not _USE_TPU:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-# In-process CPU collectives deadlock when async dispatch lets several
-# programs' collectives interleave across the 8 virtual devices (thread-pool
-# starvation in the rendezvous) — run the CPU simulation synchronously.
-jax.config.update("jax_cpu_enable_async_dispatch", False)
-try:
-    import jax.extend.backend
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    # In-process CPU collectives deadlock when async dispatch lets several
+    # programs' collectives interleave across the 8 virtual devices
+    # (thread-pool starvation in the rendezvous) — run the CPU simulation
+    # synchronously.
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+    try:
+        import jax.extend.backend
 
-    jax.extend.backend.clear_backends()
-except Exception:  # pragma: no cover - best effort; devices check will catch it
-    pass
+        jax.extend.backend.clear_backends()
+    except Exception:  # pragma: no cover - devices fixture will catch it
+        pass
 
 import pytest  # noqa: E402
 

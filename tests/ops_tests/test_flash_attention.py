@@ -9,17 +9,13 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from chainermn_tpu.ops import flash_attention
+from chainermn_tpu.ops import flash_attention, reference_attention
 
 
 def _oracle(q, k, v, causal):
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    if causal:
-        T = q.shape[1]
-        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # Thin alias of the shared fp32 oracle (single source of truth for every
+    # flash test/benchmark; see chainermn_tpu.ops.reference_attention).
+    return reference_attention(q, k, v, causal)
 
 
 def _qkv(rng, B=2, T=128, H=2, D=32):
