@@ -7,6 +7,10 @@ has an XLA fallback so the package stays portable (CPU tests run the same
 code in interpret mode).
 """
 
-from chainermn_tpu.ops.flash_attention import flash_attention, reference_attention
+from chainermn_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_lse,
+    reference_attention,
+)
 
-__all__ = ["flash_attention", "reference_attention"]
+__all__ = ["flash_attention", "flash_attention_lse", "reference_attention"]

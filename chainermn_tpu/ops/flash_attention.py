@@ -252,12 +252,18 @@ def _bwd_dq_kernel(
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, interpret, residuals, g):
+def _bwd(causal, block_q, block_k, interpret, residuals, g, dlse=None):
+    """Shared backward.  ``dlse`` (cotangent of the logsumexp output, used by
+    the LSE-exposing API) folds into the kernels for free: ``∂lse_i/∂s_ij =
+    p_ij``, so the lse cotangent just shifts the per-row delta —
+    ``ds = p·(dp − (delta − dlse))`` — and both kernels run unchanged."""
     q, k, v, o, lse = residuals
     do = g
     BH, T, D = q.shape
     scale = 1.0 / math.sqrt(D)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale
@@ -307,21 +313,61 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
 
 # --------------------------------------------------------------------- api
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return o
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
+    return _fwd(q, k, v, causal, block_q, block_k, interpret)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
     o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    return _bwd(causal, block_q, block_k, interpret, residuals, g)
+def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, g):
+    do, dlse = g
+    return _bwd(causal, block_q, block_k, interpret, residuals, do, dlse=dlse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    ``(B, H, T)`` — the merge state for blockwise/ring composition: two
+    attention results over disjoint key sets combine exactly as
+
+        ``lse = logaddexp(lse₁, lse₂);  o = (o₁·e^{lse₁−lse} + o₂·e^{lse₂−lse})``
+
+    (see :func:`chainermn_tpu.parallel.ring_attention.ring_flash_self_attention`).
+    Differentiable in both outputs."""
+    B, T, H, D = q.shape
+    if interpret is None:
+        interpret = _use_interpret()
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(
+            f"seq len {T} must be a multiple of block sizes "
+            f"({block_q}, {block_k})"
+        )
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    o, lse = _flash_lse(
+        to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k, interpret
+    )
+    return (
+        o.reshape(B, H, T, D).transpose(0, 2, 1, 3),
+        lse.reshape(B, H, T),
+    )
 
 
 def flash_attention(
@@ -338,22 +384,11 @@ def flash_attention(
     Requires ``seq % block == 0`` (pad upstream; the data layer's bucketing
     keeps XLA-friendly static shapes anyway).  Differentiable via the flash
     backward.  ``interpret=None`` auto-selects interpret mode off-TPU.
-    """
-    B, T, H, D = q.shape
-    if interpret is None:
-        interpret = _use_interpret()
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(
-            f"seq len {T} must be a multiple of block sizes "
-            f"({block_q}, {block_k})"
-        )
 
-    def to_bh(x):  # (B, T, H, D) -> (B·H, T, D)
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-
-    o = _flash(
-        to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k, interpret
-    )
-    return o.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    Thin facade over :func:`flash_attention_lse` (one custom-VJP path to
+    maintain); the dropped lse output arrives in the backward as a zero
+    cotangent, which folds away inside the shared kernels."""
+    return flash_attention_lse(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )[0]

@@ -17,6 +17,7 @@ first-class citizens:
 
 from chainermn_tpu.parallel.ring_attention import (
     ring_attention,
+    ring_flash_self_attention,
     ring_self_attention,
 )
 from chainermn_tpu.parallel.ulysses import ulysses_attention
@@ -24,6 +25,7 @@ from chainermn_tpu.parallel.moe import MoELayer, moe_combine, moe_dispatch
 
 __all__ = [
     "ring_attention",
+    "ring_flash_self_attention",
     "ring_self_attention",
     "ulysses_attention",
     "moe_dispatch",

@@ -114,6 +114,96 @@ def ring_self_attention(
     return out.astype(q.dtype)
 
 
+def _merge_blocks(o1, lse1, o2, lse2):
+    """Exactly combine two normalized attention results over disjoint key
+    sets via their logsumexps.  o: (B, T, H, D) fp32; lse: (B, H, T)."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w1 = jnp.exp(lse1 - m_safe)  # exp(-inf - 0) = 0 for empty sides
+    w2 = jnp.exp(lse2 - m_safe)
+    tot = jnp.maximum(w1 + w2, jnp.finfo(jnp.float32).tiny)
+    wt1 = (w1 / tot).transpose(0, 2, 1)[..., None]  # (B, T, H, 1)
+    wt2 = (w2 / tot).transpose(0, 2, 1)[..., None]
+    o = o1 * wt1 + o2 * wt2
+    lse = m_safe + jnp.log(tot)
+    lse = jnp.where(jnp.isneginf(m), -jnp.inf, lse)
+    return o, lse
+
+
+def ring_flash_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Ring attention whose LOCAL blocks run the Pallas flash kernel.
+
+    Same contract as :func:`ring_self_attention` (call inside ``shard_map``
+    with ``(B, T_local, H, D)`` sequence shards), but each visiting K/V block
+    is attended with :func:`chainermn_tpu.ops.flash_attention_lse` — scores
+    stay in VMEM instead of materializing ``(B, H, T, T)`` per ring step —
+    and the per-block results merge exactly through their logsumexps.  At
+    ring-block granularity the causal structure is block-constant: the
+    diagonal block (step 0, src == my rank) uses the kernel's causal mask,
+    strictly-past blocks attend fully, strictly-future blocks are discarded
+    (lse = −inf) before the merge.  Backward is AD end-to-end: the kernel's
+    custom VJP absorbs the lse cotangent, and the transposed ``ppermute``
+    rotates gradients backward around the ring.
+    """
+    from chainermn_tpu.ops import flash_attention_lse
+
+    B, T, H, D = q.shape
+    S = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def local(qb, kb, vb, causal_blk):
+        o, lse = flash_attention_lse(
+            qb, kb, vb, causal=causal_blk,
+            block_q=min(block_q, T), block_k=min(block_k, T),
+        )
+        return o.astype(jnp.float32), lse
+
+    # Step 0 is the diagonal block on every rank (src == my).
+    o_acc, lse_acc = local(q, k, v, causal)
+    k_cur = lax.ppermute(k, axis_name, perm=perm)
+    v_cur = lax.ppermute(v, axis_name, perm=perm)
+
+    def body(carry, step):
+        k_cur, v_cur, o_acc, lse_acc = carry
+        if causal:
+            # Visiting block originated at rank (my - step); it is visible
+            # only if strictly in the past (src < my in global order).
+            # SKIP the kernel for future blocks rather than computing and
+            # discarding (≈half the ring's flash FLOPs in causal mode); the
+            # rank-varying predicate is SPMD-safe — no collectives inside.
+            src = (my - step) % S
+            o_blk, lse_blk = lax.cond(
+                src < my,
+                lambda: local(q, k_cur, v_cur, False),
+                lambda: (
+                    jnp.zeros((B, T, H, D), jnp.float32),
+                    jnp.full((B, H, T), -jnp.inf, jnp.float32),
+                ),
+            )
+        else:
+            o_blk, lse_blk = local(q, k_cur, v_cur, False)
+        o_acc, lse_acc = _merge_blocks(o_acc, lse_acc, o_blk, lse_blk)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm=perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm=perm)
+        return (k_nxt, v_nxt, o_acc, lse_acc), None
+
+    if S > 1:
+        body = jax.checkpoint(body)
+        (_, _, o_acc, lse_acc), _ = lax.scan(
+            body, (k_cur, v_cur, o_acc, lse_acc), jnp.arange(1, S)
+        )
+    return o_acc.astype(q.dtype)
+
+
 def ring_attention(
     comm,
     q: jax.Array,
