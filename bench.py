@@ -122,11 +122,18 @@ if _FORCE_CPU:
         pass
 
 import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
 import optax  # noqa: E402
 
 import chainermn_tpu as cmn  # noqa: E402
 from chainermn_tpu.models.resnet import ResNet50, resnet_loss  # noqa: E402
+
+
+def _mark(msg: str) -> None:
+    """Progress marker on stderr (stdout carries the one-JSON-line contract).
+    The axon tunnel can stall for minutes at a time; these make a hung run
+    diagnosable (which phase: transfer / compile / warmup / timed loop)."""
+    print(f"# bench [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
 
 
 def _aot_compile(step, state, batch):
@@ -154,6 +161,41 @@ def _is_oom(e: Exception) -> bool:
     return any(t in s for t in ("RESOURCE_EXHAUSTED", "Out of memory", "OOM"))
 
 
+def _is_transient(e: Exception) -> bool:
+    """Tunnel hiccups surface as UNAVAILABLE / DEADLINE_EXCEEDED mid-run."""
+    s = str(e)
+    return any(t in s for t in ("UNAVAILABLE", "DEADLINE_EXCEEDED"))
+
+
+def _device_batch(comm, global_batch, image_size):
+    """Synthesize the benchmark batch ON DEVICE with the data-axis sharding.
+
+    A host-generated batch at the headline geometry is ~150 MB; pushing it
+    through the axon tunnel has been observed to kill the run (UNAVAILABLE
+    mid-device_put).  The batch never changes across iterations, so device-
+    side RNG is equivalent — and the input pipeline is benchmarked separately
+    (PrefetchIterator), not here.
+    """
+    from functools import partial
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = lambda spec: NamedSharding(comm.mesh, spec)
+
+    @partial(
+        jax.jit,
+        out_shardings=(sh(P(comm.axes)), sh(P(comm.axes))),
+    )
+    def gen(key):
+        kx, ky = jax.random.split(key)
+        x = jax.random.normal(
+            kx, (global_batch, image_size, image_size, 3), jnp.float32
+        )
+        y = jax.random.randint(ky, (global_batch,), 0, 1000, jnp.int32)
+        return x, y
+
+    return jax.block_until_ready(gen(jax.random.PRNGKey(17)))
+
+
 def main():
     devices = jax.devices()
     n_dev = len(devices)
@@ -167,7 +209,9 @@ def main():
         os.environ.get("CMN_BENCH_BATCH", 8 if on_cpu else 256)
     )
     # The driver runs this unattended at round end: if the headline batch
-    # OOMs on the chip, degrade (halving) rather than record nothing.
+    # OOMs on the chip, degrade (halving); if the tunnel hiccups
+    # (UNAVAILABLE mid-run), back off and redial a few times.
+    transient_left = 2
     while True:
         try:
             _run(per_chip_batch, n_dev, platform, on_cpu)
@@ -189,6 +233,27 @@ def main():
                     f"OOM persisted down to per-chip batch {per_chip_batch} "
                     f"on {platform}: {str(e)[:300]}"
                 )
+            if _is_transient(e) and not on_cpu and transient_left > 0:
+                transient_left -= 1
+                _mark(f"transient backend error, redialing: {str(e)[:120]}")
+                time.sleep(60)
+                if not _probe_device(attempts=(120, 240)):
+                    _fail(
+                        "TPU went unreachable mid-benchmark and did not "
+                        f"recover: {str(e)[:300]}"
+                    )
+                # The in-process PJRT client may be permanently wedged by the
+                # error even though the tunnel recovered (the probe runs in a
+                # fresh subprocess) — drop it so _run builds a new client.
+                try:
+                    from jax.extend import backend as _jx_backend
+
+                    _jx_backend.clear_backends()
+                except Exception:
+                    pass
+                continue
+            if _is_transient(e) and not on_cpu:
+                _fail(f"TPU kept failing transiently: {str(e)[:300]}")
             raise
 
 
@@ -198,6 +263,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     image_size = 64 if on_cpu else 224
     warmup, iters = (1, 2) if on_cpu else (5, 20)
 
+    _mark(f"client up: {platform} x{n_dev}, per_chip_batch={per_chip_batch}")
     comm = cmn.create_communicator("xla", allreduce_grad_dtype=jnp.bfloat16)
     model = ResNet50(num_classes=1000, axis_name=comm.axis_name)
     opt = cmn.create_multi_node_optimizer(optax.sgd(0.1, momentum=0.9), comm)
@@ -207,21 +273,16 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     # Init without the cross-device axis in scope (plain eval-mode trace).
     init_model = ResNet50(num_classes=1000)
     variables = init_model.init(rng, x1, train=False)
+    _mark("model init done")
     state = opt.init(variables["params"], model_state=variables["batch_stats"])
     step = opt.make_train_step(resnet_loss(model), stateful=True)
 
     global_batch = per_chip_batch * n_dev
-    host_rng = np.random.RandomState(0)
-    batch = comm.shard_batch(
-        (
-            host_rng.normal(size=(global_batch, image_size, image_size, 3)).astype(
-                np.float32
-            ),
-            host_rng.randint(0, 1000, size=(global_batch,)).astype(np.int32),
-        )
-    )
+    batch = _device_batch(comm, global_batch, image_size)
 
+    _mark("batch on device; AOT compiling train step")
     step, flops_per_step = _aot_compile(step, state, batch)
+    _mark("compile done")
 
     # Warmup (compile + steady-state). Materialize the loss — over the axon
     # tunnel, ``block_until_ready`` on donated-aliased outputs has been
@@ -234,6 +295,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     # step's state, so materializing the FINAL loss bounds the whole chain —
     # the same sequential-dependency argument the reference's wall-clock
     # epoch timing rests on, with no host round-trip per iteration.
+    _mark("warmup done; entering timed loop")
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch)

@@ -19,6 +19,13 @@ from chainermn_tpu.models.vgg import (
     init_stage_params,
     vgg_stage_modules,
 )
+from chainermn_tpu.models.dcgan import (
+    Discriminator,
+    GanState,
+    Generator,
+    gan_init,
+    make_gan_train_step,
+)
 from chainermn_tpu.models.transformer import (
     ParallelLM,
     ParallelLMConfig,
@@ -54,4 +61,9 @@ __all__ = [
     "init_parallel_lm",
     "parallel_lm_specs",
     "dense_lm_reference",
+    "Generator",
+    "Discriminator",
+    "GanState",
+    "gan_init",
+    "make_gan_train_step",
 ]

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Watch the axon TPU tunnel and run bench.py the moment it answers.
+# The tunnel wedges for long stretches; polling with short probes and firing
+# immediately on recovery is the only strategy that has worked.
+#   usage: scripts/tpu_bench_watch.sh [max_minutes] [per_chip_batch]
+set -u
+MAX_MIN=${1:-120}
+BATCH=${2:-64}
+DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
+cd "$(dirname "$0")/.."
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256,256), jnp.bfloat16)
+assert jax.devices()[0].platform != 'cpu'
+print(float((x@x).sum()))
+" >/dev/null 2>&1; then
+    echo "# tunnel up at $(date +%H:%M:%S); running bench (batch $BATCH)" >&2
+    CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH python bench.py \
+      2>>result/bench_watch_stderr.log
+    rc=$?
+    echo "# bench rc=$rc at $(date +%H:%M:%S)" >&2
+    [ $rc -eq 0 ] && exit 0
+  fi
+  sleep 90
+done
+echo '{"error": "tpu_bench_watch: tunnel never answered within budget"}'
+exit 1
