@@ -278,7 +278,7 @@ class XlaCommunicator(CommunicatorBase):
                     mesh=self._mesh,
                     in_specs=self._spec,
                     out_specs=self._spec,
-                    check_vma=False,
+                    check_vma=True,
                 )
             )
 

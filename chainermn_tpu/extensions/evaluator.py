@@ -76,7 +76,7 @@ class Evaluator:
                     mesh=comm.mesh,
                     in_specs=(P(), P(comm.axes), P(comm.axes)),
                     out_specs=(P(), P()),
-                    check_vma=False,
+                    check_vma=True,
                 )
             )
         return self._step

@@ -27,7 +27,8 @@ from flax import struct
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from chainermn_tpu.comm.xla import XlaCommunicator
+from chainermn_tpu.comm.xla import DummyCommunicator, XlaCommunicator
+from chainermn_tpu.utils import pvary
 
 
 class Generator(nn.Module):
@@ -149,20 +150,30 @@ def make_gan_train_step(
 
     def body(state: GanState, batch):
         real, z = batch
+        # Differentiate w.r.t. explicitly device-varying copies: under the
+        # vma type system, grads w.r.t. UNVARYING params arrive pre-psum'd
+        # (the broadcast's adjoint) and the explicit mean below would scale
+        # them by ``size``.  See MultiNodeOptimizer.make_train_step.
+        vg = jax.tree_util.tree_map(
+            lambda p: pvary(p, comm.axes), state.g_params
+        )
+        vd = jax.tree_util.tree_map(
+            lambda p: pvary(p, comm.axes), state.d_params
+        )
 
         def d_loss_fn(d_params):
-            fake = gen.apply({"params": state.g_params}, z)
+            fake = gen.apply({"params": vg}, z)
             y_fake = disc.apply({"params": d_params}, lax.stop_gradient(fake))
             y_real = disc.apply({"params": d_params}, real)
             return _bce_logits(y_real, 1.0) + _bce_logits(y_fake, 0.0)
 
         def g_loss_fn(g_params):
             fake = gen.apply({"params": g_params}, z)
-            y_fake = disc.apply({"params": state.d_params}, fake)
+            y_fake = disc.apply({"params": vd}, fake)
             return _bce_logits(y_fake, 1.0)  # non-saturating heuristic loss
 
-        d_loss, d_grads = jax.value_and_grad(d_loss_fn)(state.d_params)
-        g_loss, g_grads = jax.value_and_grad(g_loss_fn)(state.g_params)
+        d_loss, d_grads = jax.value_and_grad(d_loss_fn)(vd)
+        g_loss, g_grads = jax.value_and_grad(g_loss_fn)(vg)
         d_grads = jax.tree_util.tree_map(comm.grad_reduce_leaf, d_grads)
         g_grads = jax.tree_util.tree_map(comm.grad_reduce_leaf, g_grads)
         d_updates, d_opt_state = d_tx.update(
@@ -191,6 +202,8 @@ def make_gan_train_step(
         mesh=comm.mesh,
         in_specs=(P(), (P(comm.axes), P(comm.axes))),
         out_specs=(P(), P()),
-        check_vma=False,
+        # Same exemption as MultiNodeOptimizer: the Dummy ablation's
+        # identity reduce leaves params device-varying by design.
+        check_vma=not isinstance(comm, DummyCommunicator),
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import optax
 
 from chainermn_tpu.datasets.seq import BOS, EOS, PAD  # shared sentinels
+from chainermn_tpu.utils import pvary
 
 
 class Seq2Seq(nn.Module):
@@ -37,15 +38,28 @@ class Seq2Seq(nn.Module):
     embed: int = 128
     hidden: int = 256
     dtype: Any = jnp.float32
+    #: Mesh axis name(s) when the model runs inside ``shard_map`` with vma
+    #: checking: the encoder scan's zero initial carry must be marked
+    #: device-varying (``lax.pvary``) or the scan rejects its carry type
+    #: (same pattern as ResNet's ``axis_name`` for sync-BN).
+    axis_name: Any = None
 
     @nn.compact
     def __call__(self, src, tgt_in):
         emb_s = nn.Embed(self.vocab_src, self.embed, dtype=self.dtype,
                          name="embed_src")(src)
         # encoder scan; final carry summarizes the sentence
-        enc = nn.RNN(nn.OptimizedLSTMCell(self.hidden), return_carry=True,
-                     name="encoder")
-        carry, _ = enc(emb_s)
+        cell = nn.OptimizedLSTMCell(self.hidden)
+        enc = nn.RNN(cell, return_carry=True, name="encoder")
+        # carry shape: input shape minus the (scanned) time axis
+        carry0 = cell.initialize_carry(
+            jax.random.PRNGKey(0), emb_s.shape[:1] + emb_s.shape[2:]
+        )
+        if self.axis_name is not None:
+            carry0 = jax.tree_util.tree_map(
+                lambda x: pvary(x, self.axis_name), carry0
+            )
+        carry, _ = enc(emb_s, initial_carry=carry0)
         emb_t = nn.Embed(self.vocab_tgt, self.embed, dtype=self.dtype,
                          name="embed_tgt")(tgt_in)
         dec = nn.RNN(nn.OptimizedLSTMCell(self.hidden), name="decoder")
@@ -77,6 +91,10 @@ def greedy_decode(model: nn.Module, params, src, max_len: int = 32):
     positions, full re-apply per step — an eval utility, not a serving path)."""
     B = src.shape[0]
     tgt_in = jnp.full((B, max_len), PAD, jnp.int32).at[:, 0].set(BOS)
+    if getattr(model, "axis_name", None) is not None:
+        # Inside shard_map with vma checking the fori_loop carry must start
+        # device-varying (the decoded tokens depend on the varying src).
+        tgt_in = pvary(tgt_in, model.axis_name)
 
     def body(i, tgt_in):
         logits = model.apply({"params": params}, src, tgt_in)

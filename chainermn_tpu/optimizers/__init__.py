@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.comm.base import CommunicatorBase
 from chainermn_tpu.comm.xla import XlaCommunicator
+from chainermn_tpu.utils import pvary
 
 
 @struct.dataclass
@@ -139,16 +140,28 @@ class MultiNodeOptimizer:
 
         def body(state: TrainState, batch):
             new_model_state = state.model_state
+            # Differentiate w.r.t. an explicitly device-varying copy of the
+            # replicated params.  Under shard_map's vma type system
+            # (check_vma=True), differentiating w.r.t. an UNVARYING input
+            # auto-inserts a psum in the transpose (the broadcast's adjoint),
+            # which would return grads already summed over the axis — and the
+            # explicit wire-dtype reduction below would then silently scale
+            # them by ``size`` (pmean of an unvarying value is identity).
+            # pvary first keeps grads per-device, exactly like the reference's
+            # local backward before its allreduce.
+            vparams = jax.tree_util.tree_map(
+                lambda p: pvary(p, axes), state.params
+            )
             if stateful:
                 (loss, (aux, new_model_state)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
-                )(state.params, state.model_state, batch)
+                )(vparams, state.model_state, batch)
             elif has_aux:
                 (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                    state.params, batch
+                    vparams, batch
                 )
             else:
-                loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+                loss, grads = jax.value_and_grad(loss_fn)(vparams, batch)
                 aux = {}
             grads = self._allreduce_grads(grads)
             if dbuf:
@@ -186,12 +199,17 @@ class MultiNodeOptimizer:
             )
 
         batch_spec = P(axes)
+        # DummyCommunicator's identity "reduce" leaves grads device-varying
+        # on purpose (comm-cost ablation); the vma checker rightly rejects
+        # the replicated out_specs there, so the ablation runs unchecked.
+        from chainermn_tpu.comm.xla import DummyCommunicator
+
         mapped = jax.shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), batch_spec),
             out_specs=(P(), P()),
-            check_vma=False,
+            check_vma=not isinstance(comm, DummyCommunicator),
         )
         donate_argnums = (0,) if donate else ()
         return jax.jit(mapped, donate_argnums=donate_argnums)

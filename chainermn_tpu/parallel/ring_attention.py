@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from chainermn_tpu.utils import pvary
+
 
 def _block_attend(q, k, v, m, l, o, mask):
     """One flash-style online-softmax accumulation of a visiting K/V block.
@@ -80,9 +82,12 @@ def ring_self_attention(
     S = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
 
-    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
-    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+    # Fresh accumulators are device-INVARIANT until marked varying; the scan
+    # carry mixes them with the (varying) rotating K/V blocks, so the vma
+    # checker requires pvary here.
+    m0 = pvary(jnp.full((B, H, T), -jnp.inf, jnp.float32), axis_name)
+    l0 = pvary(jnp.zeros((B, H, T), jnp.float32), axis_name)
+    o0 = pvary(jnp.zeros((B, T, H, D), jnp.float32), axis_name)
 
     perm = [(i, (i + 1) % S) for i in range(S)]
     rel = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]  # q_pos - k_pos (local)
@@ -185,8 +190,10 @@ def ring_flash_self_attention(
                 src < my,
                 lambda: local(q, k_cur, v_cur, False),
                 lambda: (
-                    jnp.zeros((B, T, H, D), jnp.float32),
-                    jnp.full((B, H, T), -jnp.inf, jnp.float32),
+                    pvary(jnp.zeros((B, T, H, D), jnp.float32), axis_name),
+                    pvary(
+                        jnp.full((B, H, T), -jnp.inf, jnp.float32), axis_name
+                    ),
                 ),
             )
         else:
@@ -231,7 +238,7 @@ def ring_attention(
                 ),
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
-                check_vma=False,
+                check_vma=True,
             )
         )
 

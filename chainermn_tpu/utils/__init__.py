@@ -43,6 +43,18 @@ def respect_jax_platforms_env() -> None:
         pass
 
 
+def pvary(x: Any, axis_name) -> Any:
+    """Mark ``x`` device-varying over ``axis_name`` (vma type system).
+
+    ``jax.lax.pvary`` is deprecated in favor of ``lax.pcast(..., to=
+    'varying')``; prefer the new spelling, fall back on older JAX."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, axis_name)
+
+
 def sync(tree: Any) -> None:
     """Wait for device work by MATERIALIZING a value, not just
     ``block_until_ready`` — readiness can report early on donated-aliased

@@ -43,7 +43,8 @@ def main():
 
     comm = cmn.create_communicator(args.communicator)
     model = Seq2Seq(vocab_src=args.vocab, vocab_tgt=args.vocab,
-                    embed=args.embed, hidden=args.hidden)
+                    embed=args.embed, hidden=args.hidden,
+                    axis_name=comm.axis_name)
     pairs = make_synthetic_translation(4096, vocab=args.vocab, min_len=4,
                                        max_len=16)
     batches = bucket_batches(pairs, args.batchsize,

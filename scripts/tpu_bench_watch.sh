@@ -15,12 +15,28 @@ x = jnp.ones((256,256), jnp.bfloat16)
 assert jax.devices()[0].platform != 'cpu'
 print(float((x@x).sum()))
 " >/dev/null 2>&1; then
-    echo "# tunnel up at $(date +%H:%M:%S); running bench (batch $BATCH)" >&2
-    CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH python bench.py \
-      2>>result/bench_watch_stderr.log
-    rc=$?
-    echo "# bench rc=$rc at $(date +%H:%M:%S)" >&2
-    [ $rc -eq 0 ] && exit 0
+    if [ ! -s result/bench_tpu_done.json ]; then
+      echo "# tunnel up at $(date +%H:%M:%S); running bench (batch $BATCH)" >&2
+      CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH python bench.py \
+        >result/bench_tpu_last.json 2>>result/bench_watch_stderr.log
+      rc=$?
+      cat result/bench_tpu_last.json  # accumulate every attempt on our stdout
+      echo "# bench rc=$rc at $(date +%H:%M:%S)" >&2
+      if [ $rc -eq 0 ] && ! grep -q unreachable result/bench_tpu_last.json; then
+        cp result/bench_tpu_last.json result/bench_tpu_done.json
+      fi
+    fi
+    # Each artifact retries independently across tunnel windows: a sweep
+    # killed by a mid-run wedge gets another chance on the next window.
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/flash_tpu.json ]; then
+      echo "# running flash sweep at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/flash_tpu.py --out result/flash_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# flash sweep rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/bench_tpu_done.json ] && [ -s result/flash_tpu.json ]; then
+      exit 0
+    fi
   fi
   sleep 90
 done

@@ -69,8 +69,11 @@ def _oracle(gen, disc, g_tx, d_tx, batches, rng):
 
 def test_gan_dp_matches_single_device_oracle(devices):
     gen, disc = _models()
-    g_tx = optax.adam(2e-4, b1=0.5)
-    d_tx = optax.adam(2e-4, b1=0.5)
+    # SGD, deliberately: scale-invariant optimizers (adam) mask wrong-by-
+    # constant-factor gradient reductions (e.g. the vma implicit-psum
+    # pitfall), which this oracle exists to catch.
+    g_tx = optax.sgd(1e-3, momentum=0.9)
+    d_tx = optax.sgd(1e-3, momentum=0.9)
     comm = cmn.create_communicator("xla", devices=devices)
 
     rg, rd = jax.random.split(jax.random.PRNGKey(0))

@@ -35,7 +35,8 @@ def test_pad_to():
 def test_seq2seq_dp_learns_reversal(devices):
     comm = cmn.create_communicator("xla", devices=devices)
     vocab = 30
-    model = Seq2Seq(vocab_src=vocab, vocab_tgt=vocab, embed=32, hidden=64)
+    model = Seq2Seq(vocab_src=vocab, vocab_tgt=vocab, embed=32, hidden=64,
+                    axis_name=comm.axis_name)
     pairs = make_synthetic_translation(1024, vocab=vocab, min_len=4, max_len=8)
     batches = bucket_batches(pairs, batch_size=64, bucket_width=8)
 
