@@ -26,6 +26,14 @@ from chainermn_tpu.models.dcgan import (
     gan_init,
     make_gan_train_step,
 )
+from chainermn_tpu.models.parallel_convnet import (
+    channel_parallel_apply,
+    channel_parallel_loss,
+    channel_parallel_specs,
+    dense_reference_apply,
+    init_channel_parallel,
+    make_channel_parallel_train_step,
+)
 from chainermn_tpu.models.transformer import (
     ParallelLM,
     ParallelLMConfig,
@@ -66,4 +74,10 @@ __all__ = [
     "GanState",
     "gan_init",
     "make_gan_train_step",
+    "init_channel_parallel",
+    "channel_parallel_specs",
+    "channel_parallel_apply",
+    "channel_parallel_loss",
+    "dense_reference_apply",
+    "make_channel_parallel_train_step",
 ]

@@ -1,0 +1,183 @@
+"""Channel-parallel convnet — the reference's ``parallel_convnet`` example.
+
+Reference anchor: ``examples/cifar/train_cifar_parallel.py``-style parallel
+convnet (SURVEY.md §2.9 "dcgan/parallel-convnet variants"): each rank owns a
+fraction of every conv layer's FILTERS and the ranks exchange activations
+through the differentiable collectives between layers — filter/channel
+tensor-parallelism built from ``chainermn.functions``.
+
+TPU-native design: a ``model`` mesh axis.  Each device holds the
+``(3, 3, C_in, C_out/M)`` output-channel shard of every conv kernel; a layer
+is local conv → ``lax.all_gather`` over the model axis (concat on channels).
+AD's transpose of the all_gather is the reduce-scatter that routes each
+device exactly its filter shard's gradient — what the reference's
+``allgather`` Function's backward did with MPI.  The dense head is computed
+replicated (every device, full feature vector); its gradients are pmean'd
+over the model axis by the hybrid reducer
+(:func:`chainermn_tpu.optimizers.model_parallel_grad_reduce` pattern).
+
+Params layout (per device, inside ``shard_map``):
+  ``{"convs": [(k, b), ...]  # k: (3,3,Cin,Cout/M) local shard, b: (Cout/M,)
+     "head": {"w": (F, n_classes), "b": (n_classes,)}  # replicated}``
+Stored globally with the conv leaves sharded on their LAST axis over
+``model`` and the head replicated (:func:`channel_parallel_specs`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.utils import pvary
+
+
+def init_channel_parallel(
+    rng,
+    widths: Sequence[int],
+    num_classes: int,
+    in_ch: int = 3,
+    dtype: Any = jnp.float32,
+) -> Any:
+    """Initialize the FULL (unsharded) parameter pytree host-side.
+
+    ``widths[i]`` is conv layer i's total output-channel count; every width
+    must be divisible by the model-axis size when the tree is sharded."""
+    convs: List[Tuple[jax.Array, jax.Array]] = []
+    c = in_ch
+    for i, w in enumerate(widths):
+        key = jax.random.fold_in(rng, i)
+        fan_in = 3 * 3 * c
+        k = jax.random.normal(key, (3, 3, c, w), dtype) / np.sqrt(fan_in)
+        convs.append((k, jnp.zeros((w,), dtype)))
+        c = w
+    khead = jax.random.fold_in(rng, len(widths))
+    head = {
+        "w": jax.random.normal(khead, (c, num_classes), dtype) / np.sqrt(c),
+        "b": jnp.zeros((num_classes,), dtype),
+    }
+    return {"convs": convs, "head": head}
+
+
+def channel_parallel_specs(params: Any, axis_name="model") -> Any:
+    """PartitionSpecs: conv kernels/biases sharded on their output-channel
+    (last) axis over the model axis; head replicated."""
+    return {
+        "convs": [
+            (P(None, None, None, axis_name), P(axis_name))
+            for _ in params["convs"]
+        ],
+        "head": {"w": P(), "b": P()},
+    }
+
+
+def channel_parallel_apply(params: Any, x: jax.Array, axis_name="model"):
+    """Forward pass.  Inside ``shard_map`` (``axis_name`` set): conv kernels
+    are local output-channel shards, activations re-assemble with
+    ``all_gather`` after every layer, pooling every other layer.  With
+    ``axis_name=None`` the same code on the FULL kernels is the single-device
+    oracle (no gather) — one body, so the oracle-exactness contract can't
+    drift.  ``x``: full-channel input (B, H, W, Cin), identical on every
+    model rank (mark it varying first if it arrives replicated)."""
+    for i, (k, b) in enumerate(params["convs"]):
+        y = lax.conv_general_dilated(
+            x, k, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + b
+        if axis_name is not None:
+            # Re-assemble the channel dim from every rank's filter shard.
+            y = lax.all_gather(y, axis_name, axis=3, tiled=True)
+        x = jax.nn.relu(y)
+        if i % 2 == 1:  # pool every second layer
+            x = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+            )
+    feats = jnp.mean(x, axis=(1, 2))  # global average pool
+    return feats @ params["head"]["w"] + params["head"]["b"]
+
+
+def dense_reference_apply(params: Any, x: jax.Array):
+    """Single-device oracle: the same body with the full kernels."""
+    return channel_parallel_apply(params, x, axis_name=None)
+
+
+def channel_parallel_loss(axis_name="model"):
+    """Masked-free CE loss for the shard_map body: every model rank computes
+    the identical loss on the full batch; conv grads arrive per-shard via
+    the all_gather transpose, head grads are pmean'd to cancel the replica
+    multiplicity."""
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = channel_parallel_apply(params, x, axis_name)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+    return loss_fn
+
+
+def make_channel_parallel_train_step(comm, tx, params, opt_state,
+                                     axis_name=None):
+    """Build the jitted SPMD step of channel-parallel training:
+    ``step((params, opt_state), batch) -> ((params, opt_state), loss)``.
+    ``params``/``opt_state`` fix the carry structure for the specs; the step
+    donates its carry, so pass it copies of these trees.  Batch is
+    replicated to every rank (channel parallelism splits filters, not
+    samples — the reference example's layout)."""
+    if axis_name is None:
+        axis_name = comm.axes  # the communicator's mesh axes ARE the model axis
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    # Multiplicity = extent of the axes the collectives actually run over
+    # (NOT comm.size: on a hybrid mesh a subset axis has a smaller extent).
+    n_replicas = int(np.prod([comm.mesh.shape[a] for a in axes]))
+    loss_fn = channel_parallel_loss(axis_name)
+
+    def body(carry, batch):
+        params, opt_state = carry
+        # Batch arrives replicated (unvarying); params are channel-sharded
+        # (varying).  Mark the batch + replicated head varying so grads stay
+        # per-device (see MultiNodeOptimizer on the implicit-psum pitfall).
+        batch = jax.tree_util.tree_map(lambda t: pvary(t, axis_name), batch)
+        vparams = {
+            "convs": params["convs"],  # sharded leaves are already varying
+            "head": jax.tree_util.tree_map(
+                lambda p: pvary(p, axis_name), params["head"]
+            ),
+        }
+        loss, grads = jax.value_and_grad(loss_fn)(vparams, batch)
+        # The loss is computed once PER RANK (replicated compute), so the
+        # all_gather transpose delivers each conv shard the SUM of all M
+        # identical copies' cotangents — M× the true gradient; divide it
+        # out.  Head grads never cross the gather (one copy each, identical
+        # values) — pmean just restores invariance.  Same multiplicity
+        # cancellation as optimizers.model_parallel_grad_reduce.
+        grads = {
+            "convs": jax.tree_util.tree_map(
+                lambda g: g / n_replicas, grads["convs"]
+            ),
+            "head": jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, axis_name), grads["head"]
+            ),
+        }
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), lax.pmean(loss, axis_name)
+
+    pspecs = channel_parallel_specs(params, axis_name)
+    from chainermn_tpu.optimizers import optimizer_state_specs
+
+    ospecs = optimizer_state_specs(opt_state, params, pspecs)
+    carry_spec = (pspecs, ospecs)
+    mapped = jax.shard_map(
+        body,
+        mesh=comm.mesh,
+        in_specs=(carry_spec, (P(), P())),
+        out_specs=(carry_spec, P()),
+        check_vma=True,
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
