@@ -356,11 +356,22 @@ class XlaCommunicator(CommunicatorBase):
     def gather(self, x: Any, root: int = 0) -> Any:
         # SPMD note: every slot receives the stack (root only matters for the
         # object plane); documented deviation from the MPMD reference.
+        #
+        # Traffic: O(size×) the payload reaches EVERY device (an allgather) —
+        # under SPMD there is no cheaper gather-to-one, since all devices run
+        # the same program.  Fine for the control-plane uses these facades
+        # exist for; route tensor-sized data through ``shard_batch`` /
+        # in-graph collectives instead.
         return self.allgather(x)
 
     def scatter(self, x: Any, root: int = 0) -> Any:
         """Slot ``root`` holds ``(size, ...)`` rows; output slot ``r`` gets row
-        ``r``.  Leaf shape ``(size, size, ...)`` → ``(size, ...)``."""
+        ``r``.  Leaf shape ``(size, size, ...)`` → ``(size, ...)``.
+
+        Traffic: the mask+psum broadcasts root's full ``(size, ...)`` buffer
+        to every device before each picks its row — O(size×) the per-rank
+        payload, the SPMD cost of a root-scatter (see :meth:`gather`).
+        Control-plane sized data only."""
         axes = self.axis_name
 
         def body(z):  # z: (1, size, ...)

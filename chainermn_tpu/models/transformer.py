@@ -49,10 +49,13 @@ class TransformerLM(nn.Module):
     d_ff: int = 1024
     max_len: int = 1024
     dtype: Any = jnp.bfloat16
+    #: "flash" (Pallas kernel) or "xla" (materialized-scores oracle) — the
+    #: switch the LM benchmark uses to measure the kernel's end-to-end value.
+    attention: str = "flash"
 
     @nn.compact
     def __call__(self, tokens):  # (B, T) int32 -> (B, T, vocab) f32
-        from chainermn_tpu.ops import flash_attention
+        from chainermn_tpu.ops import flash_attention, reference_attention
 
         B, T = tokens.shape
         D, H = self.d_model, self.n_heads
@@ -67,13 +70,20 @@ class TransformerLM(nn.Module):
                 (3, H, D // H), dtype=self.dtype, name=f"qkv_{i}"
             )(x)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            # Largest power-of-two block that divides T (flash needs T %
-            # block == 0); natural lengths work without upstream padding.
-            block = 128
-            while block > 1 and T % block:
-                block //= 2
-            a = flash_attention(q, k, v, causal=True, block_q=block,
-                                block_k=block)
+            if self.attention == "flash":
+                # Largest power-of-two block that divides T (flash needs T %
+                # block == 0); natural lengths work without upstream padding.
+                block = 128
+                while block > 1 and T % block:
+                    block //= 2
+                a = flash_attention(q, k, v, causal=True, block_q=block,
+                                    block_k=block)
+            elif self.attention == "xla":
+                a = reference_attention(q, k, v, causal=True).astype(q.dtype)
+            else:
+                raise ValueError(
+                    f"attention={self.attention!r}: expected 'flash' or 'xla'"
+                )
             o = nn.DenseGeneral(
                 D, axis=(-2, -1), dtype=self.dtype, name=f"proj_{i}"
             )(a)

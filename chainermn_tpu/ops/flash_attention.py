@@ -46,6 +46,13 @@ def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
     implementation, so the CPU interpret tests and the on-chip harness can
     never validate against diverging references).  Computed in fp32, cast
     back to the input dtype."""
+    return _reference_attention_lse(q, k, v, causal)[0]
+
+
+def _reference_attention_lse(q, k, v, causal: bool = False):
+    """:func:`reference_attention` + per-row logsumexp ``(B, H, T)`` — the
+    XLA twin of :func:`flash_attention_lse` (used as its vma-checked
+    interpret-mode fallback)."""
     B, T, H, D = q.shape
     qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
     kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
@@ -54,9 +61,10 @@ def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B, H, T)
+    p = jnp.exp(s - lse[..., None])
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
-    return o.transpose(0, 2, 1, 3).astype(q.dtype)
+    return o.transpose(0, 2, 1, 3).astype(q.dtype), lse
 
 
 # --------------------------------------------------------------------- fwd
@@ -112,6 +120,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
     lse_ref[0] = m + jnp.log(l_safe)
 
 
+
+def _vma_union(*arrays):
+    """Union of the inputs' varying-manual-axes (vma) types.
+
+    Inside a ``check_vma=True`` ``shard_map``, ``pallas_call`` outputs must
+    declare how they vary over the mesh (``ShapeDtypeStruct(vma=...)``);
+    the kernel is per-device local compute, so outputs vary exactly as the
+    union of the inputs do.  Outside shard_map this is the empty set."""
+    out = frozenset()
+    for a in arrays:
+        out |= getattr(jax.typeof(a), "vma", frozenset())
+    return out
+
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     BH, T, D = q.shape
     scale = 1.0 / math.sqrt(D)
@@ -132,8 +153,8 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype, vma=_vma_union(q, k, v)),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32, vma=_vma_union(q, k, v)),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -284,8 +305,12 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g, dlse=None):
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+            jax.ShapeDtypeStruct(
+                (BH, T, D), k.dtype, vma=_vma_union(q, k, v, do, lse, delta)
+            ),
+            jax.ShapeDtypeStruct(
+                (BH, T, D), v.dtype, vma=_vma_union(q, k, v, do, lse, delta)
+            ),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -305,7 +330,9 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g, dlse=None):
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # delta
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (BH, T, D), q.dtype, vma=_vma_union(q, k, v, do, lse, delta)
+        ),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
@@ -353,10 +380,20 @@ def flash_attention_lse(
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     if T % block_q or T % block_k:
+        # Validate BEFORE any fallback so CPU tests reject exactly the
+        # block configs the TPU kernel would.
         raise ValueError(
             f"seq len {T} must be a multiple of block sizes "
             f"({block_q}, {block_k})"
         )
+    if interpret and _vma_union(q, k, v):
+        # Interpret-mode Pallas cannot be traced through shard_map's vma
+        # checker (its kernel jaxpr mixes varying refs with invariant index
+        # scalars and the checker rejects it — a JAX interpreter
+        # limitation).  Off-TPU inside a checked shard_map, compute the
+        # mathematically identical XLA form instead; the compiled kernel is
+        # unaffected (opaque to the checker).
+        return _reference_attention_lse(q, k, v, causal)
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)

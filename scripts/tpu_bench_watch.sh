@@ -17,7 +17,8 @@ print(float((x@x).sum()))
 " >/dev/null 2>&1; then
     if [ ! -s result/bench_tpu_done.json ]; then
       echo "# tunnel up at $(date +%H:%M:%S); running bench (batch $BATCH)" >&2
-      CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH python bench.py \
+      CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH \
+        CMN_BENCH_PROFILE=result/profile_r02 python bench.py \
         >result/bench_tpu_last.json 2>>result/bench_watch_stderr.log
       rc=$?
       cat result/bench_tpu_last.json  # accumulate every attempt on our stdout
