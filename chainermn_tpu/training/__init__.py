@@ -12,12 +12,26 @@ every reference example).
 from __future__ import annotations
 
 import json
+import math
 import os
+import sys
 import time
 from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+
+#: True while a ProgressBar \r-line is open on stderr; printers that emit
+#: full lines (LogReport) break the line first so output never interleaves.
+_progress_line_open = False
+
+
+def _close_progress_line():
+    global _progress_line_open
+    if _progress_line_open:
+        print(file=sys.stderr, flush=True)
+        _progress_line_open = False
 
 
 class Extension:
@@ -86,6 +100,7 @@ class LogReport(Extension):
     def _report(self, means, entry):
         if jax.process_index() == 0:
             if self._print:
+                _close_progress_line()
                 parts = [f"epoch {entry['epoch']}", f"iter {entry['iteration']}"]
                 parts += [f"{k} {v:.4f}" for k, v in means.items()]
                 print("  ".join(parts), flush=True)
@@ -93,6 +108,55 @@ class LogReport(Extension):
                 os.makedirs(os.path.dirname(self._out) or ".", exist_ok=True)
                 with open(self._out, "w") as f:
                     json.dump(self.log, f, indent=1)
+
+
+class ProgressBar(Extension):
+    """Rank-0 progress line with rate + ETA (reference: Chainer's
+    ``ProgressBar``, attached ``if comm.rank == 0`` in every example).
+    Writes a carriage-returned status line to stderr every
+    ``update_interval`` iterations — never on the metric hot path."""
+
+    def __init__(self, update_interval: int = 10):
+        super().__init__(self._fire, trigger=(update_interval, "iteration"),
+                         name="ProgressBar")
+        self._t0 = time.time()
+
+    def _fire(self, trainer: "Trainer"):
+        if jax.process_index() != 0:
+            return
+        elapsed = time.time() - self._t0
+        rate = trainer.iteration / elapsed if elapsed > 0 else 0.0
+        total = self._total_iters(trainer)
+        if total:
+            frac = min(trainer.iteration / total, 1.0)
+            bar = "#" * int(frac * 20)
+            eta = (total - trainer.iteration) / rate if rate > 0 else 0.0
+            msg = (f"[{bar:<20}] {frac:6.1%}  iter {trainer.iteration}"
+                   f"  {rate:.2f} it/s  eta {eta:.0f}s")
+        else:
+            msg = (f"iter {trainer.iteration}  epoch {trainer.epoch}"
+                   f"  {rate:.2f} it/s")
+        # Pad to the widest line so a shrinking eta/rate never leaves stale
+        # trailing characters, and \r only after the payload.
+        self._width = max(getattr(self, "_width", 0), len(msg))
+        print("\r" + msg.ljust(self._width), end="", file=sys.stderr,
+              flush=True)
+        global _progress_line_open
+        _progress_line_open = True
+
+    @staticmethod
+    def _total_iters(trainer: "Trainer") -> Optional[int]:
+        if trainer.stop_unit == "iteration":
+            return trainer.stop_n
+        it = trainer.train_iter
+        n, bs = getattr(it, "_n", None), getattr(it, "batch_size", None)
+        if n and bs:
+            return trainer.stop_n * math.ceil(n / bs)
+        return None
+
+    def finalize(self, trainer: "Trainer"):
+        if jax.process_index() == 0:
+            _close_progress_line()
 
 
 class Trainer:
