@@ -48,6 +48,7 @@ from chainermn_tpu.optimizers import (  # noqa: E402
     MultiNodeOptimizer,
     TrainState,
     create_multi_node_optimizer,
+    create_zero_optimizer,
 )
 
 __all__ = [
@@ -65,6 +66,7 @@ __all__ = [
     "functions",
     "links",
     "create_multi_node_optimizer",
+    "create_zero_optimizer",
     "MultiNodeOptimizer",
     "TrainState",
     "create_multi_node_evaluator",

@@ -309,3 +309,11 @@ def model_parallel_grad_reduce(data_comm, model_comm) -> Callable:
         return data_comm.grad_reduce_leaf(g)
 
     return reduce_leaf
+
+
+# ZeRO tier (sharded params/grads/optimizer state) lives in its own module.
+from chainermn_tpu.optimizers.zero import (  # noqa: E402
+    ZeroMultiNodeOptimizer,
+    ZeroTrainState,
+    create_zero_optimizer,
+)

@@ -1,0 +1,302 @@
+"""ZeRO-1/3-style sharded-state optimizer — beyond-parity memory scaling.
+
+The reference replicates parameters, gradients, and optimizer state on every
+GPU (``_MultiNodeOptimizer``; SURVEY.md §2.6) — at N devices that is N full
+copies of everything.  This optimizer shards all three over the data axis,
+the TPU-idiomatic way:
+
+* **parameters** live as flat padded slices, one ``1/N`` shard per device
+  (``(N·k,)`` arrays sharded over the mesh); the train step ``all_gather``\\ s
+  them at entry for the forward/backward — XLA schedules the gathers
+  alongside compute, and ICI bandwidth makes this the standard TPU recipe
+  (the fsdp/"ZeRO-3 storage" layout);
+* **gradients** are ``psum_scatter``'d — each device receives only the
+  reduced shard it owns (half the collective traffic of a full all-reduce);
+* **optimizer state** (momenta, adam moments) exists only for the local
+  shard — the ZeRO-1 partitioning that cuts state memory by N×.
+
+Numerics are EXACTLY the replicated optimizer's: reduce-scatter + local
+update + all-gather ≡ all-reduce + replicated update (oracle-tested).
+Supports the wire-dtype (bf16 grads) path with the 1/N division fused into
+the cast-back, and the vma checker end-to-end (every carried tensor is
+device-varying with a sharded spec — no replication claims to discharge).
+
+Reference anchor: none — ChainerMN had no state sharding; this is the
+capability a modern user expects on top of ``create_multi_node_optimizer``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.comm.xla import XlaCommunicator
+
+
+class _LeafSpec(NamedTuple):
+    shape: Tuple[int, ...]
+    size: int
+    padded: int  # size padded up to a multiple of the axis extent
+    dtype: Any
+
+
+@struct.dataclass
+class ZeroTrainState:
+    """Sharded training state: flat padded param/opt-state slices."""
+
+    step: jax.Array
+    flat_params: Any  # list-structured pytree of (N·k,) arrays, sharded
+    opt_state: Any  # optax state over the flat layout (param-shaped leaves
+    # sharded, scalars replicated)
+    model_state: Any = None
+
+
+class ZeroMultiNodeOptimizer:
+    """``create_multi_node_optimizer`` with ZeRO-sharded params/grads/state.
+
+    Same ``loss_fn`` contract as :class:`MultiNodeOptimizer`; the state it
+    carries is sharded, so use :meth:`materialize_params` to obtain the full
+    parameter pytree (eval, checkpoint interchange, export).
+    """
+
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        communicator: XlaCommunicator,
+    ):
+        if not isinstance(communicator, XlaCommunicator):
+            raise TypeError("ZeRO optimizer requires a mesh-backed communicator")
+        self.tx = tx
+        self.comm = communicator
+        self._leafspecs = None
+        self._treedef = None
+
+    # ---------------------------------------------------------------- layout
+    @property
+    def _n(self) -> int:
+        return int(
+            np.prod([self.comm.mesh.shape[a] for a in self.comm.axes])
+        )
+
+    def _flatten_spec(self, params: Any):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        n = self._n
+        specs = []
+        for leaf in leaves:
+            size = int(np.prod(leaf.shape)) if leaf.shape else 1
+            k = -(-size // n)  # ceil
+            specs.append(
+                _LeafSpec(tuple(leaf.shape), size, k * n, leaf.dtype)
+            )
+        return specs, treedef
+
+    def _flat_sharding(self) -> NamedSharding:
+        return NamedSharding(self.comm.mesh, P(self.comm.axes))
+
+    # ----------------------------------------------------------------- init
+    def init(self, params: Any, model_state: Any = None) -> ZeroTrainState:
+        self._leafspecs, self._treedef = self._flatten_spec(params)
+        sh = self._flat_sharding()
+        leaves = jax.tree_util.tree_leaves(params)
+        flat = []
+        for leaf, spec in zip(leaves, self._leafspecs):
+            v = jnp.ravel(jnp.asarray(leaf))
+            if spec.padded != spec.size:
+                v = jnp.pad(v, (0, spec.padded - spec.size))
+            flat.append(jax.device_put(v, sh))
+        # optax state over the flat layout: param-corresponding leaves are
+        # sharded like the flat params, everything else (adam's count, any
+        # auxiliary buffers) replicated.  optax.tree_map_params knows which
+        # leaves correspond to params — no shape heuristics.
+        opt_state = self.tx.init(flat)
+        repl = NamedSharding(self.comm.mesh, P())
+        opt_state = self._map_opt_state(
+            opt_state,
+            on_param=lambda v: jax.device_put(v, sh),
+            on_other=lambda v: jax.device_put(v, repl),
+        )
+        if model_state is not None:
+            model_state = self.comm.replicate(
+                jax.tree_util.tree_map(jnp.array, model_state)
+            )
+        return ZeroTrainState(
+            step=jnp.zeros((), jnp.int32),
+            flat_params=flat,
+            opt_state=opt_state,
+            model_state=model_state,
+        )
+
+    def _map_opt_state(self, opt_state, on_param, on_other):
+        """Apply ``on_param`` to state leaves that correspond to params and
+        ``on_other`` to the rest (count scalars, schedule buffers, ...)."""
+        marker = object()
+        marked = optax.tree_map_params(self.tx, lambda _: marker, opt_state)
+        flat_m, treedef = jax.tree_util.tree_flatten(
+            marked, is_leaf=lambda x: x is marker
+        )
+        flat_s = jax.tree_util.tree_leaves(opt_state)
+        assert len(flat_m) == len(flat_s), "tree_map_params changed structure"
+        out = [
+            on_param(v) if m is marker else on_other(v)
+            for m, v in zip(flat_m, flat_s)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # ------------------------------------------------------------ reassembly
+    def _unflatten(self, flat_leaves) -> Any:
+        out = []
+        for v, spec in zip(flat_leaves, self._leafspecs):
+            out.append(v[: spec.size].reshape(spec.shape))
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def materialize_params(self, state: ZeroTrainState) -> Any:
+        """Full (replicated-layout) parameter pytree from the sharded state."""
+        return self._unflatten(state.flat_params)
+
+    # ----------------------------------------------------------- train step
+    def make_train_step(
+        self,
+        loss_fn: Callable,
+        has_aux: bool = False,
+        stateful: bool = False,
+        donate: bool = True,
+    ) -> Callable:
+        comm = self.comm
+        axes = comm.axes
+        tx = self.tx
+        n = self._n
+        specs = self._leafspecs
+        if specs is None:
+            raise RuntimeError("call init() before make_train_step()")
+        wire = getattr(comm, "allreduce_grad_dtype", None)
+
+        def gather_full(flat_local):
+            """Local (k,) slices → full param pytree (device-varying)."""
+            full = [
+                lax.all_gather(v, axes, axis=0, tiled=True)
+                for v in flat_local
+            ]
+            return self._unflatten(full)
+
+        def scatter_grads(grads):
+            """Full grad pytree → mean-reduced local (k,) slices (the
+            reduce-scatter half of the allreduce; wire dtype honored with
+            the 1/N division fused into the cast-back)."""
+            leaves = jax.tree_util.tree_leaves(grads)
+            out = []
+            for g, spec in zip(leaves, specs):
+                v = g.reshape(-1)
+                if spec.padded != spec.size:
+                    v = jnp.pad(v, (0, spec.padded - spec.size))
+                v = v.reshape(n, spec.padded // n)
+                if wire is not None and v.dtype != wire:
+                    r = lax.psum_scatter(
+                        v.astype(wire), axes, scatter_dimension=0,
+                        tiled=False,
+                    )
+                    r = (r.astype(g.dtype) / n).astype(g.dtype)
+                else:
+                    r = lax.psum_scatter(
+                        v, axes, scatter_dimension=0, tiled=False
+                    ) / n
+                out.append(r)
+            return out
+
+        def body(state: ZeroTrainState, batch):
+            params = gather_full(state.flat_params)
+            new_model_state = state.model_state
+            if stateful:
+                (loss, (aux, new_model_state)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, state.model_state, batch)
+            elif has_aux:
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, batch)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                aux = {}
+            g_local = scatter_grads(grads)
+            p_local = state.flat_params
+            updates, opt_state = tx.update(g_local, state.opt_state, p_local)
+            p_local = optax.apply_updates(p_local, updates)
+            metrics = {"loss": lax.pmean(loss, comm.axis_name)}
+            for k_, v_ in aux.items():
+                metrics[k_] = lax.pmean(v_, comm.axis_name)
+            return (
+                ZeroTrainState(
+                    step=state.step + 1,
+                    flat_params=p_local,
+                    opt_state=opt_state,
+                    model_state=new_model_state,
+                ),
+                metrics,
+            )
+
+        flat_spec = [P(axes) for _ in specs]
+        opt_spec = self._map_opt_state(
+            jax.eval_shape(lambda: tx.init(
+                [jnp.zeros((s.padded,), s.dtype) for s in specs]
+            )),
+            on_param=lambda _: P(axes),
+            on_other=lambda _: P(),
+        )
+        state_spec = ZeroTrainState(
+            step=P(), flat_params=flat_spec, opt_state=opt_spec,
+            model_state=P(),
+        )
+        mapped = jax.shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=(state_spec, P(axes)),
+            out_specs=(state_spec, P()),
+            check_vma=True,
+        )
+        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+    # --------------------------------------------------------------- update
+    def update(
+        self,
+        state: ZeroTrainState,
+        batch: Any,
+        loss_fn: Callable,
+        has_aux: bool = False,
+        stateful: bool = False,
+    ) -> Tuple[ZeroTrainState, dict]:
+        """Eager-style API mirroring ``MultiNodeOptimizer.update`` (the
+        ``training.Trainer`` contract): caches the jitted step per loss_fn
+        and serializes steps on the CPU simulation mesh (XLA:CPU in-process
+        collective rendezvous can deadlock under async dispatch)."""
+        key = (id(loss_fn), has_aux, stateful)
+        if not hasattr(self, "_step_cache"):
+            self._step_cache = {}
+        step = self._step_cache.get(key)
+        if step is None:
+            step = self._step_cache[key] = self.make_train_step(
+                loss_fn, has_aux, stateful
+            )
+        out = step(state, self.comm.shard_batch(batch))
+        try:
+            on_cpu = jax.devices()[0].platform == "cpu"
+        except Exception:
+            on_cpu = False
+        if on_cpu:
+            jax.block_until_ready(out[0])
+        return out
+
+
+def create_zero_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+    communicator: XlaCommunicator,
+) -> ZeroMultiNodeOptimizer:
+    """Factory mirroring ``create_multi_node_optimizer`` for the sharded-
+    state tier (no reference analog — ChainerMN replicated everything)."""
+    return ZeroMultiNodeOptimizer(actual_optimizer, communicator)
