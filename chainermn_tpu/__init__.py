@@ -47,8 +47,11 @@ from chainermn_tpu.iterators import (  # noqa: E402
 from chainermn_tpu.optimizers import (  # noqa: E402
     MultiNodeOptimizer,
     TrainState,
+    ZeroMultiNodeOptimizer,
+    ZeroTrainState,
     create_multi_node_optimizer,
     create_zero_optimizer,
+    zero_clip_by_global_norm,
 )
 
 __all__ = [
@@ -67,6 +70,9 @@ __all__ = [
     "links",
     "create_multi_node_optimizer",
     "create_zero_optimizer",
+    "ZeroMultiNodeOptimizer",
+    "ZeroTrainState",
+    "zero_clip_by_global_norm",
     "MultiNodeOptimizer",
     "TrainState",
     "create_multi_node_evaluator",

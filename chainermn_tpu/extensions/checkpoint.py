@@ -109,11 +109,25 @@ class MultiNodeCheckpointer(Extension):
         }
         restored = self._mngr.restore(step, args=ocp.args.StandardRestore(template))
         new_state = restored["train_state"]
-        # Re-place on the communicator's mesh: orbax may hand back leaves with
-        # mixed placements (single-device scalars vs mesh-replicated params),
-        # which jit rejects.
-        if hasattr(self.comm, "replicate"):
-            new_state = self.comm.replicate(new_state)
+        # Re-place on the communicator's mesh, honoring each INPUT leaf's
+        # sharding (ZeRO states carry 1/N shards — blanket replication would
+        # momentarily materialize N full copies).  Orbax may hand back leaves
+        # with mixed placements (single-device scalars vs mesh arrays), which
+        # jit rejects; leaves whose input sharding is unknown replicate.
+        from jax.sharding import NamedSharding
+
+        def _replace(restored_leaf, input_leaf):
+            sh = getattr(input_leaf, "sharding", None)
+            # Only mesh shardings count — single-device placements (fresh
+            # uncommitted scalars like `step`) must re-replicate or jit sees
+            # mixed device sets.
+            if isinstance(sh, NamedSharding):
+                return jax.device_put(restored_leaf, sh)
+            if hasattr(self.comm, "replicate"):
+                return self.comm.replicate(restored_leaf)
+            return restored_leaf
+
+        new_state = jax.tree_util.tree_map(_replace, new_state, state)
         loop = restored["loop"]
         if trainer is not None:
             trainer.state = new_state

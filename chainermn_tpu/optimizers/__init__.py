@@ -225,30 +225,32 @@ class MultiNodeOptimizer:
     ) -> Tuple[TrainState, dict]:
         """Eager-style API mirroring ``_MultiNodeOptimizer.update``: caches the
         jitted step per ``loss_fn``."""
-        key = (id(loss_fn), has_aux, stateful)
-        step = self._step_cache.get(key)
-        if step is None:
-            step = self._step_cache[key] = self.make_train_step(
-                loss_fn, has_aux, stateful
-            )
-        if isinstance(self.comm, XlaCommunicator):
-            batch = self.comm.shard_batch(batch)
-        out = step(state, batch)
-        if self._serialize_steps():
-            # XLA:CPU's in-process collective rendezvous can deadlock when
-            # launches overlap across the virtual device pool (timing races
-            # observed with multiple compiled shapes in flight).  The CPU
-            # mesh exists only to SIMULATE a pod, so serialize there; real
-            # TPU/GPU paths keep async dispatch and compiler overlap.
-            jax.block_until_ready(out[0])
-        return out
+        return _eager_update(self, state, batch, loss_fn, has_aux, stateful)
 
-    @staticmethod
-    def _serialize_steps() -> bool:
-        try:
-            return jax.devices()[0].platform == "cpu"
-        except Exception:
-            return False
+
+def _eager_update(opt, state, batch, loss_fn, has_aux, stateful):
+    """Shared eager-style update: cache the jitted step per (loss_fn, flags)
+    — keyed by the FUNCTION OBJECT (holding a reference), not ``id()``,
+    which can be recycled after gc — and serialize steps on the CPU
+    simulation mesh: XLA:CPU's in-process collective rendezvous can
+    deadlock when launches overlap across the virtual device pool.  The CPU
+    mesh exists only to SIMULATE a pod; real TPU/GPU paths keep async
+    dispatch and compiler overlap."""
+    key = (loss_fn, has_aux, stateful)
+    step = opt._step_cache.get(key)
+    if step is None:
+        step = opt._step_cache[key] = opt.make_train_step(
+            loss_fn, has_aux, stateful
+        )
+    batch = opt.comm.shard_batch(batch)
+    out = step(state, batch)
+    try:
+        on_cpu = jax.devices()[0].platform == "cpu"
+    except Exception:
+        on_cpu = False
+    if on_cpu:
+        jax.block_until_ready(out[0])
+    return out
 
 
 def create_multi_node_optimizer(
@@ -316,4 +318,5 @@ from chainermn_tpu.optimizers.zero import (  # noqa: E402
     ZeroMultiNodeOptimizer,
     ZeroTrainState,
     create_zero_optimizer,
+    zero_clip_by_global_norm,
 )

@@ -64,6 +64,12 @@ class ZeroMultiNodeOptimizer:
     Same ``loss_fn`` contract as :class:`MultiNodeOptimizer`; the state it
     carries is sharded, so use :meth:`materialize_params` to obtain the full
     parameter pytree (eval, checkpoint interchange, export).
+
+    The inner transform runs on LOCAL shards, which is exact for
+    element-wise transforms (sgd, momentum, adam[w], rmsprop, weight decay)
+    — the overwhelmingly common case — but NOT for transforms with
+    cross-leaf statistics: ``optax.clip_by_global_norm`` would clip by
+    per-shard norms.  Use :func:`zero_clip_by_global_norm` for that.
     """
 
     def __init__(
@@ -77,6 +83,7 @@ class ZeroMultiNodeOptimizer:
         self.comm = communicator
         self._leafspecs = None
         self._treedef = None
+        self._step_cache: dict = {}
 
     # ---------------------------------------------------------------- layout
     @property
@@ -272,25 +279,45 @@ class ZeroMultiNodeOptimizer:
         stateful: bool = False,
     ) -> Tuple[ZeroTrainState, dict]:
         """Eager-style API mirroring ``MultiNodeOptimizer.update`` (the
-        ``training.Trainer`` contract): caches the jitted step per loss_fn
-        and serializes steps on the CPU simulation mesh (XLA:CPU in-process
-        collective rendezvous can deadlock under async dispatch)."""
-        key = (id(loss_fn), has_aux, stateful)
-        if not hasattr(self, "_step_cache"):
-            self._step_cache = {}
-        step = self._step_cache.get(key)
-        if step is None:
-            step = self._step_cache[key] = self.make_train_step(
-                loss_fn, has_aux, stateful
-            )
-        out = step(state, self.comm.shard_batch(batch))
-        try:
-            on_cpu = jax.devices()[0].platform == "cpu"
-        except Exception:
-            on_cpu = False
-        if on_cpu:
-            jax.block_until_ready(out[0])
-        return out
+        ``training.Trainer`` contract)."""
+        from chainermn_tpu.optimizers import _eager_update
+
+        return _eager_update(self, state, batch, loss_fn, has_aux, stateful)
+
+
+def zero_clip_by_global_norm(max_norm: float, communicator) -> optax.GradientTransformation:
+    """Global-norm clipping that is correct under ZeRO sharding.
+
+    ``optax.clip_by_global_norm`` computes the norm of the leaves it sees —
+    under :class:`ZeroMultiNodeOptimizer` those are 1/N LOCAL shards, so it
+    would clip by per-shard norms and silently diverge from the replicated
+    optimizer.  This transform ``psum``\ s the squared norm over the
+    communicator's axes (it runs inside the jitted sharded step, where the
+    axis names are bound), reproducing the exact global norm.  Use instead
+    of — never together with — the optax version when building the ``tx``
+    for :func:`create_zero_optimizer`; with the replicated optimizer plain
+    ``optax.clip_by_global_norm`` is already exact."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        local_sq = sum(
+            jnp.sum(jnp.square(u.astype(jnp.float32)))
+            for u in jax.tree_util.tree_leaves(updates)
+        )
+        global_norm = jnp.sqrt(
+            lax.psum(local_sq, communicator.axis_name)
+        )
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(global_norm, 1e-16))
+        return (
+            jax.tree_util.tree_map(lambda u: (u * scale).astype(u.dtype), updates),
+            state,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def create_zero_optimizer(

@@ -23,7 +23,7 @@ def run_spmd(comm, body, *args, in_specs=None, out_specs=P()):
     if in_specs is None:
         in_specs = tuple(P(comm.axes) for _ in args)
     f = jax.jit(
-        comm.spmd(body, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+        comm.spmd(body, in_specs=in_specs, out_specs=out_specs, check_vma=True)
     )
     return f(*args)
 
@@ -55,7 +55,7 @@ def test_send_recv_gradient(comm):
             return jax.lax.psum(contrib, comm.axis_name)
 
         return jnp.sum(
-            comm.spmd(body, in_specs=P(comm.axes), out_specs=P(), check_vma=False)(x)
+            comm.spmd(body, in_specs=P(comm.axes), out_specs=P(), check_vma=True)(x)
         )
 
     g = np.asarray(jax.grad(loss)(x))
@@ -109,7 +109,7 @@ def test_alltoall_forward_backward(comm):
             return jax.lax.psum(contrib, comm.axis_name)
 
         return jnp.sum(
-            comm.spmd(body, in_specs=P(comm.axes), out_specs=P(), check_vma=False)(
+            comm.spmd(body, in_specs=P(comm.axes), out_specs=P(), check_vma=True)(
                 x.reshape(8, 8, 1)
             )
         )
@@ -146,7 +146,7 @@ def test_bcast_forward_and_gradient(comm):
             return jax.lax.psum(jnp.sum(y), comm.axis_name)
 
         return jnp.sum(
-            comm.spmd(body, in_specs=P(comm.axes), out_specs=P(), check_vma=False)(x)
+            comm.spmd(body, in_specs=P(comm.axes), out_specs=P(), check_vma=True)(x)
         )
 
     g = np.asarray(jax.grad(loss)(x))

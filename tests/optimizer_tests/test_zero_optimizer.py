@@ -123,3 +123,37 @@ def test_zero_wire_dtype_close_to_fp32(devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-2, rtol=5e-2
         )
+
+
+def test_zero_global_norm_clip_matches_oracle(devices):
+    """zero_clip_by_global_norm under sharding == optax.clip_by_global_norm
+    single-device (plain optax clip would use per-shard norms and diverge)."""
+    comm, model, params, loss_fn = _setup(devices)
+    max_norm = 0.05  # small enough that clipping actually engages
+    tx_sharded = optax.chain(
+        cmn.zero_clip_by_global_norm(max_norm, comm), optax.sgd(0.1)
+    )
+    tx_oracle = optax.chain(optax.clip_by_global_norm(max_norm), optax.sgd(0.1))
+
+    opt = cmn.create_zero_optimizer(tx_sharded, comm)
+    state = opt.init(params)
+    step = opt.make_train_step(loss_fn, has_aux=True)
+
+    batches = _batches(5, 64)
+    oparams, oopt = params, tx_oracle.init(params)
+    for b in batches:
+        (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(oparams, b)
+        up, oopt = tx_oracle.update(grads, oopt, oparams)
+        oparams = optax.apply_updates(oparams, up)
+
+    for b in batches:
+        state, _ = step(state, comm.shard_batch(b))
+        jax.block_until_ready(state)
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(opt.materialize_params(state)),
+        jax.tree_util.tree_leaves(oparams),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-6, rtol=3e-6
+        )
