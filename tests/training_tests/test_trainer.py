@@ -1,0 +1,90 @@
+"""Trainer loop and extension-trigger tests (the reference delegates this to
+Chainer's Trainer; SURVEY.md §1 'Training integration' row)."""
+
+import json
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.datasets import make_synthetic_classification
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models import MLP, classification_loss
+from chainermn_tpu.training import (
+    Extension,
+    LogReport,
+    ProgressBar,
+    Trainer,
+    make_extension,
+)
+
+
+def _trainer(devices, stop=(2, "epoch"), n=512, bs=128):
+    comm = cmn.create_communicator("xla", devices=devices)
+    ds = cmn.scatter_dataset(
+        make_synthetic_classification(n, 32, 10, seed=3), comm
+    )
+    model = MLP(hidden=(16,), n_out=10)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 32), np.float32))[
+        "params"
+    ]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    return Trainer(
+        opt, opt.init(params), classification_loss(model),
+        SerialIterator(ds, bs, shuffle=True, seed=0),
+        stop=stop, has_aux=True,
+    )
+
+
+def test_stop_triggers(devices):
+    tr = _trainer(devices, stop=(3, "epoch"), n=512, bs=128)
+    tr.run()
+    assert tr.epoch == 3
+    assert tr.iteration == 3 * (512 // 128)
+
+    tr = _trainer(devices, stop=(5, "iteration"))
+    tr.run()
+    assert tr.iteration == 5
+
+
+def test_extension_fire_counts(devices):
+    fires = {"epoch": 0, "it2": 0}
+    tr = _trainer(devices, stop=(2, "epoch"), n=512, bs=128)
+
+    @make_extension(trigger=(1, "epoch"))
+    def per_epoch(t):
+        fires["epoch"] += 1
+
+    @make_extension(trigger=(2, "iteration"))
+    def per_2it(t):
+        fires["it2"] += 1
+
+    tr.extend(per_epoch)
+    tr.extend(per_2it)
+    tr.run()
+    assert fires["epoch"] == 2  # one per epoch
+    assert fires["it2"] == (2 * (512 // 128)) // 2
+
+
+def test_logreport_writes_json(devices, tmp_path):
+    out = tmp_path / "log.json"
+    tr = _trainer(devices, stop=(2, "epoch"))
+    tr.extend(LogReport(trigger=(1, "epoch"), out=str(out), print_report=False))
+    tr.run()
+    log = json.loads(out.read_text())
+    assert len(log) == 2
+    assert {"epoch", "iteration", "elapsed_time", "loss"} <= set(log[0])
+    # losses are finite floats, not device arrays
+    assert all(np.isfinite(e["loss"]) for e in log)
+
+
+def test_progressbar_smoke(devices, capsys):
+    tr = _trainer(devices, stop=(1, "epoch"))
+    tr.extend(ProgressBar(update_interval=1))
+    tr.run()
+    err = capsys.readouterr().err
+    assert "it/s" in err
+    assert err.endswith("\n")  # finalize closed the \r line

@@ -1,0 +1,57 @@
+"""Utility-layer tests: honest benchmarking sync, pvary compat, tracing."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.utils import benchmark, pvary, sync, trace
+
+
+def test_sync_blocks_on_tree():
+    x = {"a": jnp.ones((8, 8)), "b": [jnp.zeros((2,))]}
+    sync(x)  # must not raise; values materialized
+
+
+def test_benchmark_returns_positive_seconds():
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((64, 64))
+    res = benchmark(f, x, iters=3, warmup=1)
+    assert res["mean_s"] > 0
+    assert res["min_s"] <= res["mean_s"] <= res["max_s"]
+
+
+def test_pvary_outside_shard_map_is_identity():
+    x = jnp.arange(4.0)
+    y = pvary(x, ())  # no axes: trivially fine everywhere
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_pvary_inside_checked_shard_map(devices):
+    from jax.sharding import PartitionSpec as P
+    import chainermn_tpu as cmn
+
+    comm = cmn.create_communicator("xla", devices=devices)
+
+    def body(b):
+        z = pvary(jnp.zeros((4,)), comm.axes)  # invariant → varying
+        return z + b.sum()
+
+    out = jax.jit(
+        comm.spmd(body, in_specs=P(comm.axes), out_specs=P(comm.axes),
+                  check_vma=True)
+    )(jnp.ones((8, 2)))
+    assert out.shape == (32,)  # per-rank (4,) stacked over the 8 ranks
+
+
+def test_trace_writes_profile(tmp_path):
+    with trace(str(tmp_path)):
+        jax.block_until_ready(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
+    # jax profiler writes a plugins/profile dir
+    found = []
+    for root, dirs, files in os.walk(tmp_path):
+        found += files
+    assert found, "trace produced no profile artifacts"
