@@ -5,7 +5,6 @@ import json
 
 import numpy as np
 import optax
-import pytest
 
 import jax
 

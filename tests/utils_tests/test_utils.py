@@ -3,7 +3,6 @@
 import os
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
@@ -50,8 +49,8 @@ def test_pvary_inside_checked_shard_map(devices):
 def test_trace_writes_profile(tmp_path):
     with trace(str(tmp_path)):
         jax.block_until_ready(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
-    # jax profiler writes a plugins/profile dir
-    found = []
+    # jax profiler writes plugins/profile/<run>/*.xplane.pb
+    xplanes = []
     for root, dirs, files in os.walk(tmp_path):
-        found += files
-    assert found, "trace produced no profile artifacts"
+        xplanes += [f for f in files if f.endswith(".xplane.pb")]
+    assert xplanes, "trace produced no xplane profile artifact"
