@@ -227,20 +227,22 @@ class XlaCommunicator(CommunicatorBase):
         Same placement as rankwise layout (leading dim split over our axes)."""
         return self.shard_rankwise(tree)
 
+    def place(self, x: Any, sharding: NamedSharding) -> Any:
+        """Place one host array onto the mesh with ``sharding``.  The caller
+        must hold the full (host-identical) value; under multi-process the
+        global array is assembled from local slices via
+        ``make_array_from_callback`` (``device_put`` with a multi-host
+        sharding is not allowed)."""
+        if self._nproc > 1:
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                np.shape(x), sharding, lambda idx: x[idx]
+            )
+        return jax.device_put(x, sharding)
+
     def replicate(self, tree: Any) -> Any:
         sh = NamedSharding(self._mesh, P())
-
-        def put(x):
-            if self._nproc > 1:
-                # Every process holds the full (identical) value; assemble the
-                # globally-replicated array from local shards.
-                x = np.asarray(x)
-                return jax.make_array_from_callback(
-                    np.shape(x), sh, lambda idx: x[idx]
-                )
-            return jax.device_put(x, sh)
-
-        return jax.tree_util.tree_map(put, tree)
+        return jax.tree_util.tree_map(lambda x: self.place(x, sh), tree)
 
     def tile_rankwise(self, tree: Any) -> Any:
         """Stack ``size`` copies of a local pytree into rankwise layout."""

@@ -84,6 +84,11 @@ class ZeroMultiNodeOptimizer:
         self._leafspecs = None
         self._treedef = None
         self._step_cache: dict = {}
+        # One cached gather (re-created lambdas would re-trace per call).
+        self._gather_replicated = jax.jit(
+            lambda v: v,
+            out_shardings=NamedSharding(self.comm.mesh, P()),
+        )
 
     # ---------------------------------------------------------------- layout
     @property
@@ -114,20 +119,29 @@ class ZeroMultiNodeOptimizer:
         leaves = jax.tree_util.tree_leaves(params)
         flat = []
         for leaf, spec in zip(leaves, self._leafspecs):
-            v = jnp.ravel(jnp.asarray(leaf))
+            v = np.asarray(leaf).ravel()
             if spec.padded != spec.size:
-                v = jnp.pad(v, (0, spec.padded - spec.size))
-            flat.append(jax.device_put(v, sh))
+                v = np.pad(v, (0, spec.padded - spec.size))
+            flat.append(self.comm.place(v, sh))
         # optax state over the flat layout: param-corresponding leaves are
         # sharded like the flat params, everything else (adam's count, any
         # auxiliary buffers) replicated.  optax.tree_map_params knows which
         # leaves correspond to params — no shape heuristics.
+        # tx.init builds its param-shaped leaves with zeros_like over the
+        # ALREADY-SHARDED flat params, so those inherit the 1/N placement on
+        # any host count; only fresh non-param leaves (adam's count) need
+        # explicit replication.
         opt_state = self.tx.init(flat)
-        repl = NamedSharding(self.comm.mesh, P())
         opt_state = self._map_opt_state(
             opt_state,
-            on_param=lambda v: jax.device_put(v, sh),
-            on_other=lambda v: jax.device_put(v, repl),
+            # Leaves that inherited the exact 1/N sharding stay; anything
+            # else (a transform that built fresh zeros, or a wrong spec) is
+            # re-placed through the communicator's multi-host-safe path.
+            on_param=lambda v: (
+                v if getattr(v, "sharding", None) == sh
+                else self.comm.place(np.asarray(jax.device_get(v)), sh)
+            ),
+            on_other=self.comm.replicate,
         )
         if model_state is not None:
             model_state = self.comm.replicate(
@@ -164,8 +178,15 @@ class ZeroMultiNodeOptimizer:
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
     def materialize_params(self, state: ZeroTrainState) -> Any:
-        """Full (replicated-layout) parameter pytree from the sharded state."""
-        return self._unflatten(state.flat_params)
+        """Full (replicated-layout) parameter pytree from the sharded state.
+
+        Re-places each flat leaf replicated first (XLA inserts the gather):
+        host-side slicing of a cross-host sharded array is not addressable
+        under multi-process, and the callers of this method (eval, export,
+        checkpoint interchange) want replicated values anyway."""
+        return self._unflatten(
+            [self._gather_replicated(v) for v in state.flat_params]
+        )
 
     # ----------------------------------------------------------- train step
     def make_train_step(
