@@ -266,7 +266,18 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     _mark(f"client up: {platform} x{n_dev}, per_chip_batch={per_chip_batch}")
     comm = cmn.create_communicator("xla", allreduce_grad_dtype=jnp.bfloat16)
     model = ResNet50(num_classes=1000, axis_name=comm.axis_name)
-    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1, momentum=0.9), comm)
+    # CMN_BENCH_OPT=zero benchmarks the sharded-state tier (reduce-scatter
+    # grads + 1/N opt state + param all-gather) instead of the replicated
+    # optimizer — same numerics, different memory/traffic profile.
+    opt_kind = os.environ.get("CMN_BENCH_OPT", "replicated")
+    if opt_kind not in ("replicated", "zero"):
+        _fail(f"CMN_BENCH_OPT={opt_kind!r}: expected 'replicated' or 'zero'")
+    if opt_kind == "zero":
+        opt = cmn.create_zero_optimizer(optax.sgd(0.1, momentum=0.9), comm)
+    else:
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1, momentum=0.9), comm
+        )
 
     rng = jax.random.PRNGKey(0)
     x1 = jnp.ones((1, image_size, image_size, 3), jnp.float32)
@@ -325,6 +336,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         "device_kind": device_kind,
         "n_devices": n_dev,
         "per_chip_batch": per_chip_batch,
+        "optimizer": opt_kind,
         "global_batch": global_batch,
         "image_size": image_size,
         "iters": iters,

@@ -312,7 +312,7 @@ def zero_clip_by_global_norm(max_norm: float, communicator) -> optax.GradientTra
     ``optax.clip_by_global_norm`` computes the norm of the leaves it sees —
     under :class:`ZeroMultiNodeOptimizer` those are 1/N LOCAL shards, so it
     would clip by per-shard norms and silently diverge from the replicated
-    optimizer.  This transform ``psum``\ s the squared norm over the
+    optimizer.  This transform psums the squared norm over the
     communicator's axes (it runs inside the jitted sharded step, where the
     axis names are bound), reproducing the exact global norm.  Use instead
     of — never together with — the optax version when building the ``tx``

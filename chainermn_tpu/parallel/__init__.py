@@ -10,6 +10,9 @@ first-class citizens:
   Attention; flash-style online softmax).
 * :mod:`ulysses` — all-to-all sequence parallelism (DeepSpeed-Ulysses style):
   re-shard sequence↔heads with ``all_to_all`` around any local attention.
+* :mod:`zigzag` — load-balanced CAUSAL context parallelism: rank i owns
+  sequence chunks (i, 2S-1-i), equalizing causal work across the ring
+  (the plain ring leaves ~half the flops idle under causal masking).
 * :mod:`moe` — expert parallelism: capacity-based top-k token dispatch over an
   ``expert`` mesh axis via ``all_to_all`` (built on the same primitive the
   reference exposed as ``chainermn.functions.alltoall``).
@@ -21,6 +24,12 @@ from chainermn_tpu.parallel.ring_attention import (
     ring_self_attention,
 )
 from chainermn_tpu.parallel.ulysses import ulysses_attention
+from chainermn_tpu.parallel.zigzag import (
+    zigzag_attention,
+    zigzag_ring_self_attention,
+    zigzag_shard,
+    zigzag_unshard,
+)
 from chainermn_tpu.parallel.moe import MoELayer, moe_combine, moe_dispatch
 
 __all__ = [
@@ -28,6 +37,10 @@ __all__ = [
     "ring_flash_self_attention",
     "ring_self_attention",
     "ulysses_attention",
+    "zigzag_attention",
+    "zigzag_ring_self_attention",
+    "zigzag_shard",
+    "zigzag_unshard",
     "moe_dispatch",
     "moe_combine",
     "MoELayer",

@@ -1,0 +1,191 @@
+"""Zigzag ring attention — load-balanced causal context parallelism.
+
+Plain causal ring attention (:func:`~chainermn_tpu.parallel.ring_attention.
+ring_self_attention`) is imbalanced: with contiguous sequence shards, rank
+``i``'s queries attend only ranks ``≤ i``, so the last rank does ``S``
+block-attends while rank 0 does one — the ring's wall-clock is set by the
+busiest rank and ~half the flops sit idle.
+
+The zigzag layout (the context-parallel schedule used by modern long-context
+trainers) splits the sequence into ``2S`` chunks and gives rank ``i`` the
+PAIR ``(i, 2S-1-i)`` — one early chunk and one late chunk.  Causal work per
+rank becomes exactly equal: rank ``i`` must attend ``(i+1) + (2S-i) = 2S+1``
+chunk-pairs regardless of ``i``.  Each ring step attends the needed
+quadrants of the visiting K/V pair under ``lax.cond`` (fully-masked
+quadrants are skipped, not computed-and-discarded), with the same
+online-softmax accumulator as the plain ring.
+
+Data layout helpers :func:`zigzag_shard` / :func:`zigzag_unshard` reorder
+the global sequence axis between contiguous and zigzag order host-side (or
+under jit) — the attention output is returned in the SAME zigzag layout the
+inputs arrived in, so a transformer block can stay entirely in zigzag order
+and only un-shuffle at the loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from chainermn_tpu.parallel.ring_attention import _block_attend
+from chainermn_tpu.utils import pvary
+
+
+def zigzag_order(S: int) -> np.ndarray:
+    """Chunk indices in zigzag order: rank i owns chunks (i, 2S-1-i)."""
+    out = []
+    for i in range(S):
+        out += [i, 2 * S - 1 - i]
+    return np.asarray(out)
+
+
+def zigzag_shard(x: jax.Array, S: int, axis: int = 1) -> jax.Array:
+    """Reorder a contiguous global sequence axis into zigzag layout.
+
+    ``x``'s ``axis`` (length T, with ``T % 2S == 0``) is split into ``2S``
+    chunks and permuted so that chunk-pair ``(i, 2S-1-i)`` is contiguous —
+    shard ``i`` of the result (under a ``P(..., 'seq', ...)`` sharding) holds
+    exactly rank i's zigzag pair."""
+    T = x.shape[axis]
+    if T % (2 * S):
+        raise ValueError(f"seq len {T} must divide into 2*{S} chunks")
+    c = T // (2 * S)
+    parts = jnp.split(x, 2 * S, axis=axis)
+    return jnp.concatenate([parts[j] for j in zigzag_order(S)], axis=axis)
+
+
+def zigzag_unshard(x: jax.Array, S: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_shard`."""
+    T = x.shape[axis]
+    if T % (2 * S):
+        raise ValueError(f"seq len {T} must divide into 2*{S} chunks")
+    order = zigzag_order(S)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(2 * S)
+    parts = jnp.split(x, 2 * S, axis=axis)
+    return jnp.concatenate([parts[j] for j in inv], axis=axis)
+
+
+def zigzag_ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name,
+    remat: bool = True,
+) -> jax.Array:
+    """Causal self-attention over a ZIGZAG-sharded sequence.
+
+    Call inside ``shard_map``; ``q``/``k``/``v`` are the local
+    ``(B, 2c, H, D)`` zigzag pairs (first half = early chunk ``my``, second
+    half = late chunk ``2S-1-my``).  Returns the local output block in the
+    same layout.  Always causal — the balanced schedule is only meaningful
+    under causal masking (full attention is already balanced on the plain
+    ring)."""
+    B, T2, H, D = q.shape
+    if T2 % 2:
+        raise ValueError("local zigzag block must hold an even chunk pair")
+    c = T2 // 2
+    S = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def chunk_ids(rank):
+        return rank, 2 * S - 1 - rank  # (early, late) global chunk index
+
+    def split(x):
+        return x[:, :c], x[:, c:]
+
+    def attend_pair(qc, q_id, kc, vc, k_id, m, l, o):
+        """Attend one (q_chunk, k_chunk) quadrant under the chunk-level
+        causal structure; skipped entirely when the quadrant is fully
+        masked.  All three cases keep the same static shapes."""
+        rel = jnp.arange(c)[:, None] - jnp.arange(c)[None, :]
+        diag_mask = rel >= 0
+
+        def full():
+            return _block_attend(qc, kc, vc, m, l, o, None)
+
+        def diag():
+            return _block_attend(qc, kc, vc, m, l, o, diag_mask)
+
+        def skip():
+            return m, l, o
+
+        return lax.cond(
+            q_id > k_id,
+            full,
+            lambda: lax.cond(q_id == k_id, diag, skip),
+        )
+
+    def attend_block(k_blk, v_blk, src, acc):
+        """Attend all needed quadrants of the visiting rank's pair."""
+        (m_e, l_e, o_e), (m_l, l_l, o_l) = acc
+        q_e, q_l = split(q)
+        k_e, k_l = split(k_blk)
+        v_e, v_l = split(v_blk)
+        my_e, my_l = chunk_ids(my)
+        src_e, src_l = chunk_ids(src)
+        for kc, vc, k_id in ((k_e, v_e, src_e), (k_l, v_l, src_l)):
+            m_e, l_e, o_e = attend_pair(q_e, my_e, kc, vc, k_id, m_e, l_e, o_e)
+            m_l, l_l, o_l = attend_pair(q_l, my_l, kc, vc, k_id, m_l, l_l, o_l)
+        return (m_e, l_e, o_e), (m_l, l_l, o_l)
+
+    def fresh():
+        m0 = pvary(jnp.full((B, H, c), -jnp.inf, jnp.float32), axis_name)
+        l0 = pvary(jnp.zeros((B, H, c), jnp.float32), axis_name)
+        o0 = pvary(jnp.zeros((B, c, H, D), jnp.float32), axis_name)
+        return m0, l0, o0
+
+    def body(carry, step):
+        k_cur, v_cur, acc_e, acc_l = carry
+        src = (my - step) % S
+        acc_e, acc_l = attend_block(k_cur, v_cur, src, (acc_e, acc_l))
+        k_nxt = lax.ppermute(k_cur, axis_name, perm=perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm=perm)
+        return (k_nxt, v_nxt, acc_e, acc_l), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    (_, _, (m_e, l_e, o_e), (m_l, l_l, o_l)), _ = lax.scan(
+        body, (k, v, fresh(), fresh()), jnp.arange(S)
+    )
+
+    def finish(m, l, o):
+        l = jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+        return o / l.transpose(0, 2, 1)[..., None]
+
+    out = jnp.concatenate([finish(m_e, l_e, o_e), finish(m_l, l_l, o_l)], axis=1)
+    return out.astype(q.dtype)
+
+
+def zigzag_attention(comm, q, k, v) -> jax.Array:
+    """Eager convenience wrapper: CONTIGUOUS global ``(B, T, H, D)`` arrays
+    in, causal attention out (contiguous layout restored) — the zigzag
+    shuffle, the balanced ring, and the unshuffle in one jitted program,
+    sequence-sharded over ``comm``'s axes."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    S = comm.size
+    spec = P(None, comm.axes)
+
+    def build():
+        inner = comm.spmd(
+            partial(zigzag_ring_self_attention, axis_name=comm.axis_name),
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=True,
+        )
+
+        def run(q, k, v):
+            zq = zigzag_shard(q, S)
+            zk = zigzag_shard(k, S)
+            zv = zigzag_shard(v, S)
+            return zigzag_unshard(inner(zq, zk, zv), S)
+
+        return jax.jit(run)
+
+    return comm._jitted(("zigzag_attention",), build)(q, k, v)
