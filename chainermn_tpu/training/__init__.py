@@ -16,7 +16,7 @@ import math
 import os
 import sys
 import time
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -108,6 +108,69 @@ class LogReport(Extension):
                 os.makedirs(os.path.dirname(self._out) or ".", exist_ok=True)
                 with open(self._out, "w") as f:
                     json.dump(self.log, f, indent=1)
+
+
+class PrintReport(Extension):
+    """Prints a fixed-column table of selected LogReport entries (reference:
+    Chainer's ``PrintReport``, attached ``if comm.rank == 0``).
+
+    Reads the newest entries of the trainer's :class:`LogReport` (located
+    automatically, or pass ``log_report=``); fires on the same cadence so a
+    row appears per LogReport interval.  With a LogReport that also prints,
+    set its ``print_report=False`` to avoid double output."""
+
+    def __init__(self, entries: Sequence[str], log_report: "LogReport" = None,
+                 trigger=(1, "epoch")):
+        super().__init__(self._fire, trigger=trigger, name="PrintReport")
+        self._keys = list(entries)
+        if not self._keys:
+            raise ValueError("PrintReport needs at least one entry key")
+        self._log = log_report
+        self._shown = 0
+        self._header_done = False
+
+    def _find_log(self, trainer: "Trainer") -> Optional["LogReport"]:
+        if self._log is not None:
+            return self._log
+        for ext in trainer.extensions:
+            if isinstance(ext, LogReport):
+                return ext
+        return None
+
+    def should_fire(self, trainer: "Trainer") -> bool:
+        # Fire AFTER the LogReport regardless of registration order: the
+        # trainer walks extensions in list order, so an earlier-registered
+        # PrintReport would read log.log before this tick's entry lands
+        # (rows one interval late, final row dropped at finalize).  Instead
+        # of an ordering contract, fire whenever there are unshown entries.
+        log = self._find_log(trainer)
+        if log is not None and len(log.log) > self._shown:
+            return True
+        return False
+
+    def _fire(self, trainer: "Trainer"):
+        if jax.process_index() != 0:
+            return
+        log = self._find_log(trainer)
+        if log is None:
+            return
+        _close_progress_line()
+        width = max(12, max(len(k) for k in self._keys) + 2)
+        if not self._header_done:
+            print("".join(k.ljust(width) for k in self._keys), flush=True)
+            self._header_done = True
+        for entry in log.log[self._shown:]:
+            cells = []
+            for k in self._keys:
+                v = entry.get(k, "")
+                cells.append(
+                    (f"{v:.6g}" if isinstance(v, float) else str(v)).ljust(width)
+                )
+            print("".join(cells), flush=True)
+        self._shown = len(log.log)
+
+    def finalize(self, trainer: "Trainer"):
+        self._fire(trainer)
 
 
 class ProgressBar(Extension):

@@ -87,3 +87,35 @@ def test_progressbar_smoke(devices, capsys):
     err = capsys.readouterr().err
     assert "it/s" in err
     assert err.endswith("\n")  # finalize closed the \r line
+
+
+def test_printreport_table(devices, capsys):
+    from chainermn_tpu.training import PrintReport
+
+    tr = _trainer(devices, stop=(2, "epoch"))
+    tr.extend(LogReport(trigger=(1, "epoch"), print_report=False))
+    tr.extend(PrintReport(["epoch", "iteration", "loss"]))
+    tr.run()
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert lines[0].split()[:3] == ["epoch", "iteration", "loss"]
+    assert len(lines) == 3  # header + one row per epoch
+
+
+def test_printreport_order_independent(devices, capsys):
+    """PrintReport registered BEFORE LogReport still prints every row."""
+    from chainermn_tpu.training import PrintReport
+
+    tr = _trainer(devices, stop=(2, "epoch"))
+    tr.extend(PrintReport(["epoch", "loss"]))  # attached first
+    tr.extend(LogReport(trigger=(1, "epoch"), print_report=False))
+    tr.run()
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 3  # header + both epochs, nothing dropped
+
+
+def test_printreport_empty_keys_rejected():
+    from chainermn_tpu.training import PrintReport
+
+    with np.testing.assert_raises(ValueError):
+        PrintReport([])
