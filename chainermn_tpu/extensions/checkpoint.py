@@ -175,8 +175,14 @@ def create_multi_node_checkpointer(
     path: str = "checkpoints",
     max_to_keep: int = 5,
     trigger=(1, "epoch"),
+    async_save: bool = True,
 ) -> MultiNodeCheckpointer:
-    """Reference anchor: ``create_multi_node_checkpointer(name, comm)``."""
+    """Reference anchor: ``create_multi_node_checkpointer(name, comm)``.
+
+    ``async_save=False`` commits synchronously at the trigger — use when a
+    crash immediately after the trigger must still find that snapshot
+    complete (fault-injection tests; final pre-shutdown saves)."""
     return MultiNodeCheckpointer(
-        name, comm, path=path, max_to_keep=max_to_keep, trigger=trigger
+        name, comm, path=path, max_to_keep=max_to_keep, trigger=trigger,
+        async_save=async_save,
     )

@@ -43,7 +43,15 @@ def _global_except_hook(exctype, value, tb):
         sys.stderr.flush()
         if nproc > 1:
             # Tear the whole job down (MPI_Abort analog) — leaving peers
-            # blocked in a collective is worse than a hard exit.
+            # blocked in a collective is worse than a hard exit.  The
+            # graceful coordination-service disconnect can itself BLOCK
+            # (observed: distributed.shutdown barriers against peers that
+            # are stuck in the very collective we are aborting), so arm a
+            # watchdog first: this process dies within 2s no matter what —
+            # MPI_Abort was never graceful either.
+            import threading
+
+            threading.Timer(2.0, lambda: os._exit(1)).start()
             try:
                 import jax
 

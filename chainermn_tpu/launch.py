@@ -1,0 +1,152 @@
+"""Multi-process launcher — the ``mpiexec`` analog.
+
+Reference jobs start as ``mpiexec -n N python train.py``: the MPI runtime
+spawns the ranks, wires their bootstrap, and — crucially for fault tolerance
+— kills every rank when one calls ``MPI_Abort`` (which the global except
+hook does on an uncaught exception).  JAX has no launcher daemon; this
+module is that missing runtime piece for local/single-host multi-process
+runs (the torchrun shape):
+
+    python -m chainermn_tpu.launch --nproc 2 train.py --epochs 4
+
+It allocates the coordinator and object-plane ports, exports the bootstrap
+env (``CMN_COORDINATOR`` / ``CMN_NUM_PROCESSES`` / ``CMN_PROCESS_ID`` /
+``CMN_TPU_HOSTS`` / ``CMN_TPU_RANK``) consumed by
+:func:`chainermn_tpu.init_distributed`, and supervises the children: the
+FIRST nonzero exit tears the remaining ranks down (SIGTERM, then SIGKILL
+after a grace period) — a peer blocked in a collective whose partner died
+is exactly the deadlock the reference's ``MPI_Abort`` existed to prevent.
+
+Multi-host jobs don't launch through this (each host runs one process under
+its own supervisor and passes an explicit coordinator address); the kill-on
+-failure contract there belongs to the cluster scheduler, as it did to the
+multi-host MPI runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(
+    nproc: int,
+    argv: list,
+    grace_s: float = 10.0,
+    env_extra: dict = None,
+) -> int:
+    """Spawn ``nproc`` ranks of ``argv``; return the job's exit code
+    (0 iff every rank exited 0).  On the first nonzero exit the remaining
+    ranks are terminated."""
+    coord = _free_port()
+    hc_ports = [_free_port() for _ in range(nproc)]
+    hosts = ",".join(f"127.0.0.1:{p}" for p in hc_ports)
+
+    procs = []
+    for pid in range(nproc):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update(
+            {
+                "CMN_COORDINATOR": f"127.0.0.1:{coord}",
+                "CMN_NUM_PROCESSES": str(nproc),
+                "CMN_PROCESS_ID": str(pid),
+                "CMN_TPU_HOSTS": hosts,
+                "CMN_TPU_RANK": str(pid),
+            }
+        )
+        # Own session per rank so the launcher can kill a rank's whole
+        # process tree, and ranks never receive the terminal's signals.
+        procs.append(
+            subprocess.Popen(
+                [sys.executable] + argv, env=env, start_new_session=True
+            )
+        )
+
+    def _killall(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except Exception:
+                    p.kill()
+        if signum is not None:
+            sys.exit(128 + signum)
+
+    # The launcher itself being terminated must not orphan the ranks (they
+    # would hold inherited pipes open and hang the parent harness).
+    prev_term = signal.signal(signal.SIGTERM, _killall)
+    prev_int = signal.signal(signal.SIGINT, _killall)
+
+    failed_code = None
+    try:
+        while True:
+            running = [p for p in procs if p.poll() is None]
+            for p in procs:
+                rc = p.poll()
+                if rc is not None and rc != 0 and failed_code is None:
+                    failed_code = rc
+                    sys.stderr.write(
+                        f"[chainermn_tpu.launch] rank exited with {rc}; "
+                        f"terminating {len(running)} remaining rank(s)\n"
+                    )
+            if failed_code is not None:
+                break
+            if not running:
+                return 0
+            time.sleep(0.2)
+
+        # Tear down survivors: SIGTERM, grace period, then SIGKILL the
+        # whole process group (a rank blocked in a native collective may
+        # not service SIGTERM at all).
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + grace_s
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(deadline - time.time(), 0.1))
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(p.pid, signal.SIGKILL)
+                    except Exception:
+                        p.kill()
+                    p.wait()
+        return failed_code
+    finally:
+        _killall()
+        for p in procs:
+            if p.poll() is None:
+                p.wait()
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        prog="python -m chainermn_tpu.launch",
+        description="mpiexec-analog local multi-process launcher",
+    )
+    ap.add_argument("--nproc", "-n", type=int, required=True)
+    ap.add_argument("--grace", type=float, default=10.0,
+                    help="seconds between SIGTERM and SIGKILL on teardown")
+    ap.add_argument("script", help="python script to run on every rank")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args()
+    sys.exit(launch(ns.nproc, [ns.script] + ns.args, grace_s=ns.grace))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Worker for the fault-injection / restart-recovery integration test.
+
+Trains a small DP MLP across 2 OS processes with per-epoch checkpoints.
+With ``CMN_FAULT_ITER`` set, process 1 raises mid-training — the global
+except hook must tear the whole job down (the reference's ``MPI_Abort``
+semantics) instead of leaving process 0 deadlocked in a collective.
+Without it, the worker resumes from the latest complete checkpoint and
+finishes, reporting where it resumed from.
+"""
+
+import json
+import os
+import sys
+import traceback
+
+import numpy as np
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid}
+
+    import optax
+
+    from chainermn_tpu.datasets import make_synthetic_classification
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.training import Trainer
+
+    comm = cmn.create_communicator("flat")
+    ds = cmn.scatter_dataset(
+        make_synthetic_classification(256, 8, 4, seed=9), comm, shuffle=True,
+        seed=4,
+    )
+    model = MLP(hidden=(8,), n_out=4)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))[
+        "params"
+    ]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    it = SerialIterator(ds, 64, shuffle=True, seed=2)
+    trainer = Trainer(
+        opt, opt.init(params), classification_loss(model), it,
+        stop=(4, "epoch"), has_aux=True,
+    )
+    # Synchronous saves: the injected fault fires one tiny step after the
+    # trigger, and the except hook hard-exits within 2s — an async commit
+    # racing that exit would make the surviving snapshot step flaky.
+    ckpt = create_multi_node_checkpointer(
+        "fault", comm, path=os.environ["CMN_TEST_TMP"], trigger=(1, "epoch"),
+        async_save=False,
+    )
+    trainer.extend(ckpt)
+    _, resumed = ckpt.maybe_load(trainer.state, trainer)
+    out["resumed_from"] = int(resumed)
+
+    fault_iter = int(os.environ.get("CMN_FAULT_ITER", "-1"))
+    if pid == 1 and fault_iter >= 0:
+        # Inject the failure through the real loop: an extension raising an
+        # ordinary uncaught exception at the target iteration, handled by
+        # the global except hook exactly as a user crash would be.
+        from chainermn_tpu.training import Extension
+
+        def blow_up(tr):
+            if tr.iteration >= fault_iter:
+                raise RuntimeError("injected fault for recovery test")
+
+        trainer.extend(
+            Extension(blow_up, trigger=(1, "iteration"), name="fault")
+        )
+    trainer.run()
+
+    out["final_iteration"] = trainer.iteration
+    out["checkpoint_steps"] = [int(s) for s in ckpt.all_steps()]
+    ckpt.close()
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    # Per-rank verdict path derived from the launcher-assigned process id.
+    result_path = os.path.join(
+        os.environ["CMN_TEST_TMP"],
+        f"verdict_{os.environ['CMN_PROCESS_ID']}.json",
+    )
+    if os.environ.get("CMN_FAULT_ITER"):
+        # Fault phase: NO safety net — the injected exception (and the peer's
+        # resulting collective failure) must reach sys.excepthook so the
+        # global except hook's whole-job teardown is what's under test.  On
+        # the hook path no verdict is written; the parent asserts on exit
+        # codes and the surviving checkpoint.
+        verdict = main()
+    else:
+        try:
+            verdict = main()
+        except BaseException:
+            verdict = {"status": "fail", "traceback": traceback.format_exc()}
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)
