@@ -74,30 +74,24 @@ def main():
     state = opt.init(variables["params"], model_state=variables["batch_stats"])
     loss_fn = resnet_loss(model)
 
-    class SyntheticImageNet:
-        """Deterministic fake data iterator with epoch bookkeeping."""
+    # Synthetic epoch-resident image pool fed through the NATIVE prefetch
+    # loader (the reference example's MultiprocessIterator role): C++ worker
+    # threads assemble the next batches into a ring of reusable buffers
+    # while the chip runs the current step.
+    from chainermn_tpu.datasets import ArrayDataset
+    from chainermn_tpu.iterators import PrefetchIterator
 
-        def __init__(self, n_iters, bs, size, classes):
-            self.n, self.bs, self.size, self.classes = n_iters, bs, size, classes
-            self.epoch = 0
-            self.iteration = 0
-            self._rng = np.random.RandomState(0)
-
-        def __iter__(self):
-            return self
-
-        def __next__(self):
-            self.iteration += 1
-            # epoch bumps on the batch that COMPLETES the pass (same
-            # convention as SerialIterator — no stray extra batch)
-            if self.iteration % self.n == 0:
-                self.epoch += 1
-            x = self._rng.uniform(size=(self.bs, self.size, self.size, 3))
-            y = (x.mean(axis=(1, 2, 3)) * self.classes).astype(np.int32)
-            return x.astype(np.float32), y.clip(0, self.classes - 1)
-
-    it = SyntheticImageNet(args.iters_per_epoch, args.batchsize,
-                           args.image_size, args.num_classes)
+    pool = args.iters_per_epoch * args.batchsize
+    rng = np.random.RandomState(0)
+    xs = rng.uniform(size=(pool, args.image_size, args.image_size, 3)).astype(
+        np.float32
+    )
+    ys = (xs.mean(axis=(1, 2, 3)) * args.num_classes).astype(np.int32).clip(
+        0, args.num_classes - 1
+    )
+    it = PrefetchIterator(
+        ArrayDataset(xs, ys), args.batchsize, shuffle=True, seed=0
+    )
     trainer = Trainer(opt, state, loss_fn, it, stop=(args.epoch, "epoch"),
                       stateful=True)
     trainer.extend(LogReport(trigger=(1, "epoch")))
