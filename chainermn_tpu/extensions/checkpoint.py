@@ -78,6 +78,18 @@ class MultiNodeCheckpointer(Extension):
         it = trainer.train_iter
         out["iteration"] = np.asarray(trainer.iteration, np.int64)
         out["epoch"] = np.asarray(getattr(it, "epoch", 0), np.int64)
+        # Iterators with lookahead (PrefetchIterator's native ring) expose an
+        # explicit consumption-granular cursor — their raw attributes must
+        # not be snapshotted (the submission cursor runs depth batches ahead).
+        if hasattr(it, "checkpoint_loop_state"):
+            st = it.checkpoint_loop_state()
+            out["it_pos"] = np.asarray(st["pos"], np.int64)
+            out["it_order"] = np.asarray(st["order"], np.int64)
+            out["rng_keys"] = np.asarray(st["rng_keys"], np.uint32)
+            out["rng_pos"] = np.asarray(st["rng_pos"], np.int64)
+            out["rng_has_gauss"] = np.asarray(st["rng_has_gauss"], np.int64)
+            out["rng_cached"] = np.asarray(st["rng_cached"], np.float64)
+            return out
         out["it_pos"] = np.asarray(getattr(it, "_pos", 0), np.int64)
         # Exact mid-epoch resume needs the iterator's in-flight permutation
         # and RNG state (restoring _pos into a FRESH permutation would skip
@@ -133,19 +145,32 @@ class MultiNodeCheckpointer(Extension):
             trainer.state = new_state
             trainer.iteration = int(loop["iteration"])
             it = trainer.train_iter
-            if hasattr(it, "epoch"):
-                it.epoch = int(loop["epoch"])
-            if hasattr(it, "_pos"):
-                it._pos = int(loop["it_pos"])
-            if "it_order" in loop and hasattr(it, "_order"):
-                it._order = np.asarray(loop["it_order"]).astype(np.int64)
-                it._rng.set_state((
-                    "MT19937",
-                    np.asarray(loop["rng_keys"]).astype(np.uint32),
-                    int(loop["rng_pos"]),
-                    int(loop["rng_has_gauss"]),
-                    float(loop["rng_cached"]),
-                ))
+            if hasattr(it, "restore_loop_state") and "it_order" in loop:
+                it.restore_loop_state(
+                    int(loop["epoch"]),
+                    {
+                        "pos": int(loop["it_pos"]),
+                        "order": loop["it_order"],
+                        "rng_keys": loop["rng_keys"],
+                        "rng_pos": int(loop["rng_pos"]),
+                        "rng_has_gauss": int(loop["rng_has_gauss"]),
+                        "rng_cached": float(loop["rng_cached"]),
+                    },
+                )
+            else:
+                if hasattr(it, "epoch"):
+                    it.epoch = int(loop["epoch"])
+                if hasattr(it, "_pos"):
+                    it._pos = int(loop["it_pos"])
+                if "it_order" in loop and hasattr(it, "_order"):
+                    it._order = np.asarray(loop["it_order"]).astype(np.int64)
+                    it._rng.set_state((
+                        "MT19937",
+                        np.asarray(loop["rng_keys"]).astype(np.uint32),
+                        int(loop["rng_pos"]),
+                        int(loop["rng_has_gauss"]),
+                        float(loop["rng_cached"]),
+                    ))
             # Sync trigger state so interval extensions don't all re-fire on
             # the first post-resume iteration (which would burn a retention
             # slot on a duplicate checkpoint and log a one-iteration window).

@@ -186,6 +186,62 @@ class PrefetchIterator:
         else:
             self.is_new_epoch = False
 
+    # --------------------------------------------------------- checkpointing
+    def checkpoint_loop_state(self) -> dict:
+        """Consumption-granular cursor for the multi-node checkpointer.
+
+        The submission cursor (``_pos``) runs ``depth`` batches ahead of
+        consumption in native mode, so the raw attributes must never be
+        saved/restored directly (stale in-flight batches + a skewed cursor).
+        ``pos`` here is SAMPLES CONSUMED this epoch; exact when checkpoints
+        fire at epoch boundaries (all examples' ``(1, 'epoch')`` trigger —
+        ``pos == 0``, a fresh permutation is drawn on restore) and
+        best-effort mid-epoch (the epoch's remaining order is preserved,
+        in-flight lookahead is discarded)."""
+        mt, keys, pos, has_gauss, cached = self._rng.get_state()
+        return {
+            "pos": int(self._consumed),
+            "order": np.asarray(self._order, np.int64),
+            "rng_keys": np.asarray(keys, np.uint32),
+            "rng_pos": int(pos),
+            "rng_has_gauss": int(has_gauss),
+            "rng_cached": float(cached),
+        }
+
+    def restore_loop_state(self, epoch: int, state: dict) -> None:
+        """Restore from :meth:`checkpoint_loop_state`: drain the ring,
+        reinstall the cursor, refill the lookahead from the restored order."""
+        # Drain in-flight slots (same recycle discipline as reset()).
+        if self._held_slot is not None:
+            self._lib.loader_release(self._h, self._held_slot)
+            self._held_slot = None
+        if self._h and self._pending:
+            while self._pending:
+                if self._pending.pop(0)[1] is None:
+                    slot = self._lib.loader_next(self._h, -1)
+                    if slot >= 0:
+                        self._lib.loader_release(self._h, slot)
+        self.epoch = int(epoch)
+        self.is_new_epoch = False
+        self._rng.set_state((
+            "MT19937",
+            np.asarray(state["rng_keys"]).astype(np.uint32),
+            int(state["rng_pos"]),
+            int(state["rng_has_gauss"]),
+            float(state["rng_cached"]),
+        ))
+        self._consumed = int(state["pos"])
+        self._pos = int(state["pos"])
+        self._order = (
+            np.asarray(state["order"]).astype(np.int64)
+            if int(state["pos"]) > 0
+            else self._new_order()  # epoch boundary: fresh permutation
+        )
+        self._pending = []
+        if self._h:
+            for _ in range(self._depth):
+                self._submit_next()
+
     @property
     def epoch_detail(self):
         # Consumption-based (the submission cursor runs `depth` batches ahead

@@ -82,9 +82,11 @@ def main():
     from chainermn_tpu.iterators import PrefetchIterator
 
     pool = args.iters_per_epoch * args.batchsize
-    rng = np.random.RandomState(0)
-    xs = rng.uniform(size=(pool, args.image_size, args.image_size, 3)).astype(
-        np.float32
+    # Generate directly in float32 (rng.uniform would materialize a float64
+    # intermediate — 2x the pool, ~15 GB at default args).
+    rng = np.random.default_rng(0)
+    xs = rng.random(
+        (pool, args.image_size, args.image_size, 3), dtype=np.float32
     )
     ys = (xs.mean(axis=(1, 2, 3)) * args.num_classes).astype(np.int32).clip(
         0, args.num_classes - 1
