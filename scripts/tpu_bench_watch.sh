@@ -35,7 +35,14 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# flash sweep rc=$? at $(date +%H:%M:%S)" >&2
     fi
-    if [ -s result/bench_tpu_done.json ] && [ -s result/flash_tpu.json ]; then
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/collectives_tpu.json ]; then
+      echo "# running collectives sweep at $(date +%H:%M:%S)" >&2
+      timeout 900 python benchmarks/collectives.py --out result/collectives_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# collectives rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/bench_tpu_done.json ] && [ -s result/flash_tpu.json ] \
+       && [ -s result/collectives_tpu.json ]; then
       exit 0
     fi
   fi
