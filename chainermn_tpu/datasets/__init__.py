@@ -15,7 +15,7 @@ at level 1 and shard purely at level 2, which preserves the reference's
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Optional
 
 import jax
 import numpy as np

@@ -14,7 +14,7 @@ the whole step stays one XLA program.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any
 
 import flax.linen as nn
 import jax

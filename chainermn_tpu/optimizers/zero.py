@@ -27,7 +27,7 @@ capability a modern user expects on top of ``create_multi_node_optimizer``.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
