@@ -102,6 +102,8 @@ def test_two_process_integration(tmp_path):
             "eager_allreduce",
             "in_graph_psum",
             "scatter_dataset",
+            "cross_host_model_parallel",
+            "zero_optimizer",
             "checkpoint",
             "corpus_evaluator",
         ):

@@ -169,6 +169,49 @@ def main() -> dict:
     assert scores["n_sentences"] == n_sent, scores
     out["corpus_evaluator"] = "ok"
 
+    # --- model parallelism ACROSS HOSTS: stage-per-process chain ---------
+    # A 2-stage MultiNodeChainList with stage 0 owned by process 0's rank
+    # and stage 1 by process 1's — activations cross the HOST boundary
+    # through the in-graph ppermute edge, and the result must match the
+    # same two-layer network run locally.
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P  # noqa: F811
+
+    from chainermn_tpu.links import MultiNodeChainList
+
+    w0 = np.arange(12, dtype=np.float32).reshape(3, 4) / 10.0
+    w1 = np.arange(8, dtype=np.float32).reshape(4, 2) / 10.0
+    chain = MultiNodeChainList(comm)
+    chain.add_link(lambda p, x: jnp.tanh(x @ p), rank=0, rank_out=1)
+    chain.add_link(lambda p, x: x @ p, rank=1)
+
+    xin = np.array([[1.0, -0.5, 0.25]], np.float32)
+
+    def body(p0, p1, x):
+        return chain([p0, p1], x)
+
+    run = jax.jit(
+        comm.spmd(
+            body,
+            in_specs=(P(), P(), P()),
+            # Rankwise output: per-device (1, 2) results stack to (2, 2);
+            # row r is rank r's value (owner-localized — only the final
+            # stage's owner holds the true activation).
+            out_specs=P(comm.axes),
+            check_vma=False,
+        )
+    )
+    res = run(
+        comm.replicate(jnp.asarray(w0)),
+        comm.replicate(jnp.asarray(w1)),
+        comm.replicate(jnp.asarray(xin)),
+    )
+    want = np.tanh(xin @ w0) @ w1
+    if pid == 1:  # this process addresses the final stage owner's row
+        mine = np.asarray([s.data for s in res.addressable_shards][0])
+        np.testing.assert_allclose(mine, want, atol=1e-6)
+    out["cross_host_model_parallel"] = "ok"
+
     # --- ZeRO sharded optimizer across 2 processes -----------------------
     # Params/grads/opt-state sharded 1/N over the 2-process mesh; two steps
     # must match the plain single-device optax oracle (computed identically
