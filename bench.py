@@ -280,12 +280,33 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         )
 
     rng = jax.random.PRNGKey(0)
-    x1 = jnp.ones((1, image_size, image_size, 3), jnp.float32)
-    # Init without the cross-device axis in scope (plain eval-mode trace).
+    # Init without the cross-device axis in scope (plain eval-mode trace) —
+    # and UNDER JIT: an eager flax init is hundreds of op-by-op dispatches,
+    # each a round trip over the axon tunnel (observed to stall the bench for
+    # 10+ minutes before any compute started). One jitted program = one trip.
     init_model = ResNet50(num_classes=1000)
-    variables = init_model.init(rng, x1, train=False)
+
+    @jax.jit
+    def _init(rng):
+        x1 = jnp.ones((1, image_size, image_size, 3), jnp.float32)
+        return init_model.init(rng, x1, train=False)
+
+    variables = jax.block_until_ready(_init(rng))
     _mark("model init done")
-    state = opt.init(variables["params"], model_state=variables["batch_stats"])
+    if opt_kind == "zero" or jax.process_count() > 1:
+        # ZeRO init shards flat params host-side (numpy pad/ravel), and
+        # multi-host placement uses make_array_from_callback — neither can
+        # run under a trace.
+        state = opt.init(
+            variables["params"], model_state=variables["batch_stats"]
+        )
+    else:
+        state = jax.block_until_ready(
+            jax.jit(lambda p, s: opt.init(p, model_state=s))(
+                variables["params"], variables["batch_stats"]
+            )
+        )
+    _mark("optimizer state init done")
     step = opt.make_train_step(resnet_loss(model), stateful=True)
 
     global_batch = per_chip_batch * n_dev

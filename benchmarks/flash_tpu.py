@@ -43,7 +43,6 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from chainermn_tpu.ops import flash_attention, reference_attention
     from chainermn_tpu.utils import sync
@@ -61,11 +60,18 @@ def main():
     if interpret:  # keep the smoke tiny
         B, T, H, D = 1, 256, 2, 64
     dtype = jnp.dtype(args.dtype)
-    rng = np.random.RandomState(0)
-    mk = lambda: jnp.asarray(
-        rng.normal(size=(B, T, H, D)).astype(np.float32), dtype
-    )
-    q, k, v = mk(), mk(), mk()
+
+    # Synthesize ON device: host->device transfers of tens of MB have been
+    # observed to kill runs over the axon tunnel (UNAVAILABLE mid-put).
+    @jax.jit
+    def _mk_qkv(key):
+        ks = jax.random.split(key, 3)
+        return tuple(
+            jax.random.normal(kk, (B, T, H, D), jnp.float32).astype(dtype)
+            for kk in ks
+        )
+
+    q, k, v = jax.block_until_ready(_mk_qkv(jax.random.PRNGKey(0)))
 
     out = {
         "platform": platform,

@@ -47,6 +47,14 @@ def main():
 
     platform = jax.devices()[0].platform
     n_dev = len(jax.devices())
+    if platform != "tpu" and not args.smoke:
+        # Same policy as flash_tpu.py / bench.py: never let a CPU-fallback
+        # number land in the TPU artifact slot (--out is skipped too).
+        print(json.dumps({
+            "error": f"lm bench needs a TPU (got {platform}); "
+                     "pass --smoke for a CPU plumbing check"
+        }))
+        return
     if args.smoke:
         args.batch, args.seq, args.layers = 2, 256, 2
         args.d_model, args.heads, args.d_ff, args.vocab = 128, 4, 256, 1024
@@ -79,10 +87,18 @@ def main():
             attention=impl,
         )
         opt = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
-        params = model.init(
-            jax.random.PRNGKey(0), np.zeros((1, args.seq), np.int32)
-        )["params"]
-        state = opt.init(params)
+        # Jit both inits: an eager flax/optax init is hundreds of op-by-op
+        # dispatches, each a round trip over the axon tunnel (observed to
+        # stall real-chip runs for 10+ minutes before any compute).
+        params = jax.jit(
+            lambda r: model.init(r, jnp.zeros((1, args.seq), jnp.int32))
+        )(jax.random.PRNGKey(0))["params"]
+        if jax.process_count() > 1:
+            # Multi-host placement goes through make_array_from_callback,
+            # which cannot run under a trace.
+            state = opt.init(params)
+        else:
+            state = jax.block_until_ready(jax.jit(opt.init)(params))
         step = opt.make_train_step(lm_loss(model), has_aux=True)
 
         flops = None
