@@ -41,6 +41,7 @@ from chainermn_tpu import global_except_hook  # noqa: E402
 
 global_except_hook._add_hook_if_enabled()
 from chainermn_tpu.iterators import (  # noqa: E402
+    create_device_prefetch_iterator,
     create_multi_node_iterator,
     create_synchronized_iterator,
 )
@@ -81,4 +82,5 @@ __all__ = [
     "create_empty_dataset",
     "create_multi_node_iterator",
     "create_synchronized_iterator",
+    "create_device_prefetch_iterator",
 ]

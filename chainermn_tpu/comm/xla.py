@@ -197,6 +197,12 @@ class XlaCommunicator(CommunicatorBase):
         )
 
         def put(x):
+            # Already device-resident with the target sharding (e.g. a
+            # DevicePrefetchIterator batch): hand it back untouched — an
+            # np.asarray here would round-trip the batch through host memory
+            # every step (and crash multi-host on non-addressable shards).
+            if isinstance(x, jax.Array) and x.sharding == sh:
+                return x
             x = np.asarray(x)
             shape = np.shape(x)
             if nproc > 1:

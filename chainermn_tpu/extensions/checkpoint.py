@@ -81,8 +81,12 @@ class MultiNodeCheckpointer(Extension):
         # Iterators with lookahead (PrefetchIterator's native ring) expose an
         # explicit consumption-granular cursor — their raw attributes must
         # not be snapshotted (the submission cursor runs depth batches ahead).
-        if hasattr(it, "checkpoint_loop_state"):
-            st = it.checkpoint_loop_state()
+        st = (
+            it.checkpoint_loop_state()
+            if hasattr(it, "checkpoint_loop_state")
+            else None
+        )
+        if st is not None:
             out["it_pos"] = np.asarray(st["pos"], np.int64)
             out["it_order"] = np.asarray(st["order"], np.int64)
             out["rng_keys"] = np.asarray(st["rng_keys"], np.uint32)

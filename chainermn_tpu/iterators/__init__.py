@@ -134,3 +134,7 @@ def create_synchronized_iterator(actual_iterator, communicator):
 
 
 from chainermn_tpu.iterators.prefetch import PrefetchIterator  # noqa: E402
+from chainermn_tpu.iterators.device_prefetch import (  # noqa: E402
+    DevicePrefetchIterator,
+    create_device_prefetch_iterator,
+)

@@ -94,6 +94,10 @@ def main():
     it = PrefetchIterator(
         ArrayDataset(xs, ys), args.batchsize, shuffle=True, seed=0
     )
+    # Second pipeline stage: keep the next batches resident ON DEVICE so the
+    # host→device transfer overlaps the previous step's compute (the
+    # reference's pinned-buffer staging role, done with async dispatch).
+    it = cmn.create_device_prefetch_iterator(it, comm, depth=2)
     trainer = Trainer(opt, state, loss_fn, it, stop=(args.epoch, "epoch"),
                       stateful=True)
     trainer.extend(LogReport(trigger=(1, "epoch")))

@@ -1,0 +1,227 @@
+"""DevicePrefetchIterator: batches must be identical to the un-prefetched
+path (same seed), epoch bookkeeping must reflect consumption (not the
+wrapped iterator's lookahead cursor), and training through the wrapper must
+be bit-identical to training without it."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.datasets import ArrayDataset
+from chainermn_tpu.iterators import (
+    DevicePrefetchIterator,
+    PrefetchIterator,
+    SerialIterator,
+    create_device_prefetch_iterator,
+)
+
+
+def _dataset(n=64, dim=8):
+    rng = np.random.RandomState(0)
+    return ArrayDataset(
+        rng.normal(size=(n, dim)).astype(np.float32),
+        rng.randint(0, 10, size=(n,)).astype(np.int32),
+    )
+
+
+def _comm(devices):
+    return cmn.create_communicator("xla", devices=devices)
+
+
+def test_yields_same_batches_as_serial(devices):
+    ds = _dataset()
+    comm = _comm(devices)
+    a = SerialIterator(ds, 16, shuffle=True, seed=5)
+    b = create_device_prefetch_iterator(
+        SerialIterator(ds, 16, shuffle=True, seed=5), comm, depth=3
+    )
+    for step in range(12):
+        ba = next(a)
+        bb = next(b)
+        for xa, xb in zip(ba, bb):
+            assert isinstance(xb, jax.Array)
+            np.testing.assert_array_equal(xa, np.asarray(xb),
+                                          err_msg=f"step {step}")
+        # Consumption-time epoch flags, despite the depth-3 lookahead.
+        assert a.epoch == b.epoch
+        assert a.is_new_epoch == b.is_new_epoch
+        assert a.iteration == b.iteration
+        assert abs(a.epoch_detail - b.epoch_detail) < 1e-9
+
+
+def test_batches_are_mesh_sharded(devices):
+    ds = _dataset(n=64)
+    comm = _comm(devices)
+    it = create_device_prefetch_iterator(
+        SerialIterator(ds, 32, shuffle=False), comm
+    )
+    x, y = next(it)
+    expect = comm.shard_batch((ds.arrays[0][:32], ds.arrays[1][:32]))
+    assert x.sharding == expect[0].sharding
+    assert y.sharding == expect[1].sharding
+
+
+def test_no_repeat_drains_and_stops(devices):
+    ds = _dataset(n=48)
+    comm = _comm(devices)
+    it = create_device_prefetch_iterator(
+        SerialIterator(ds, 16, repeat=False, shuffle=False), comm, depth=4
+    )
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b[0]) for b in batches]), ds.arrays[0]
+    )
+    assert it.epoch == 1 and it.is_new_epoch
+
+
+def test_training_identical_with_and_without(devices):
+    """End-to-end oracle: the wrapper must not change a single bit of the
+    training trajectory."""
+    import optax
+
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.training import Trainer
+
+    ds = _dataset(n=64, dim=8)
+    comm = _comm(devices)
+    model = MLP(hidden=(16,), n_out=10)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.float32)
+    )["params"]
+    loss_fn = classification_loss(model)
+
+    finals = []
+    for wrap in (False, True):
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.1, momentum=0.9),
+                                              comm)
+        it = SerialIterator(ds, 16, shuffle=True, seed=9)
+        if wrap:
+            it = create_device_prefetch_iterator(it, comm, depth=2)
+        trainer = Trainer(opt, opt.init(params), loss_fn, it,
+                          stop=(3, "epoch"), has_aux=True)
+        finals.append(trainer.run().params)
+    for a, b in zip(jax.tree_util.tree_leaves(finals[0]),
+                    jax.tree_util.tree_leaves(finals[1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_cursor_subtracts_in_flight(devices):
+    """The wrapped PrefetchIterator's consumption cursor advances at
+    submission; the wrapper's checkpoint state must report the samples the
+    TRAINER consumed (queue skew subtracted) when no epoch boundary is in
+    flight."""
+    ds = _dataset(n=640)
+    comm = _comm(devices)
+    inner = PrefetchIterator(ds, 32, shuffle=True, seed=3, depth=2)
+    it = DevicePrefetchIterator(inner, comm, depth=2)
+    for _ in range(3):
+        next(it)
+    state = it.checkpoint_loop_state()
+    assert state is not None
+    assert state["pos"] == 3 * 32
+    inner.close()
+
+
+def test_checkpoint_restore_refills(devices):
+    ds = _dataset(n=640)
+    comm = _comm(devices)
+
+    inner = PrefetchIterator(ds, 32, shuffle=True, seed=3, depth=2)
+    it = DevicePrefetchIterator(inner, comm, depth=2)
+    consumed = [np.asarray(next(it)[0]) for _ in range(4)]
+    state = it.checkpoint_loop_state()
+
+    inner2 = PrefetchIterator(ds, 32, shuffle=True, seed=999, depth=2)
+    it2 = DevicePrefetchIterator(inner2, comm, depth=2)
+    it2.restore_loop_state(0, state)
+    # Replays exactly from the consumption point: batch 5 of the original
+    # epoch order comes next.
+    ref = PrefetchIterator(ds, 32, shuffle=True, seed=3, depth=2)
+    for _ in range(4):
+        next(ref)
+    np.testing.assert_array_equal(np.asarray(next(it2)[0]), next(ref)[0])
+    assert len(consumed) == 4
+    inner.close()
+    inner2.close()
+    ref.close()
+
+
+def test_reshard_is_identity_for_device_batches(devices):
+    """The optimizer's update path calls shard_batch on every batch; for an
+    already-device-resident, correctly-sharded batch that must be a no-op
+    (no device→host round trip undoing the prefetch overlap)."""
+    ds = _dataset(n=64)
+    comm = _comm(devices)
+    it = create_device_prefetch_iterator(
+        SerialIterator(ds, 32, shuffle=False), comm
+    )
+    batch = next(it)
+    again = comm.shard_batch(batch)
+    assert again[0] is batch[0]
+    assert again[1] is batch[1]
+
+
+def test_checkpointer_over_wrapped_serial_iterator(devices, tmp_path):
+    """Wrapping a SerialIterator (no checkpoint_loop_state of its own) must
+    still checkpoint and resume exactly: the wrapper synthesizes the cursor,
+    subtracting the in-flight device queue."""
+    import optax
+
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.training import Trainer
+
+    ds = _dataset(n=64, dim=8)
+    comm = _comm(devices)
+    model = MLP(hidden=(8,), n_out=10)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.float32)
+    )["params"]
+    loss_fn = classification_loss(model)
+
+    def mk(stop):
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+        it = create_device_prefetch_iterator(
+            SerialIterator(ds, 16, shuffle=True, seed=11), comm, depth=2
+        )
+        trainer = Trainer(opt, opt.init(params), loss_fn, it,
+                          stop=(stop, "epoch"), has_aux=True)
+        ckpt = create_multi_node_checkpointer(
+            "dp", comm, path=str(tmp_path), trigger=(1, "epoch"),
+            async_save=False,
+        )
+        trainer.extend(ckpt)
+        return trainer, ckpt
+
+    trainer, ckpt = mk(2)
+    trainer.run()
+    ckpt.finalize(trainer)
+
+    # Uninterrupted 3-epoch oracle.
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    it = create_device_prefetch_iterator(
+        SerialIterator(ds, 16, shuffle=True, seed=11), comm, depth=2
+    )
+    oracle = Trainer(opt, opt.init(params), loss_fn, it,
+                     stop=(3, "epoch"), has_aux=True)
+    oracle_params = oracle.run().params
+
+    # Restart from the epoch-2 checkpoint, run to epoch 3.
+    trainer2, ckpt2 = mk(3)
+    _, resumed = ckpt2.maybe_load(trainer2.state, trainer2)
+    assert resumed == trainer.iteration
+    final = trainer2.run().params
+    for a, b in zip(jax.tree_util.tree_leaves(final),
+                    jax.tree_util.tree_leaves(oracle_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    ckpt.close()
+    ckpt2.close()
+
+
+def test_depth_validation(devices):
+    with pytest.raises(ValueError):
+        DevicePrefetchIterator(SerialIterator(_dataset(), 8),
+                               _comm(devices), depth=0)
