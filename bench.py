@@ -307,7 +307,12 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
             )
         )
     _mark("optimizer state init done")
-    step = opt.make_train_step(resnet_loss(model), stateful=True)
+    # CMN_BENCH_ACCUM=k microbatches each device batch k ways (activation
+    # memory lever — lets the headline per-chip batch run on smaller HBM).
+    accum = int(os.environ.get("CMN_BENCH_ACCUM", "1"))
+    step = opt.make_train_step(
+        resnet_loss(model), stateful=True, accum_steps=accum
+    )
 
     global_batch = per_chip_batch * n_dev
     batch = _device_batch(comm, global_batch, image_size)
@@ -357,6 +362,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         "device_kind": device_kind,
         "n_devices": n_dev,
         "per_chip_batch": per_chip_batch,
+        "accum_steps": accum,
         "optimizer": opt_kind,
         "global_batch": global_batch,
         "image_size": image_size,

@@ -28,6 +28,8 @@ def main():
     ap.add_argument("--d-ff", type=int, default=3072)
     ap.add_argument("--vocab", type=int, default=32768)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--accum", type=int, default=1,
+                    help="gradient-accumulation microbatches per step")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for CPU plumbing checks")
     ap.add_argument("--out", default=None)
@@ -69,7 +71,7 @@ def main():
         "config": {
             "batch": args.batch, "seq": args.seq, "layers": args.layers,
             "d_model": args.d_model, "heads": args.heads, "d_ff": args.d_ff,
-            "vocab": args.vocab,
+            "vocab": args.vocab, "accum": args.accum,
         },
     }
 
@@ -99,7 +101,8 @@ def main():
             state = opt.init(params)
         else:
             state = jax.block_until_ready(jax.jit(opt.init)(params))
-        step = opt.make_train_step(lm_loss(model), has_aux=True)
+        step = opt.make_train_step(lm_loss(model), has_aux=True,
+                                   accum_steps=args.accum)
 
         flops = None
         try:

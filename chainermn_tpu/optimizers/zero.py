@@ -195,6 +195,7 @@ class ZeroMultiNodeOptimizer:
         has_aux: bool = False,
         stateful: bool = False,
         donate: bool = True,
+        accum_steps: int = 1,
     ) -> Callable:
         comm = self.comm
         axes = comm.axes
@@ -203,6 +204,12 @@ class ZeroMultiNodeOptimizer:
         specs = self._leafspecs
         if specs is None:
             raise RuntimeError("call init() before make_train_step()")
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        # Deferred import (same pattern as update()'s _eager_update): the
+        # optimizers package imports this module at its bottom.
+        from chainermn_tpu.optimizers import _accumulated_grads
+
         wire = getattr(comm, "allreduce_grad_dtype", None)
 
         def gather_full(flat_local):
@@ -237,20 +244,29 @@ class ZeroMultiNodeOptimizer:
                 out.append(r)
             return out
 
-        def body(state: ZeroTrainState, batch):
-            params = gather_full(state.flat_params)
-            new_model_state = state.model_state
+        def grad_one(params, model_state, mb):
             if stateful:
-                (loss, (aux, new_model_state)), grads = jax.value_and_grad(
+                (loss, (aux, ms)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
-                )(params, state.model_state, batch)
+                )(params, model_state, mb)
             elif has_aux:
                 (loss, aux), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
-                )(params, batch)
+                )(params, mb)
+                ms = model_state
             else:
-                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-                aux = {}
+                loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+                aux, ms = {}, model_state
+            return loss, aux, ms, grads
+
+        def body(state: ZeroTrainState, batch):
+            # Params are all-gathered ONCE per step and reused across the
+            # accumulation scan (one gather + one reduce-scatter per step
+            # regardless of accum_steps).
+            params = gather_full(state.flat_params)
+            loss, aux, new_model_state, grads = _accumulated_grads(
+                grad_one, params, state.model_state, batch, accum_steps
+            )
             g_local = scatter_grads(grads)
             p_local = state.flat_params
             updates, opt_state = tx.update(g_local, state.opt_state, p_local)
@@ -298,12 +314,15 @@ class ZeroMultiNodeOptimizer:
         loss_fn: Callable,
         has_aux: bool = False,
         stateful: bool = False,
+        accum_steps: int = 1,
     ) -> Tuple[ZeroTrainState, dict]:
         """Eager-style API mirroring ``MultiNodeOptimizer.update`` (the
         ``training.Trainer`` contract)."""
         from chainermn_tpu.optimizers import _eager_update
 
-        return _eager_update(self, state, batch, loss_fn, has_aux, stateful)
+        return _eager_update(
+            self, state, batch, loss_fn, has_aux, stateful, accum_steps
+        )
 
 
 def zero_clip_by_global_norm(max_norm: float, communicator) -> optax.GradientTransformation:

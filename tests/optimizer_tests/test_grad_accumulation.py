@@ -1,0 +1,143 @@
+"""Gradient accumulation (``accum_steps``): a k-way microbatched step must
+match the unsplit step on the identical global batch — for per-sample-mean
+losses the accumulated mean gradient is mathematically the full-batch
+gradient, so the trajectories agree to float tolerance (summation order is
+the only difference)."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.datasets import make_synthetic_classification
+from chainermn_tpu.models import MLP, classification_loss
+
+
+def _setup(devices):
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = MLP(hidden=(32,), n_out=10)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 16), np.float32)
+    )["params"]
+    return comm, model, params, classification_loss(model)
+
+
+def _batches(n, bs, dim=16, seed=0):
+    ds = make_synthetic_classification(n=n * bs, dim=dim, seed=seed)
+    x, y = ds.arrays
+    return [
+        (x[i * bs : (i + 1) * bs], y[i * bs : (i + 1) * bs]) for i in range(n)
+    ]
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_accum_matches_unsplit(devices, accum):
+    comm, model, params, loss_fn = _setup(devices)
+    batches = _batches(6, 64 * len(devices))
+
+    finals = []
+    for k in (1, accum):
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.1, momentum=0.9),
+                                              comm)
+        state = opt.init(params)
+        step = opt.make_train_step(loss_fn, has_aux=True, accum_steps=k)
+        for b in batches:
+            state, metrics = step(state, comm.shard_batch(b))
+        finals.append((state.params, float(metrics["loss"]),
+                       float(metrics["accuracy"])))
+    for a, b in zip(jax.tree_util.tree_leaves(finals[0][0]),
+                    jax.tree_util.tree_leaves(finals[1][0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+    assert abs(finals[0][1] - finals[1][1]) < 1e-4  # mean loss
+    assert abs(finals[0][2] - finals[1][2]) < 1e-6  # mean accuracy
+
+
+def test_accum_zero_optimizer_matches_replicated(devices):
+    """ZeRO with accumulation == replicated optimizer with accumulation
+    (adam, so any grad-scale bug would surface in the trajectory)."""
+    comm, model, params, loss_fn = _setup(devices)
+    batches = _batches(5, 32 * len(devices))
+
+    ropt = cmn.create_multi_node_optimizer(optax.adam(1e-2), comm)
+    rstate = ropt.init(params)
+    rstep = ropt.make_train_step(loss_fn, has_aux=True, accum_steps=4)
+
+    zopt = cmn.create_zero_optimizer(optax.adam(1e-2), comm)
+    zstate = zopt.init(params)
+    zstep = zopt.make_train_step(loss_fn, has_aux=True, accum_steps=4)
+
+    for b in batches:
+        sb = comm.shard_batch(b)
+        rstate, rm = rstep(rstate, sb)
+        zstate, zm = zstep(zstate, sb)
+    np.testing.assert_allclose(float(rm["loss"]), float(zm["loss"]),
+                               atol=1e-5)
+    for a, b_ in zip(jax.tree_util.tree_leaves(rstate.params),
+                     jax.tree_util.tree_leaves(
+                         zopt.materialize_params(zstate))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_accum_stateful_bn_runs(devices):
+    """accum_steps with stateful=True threads BN stats through the scan
+    sequentially (each microbatch sees the previous one's running stats)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from chainermn_tpu.links import MultiNodeBatchNormalization
+
+    comm = cmn.create_communicator("xla", devices=devices)
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool):
+            x = nn.Dense(16)(x)
+            x = MultiNodeBatchNormalization(
+                features=16, axis_name=comm.axis_name,
+                use_running_average=not train,
+            )(x)
+            return nn.Dense(4)(x)
+
+    net = Net()
+    variables = net.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32),
+                         train=True)
+
+    def loss_fn(params, model_state, batch):
+        x, y = batch
+        logits, mut = net.apply(
+            {"params": params, "batch_stats": model_state}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        )
+        return loss, ({"loss_copy": loss}, mut["batch_stats"])
+
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    state = opt.init(variables["params"],
+                     model_state=variables["batch_stats"])
+    step = opt.make_train_step(loss_fn, stateful=True, accum_steps=2)
+    rng = np.random.RandomState(0)
+    b = (rng.normal(size=(16 * len(devices), 8)).astype(np.float32),
+         rng.randint(0, 4, size=(16 * len(devices),)).astype(np.int32))
+    state, metrics = step(state, comm.shard_batch(b))
+    assert np.isfinite(float(metrics["loss"]))
+    # Running stats moved off their init values.
+    mean_leaf = jax.tree_util.tree_leaves(state.model_state)[0]
+    assert float(np.abs(np.asarray(mean_leaf)).sum()) > 0
+
+
+def test_accum_validation(devices):
+    comm, model, params, loss_fn = _setup(devices)
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    with pytest.raises(ValueError):
+        opt.make_train_step(loss_fn, accum_steps=0)
+    state = opt.init(params)
+    step = opt.make_train_step(loss_fn, has_aux=True, accum_steps=3)
+    b = _batches(1, 8 * len(devices))[0]  # 8 per device, not divisible by 3
+    with pytest.raises(ValueError, match="not divisible"):
+        step(state, comm.shard_batch(b))
