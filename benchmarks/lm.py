@@ -30,6 +30,8 @@ def main():
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation microbatches per step")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize decoder blocks (jax.checkpoint)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for CPU plumbing checks")
     ap.add_argument("--out", default=None)
@@ -71,7 +73,7 @@ def main():
         "config": {
             "batch": args.batch, "seq": args.seq, "layers": args.layers,
             "d_model": args.d_model, "heads": args.heads, "d_ff": args.d_ff,
-            "vocab": args.vocab, "accum": args.accum,
+            "vocab": args.vocab, "accum": args.accum, "remat": args.remat,
         },
     }
 
@@ -86,7 +88,7 @@ def main():
         model = TransformerLM(
             vocab=args.vocab, n_layers=args.layers, d_model=args.d_model,
             n_heads=args.heads, d_ff=args.d_ff, max_len=args.seq,
-            attention=impl,
+            attention=impl, remat=args.remat,
         )
         opt = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
         # Jit both inits: an eager flax/optax init is hundreds of op-by-op

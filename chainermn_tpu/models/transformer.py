@@ -39,6 +39,46 @@ from chainermn_tpu.parallel.ring_attention import ring_self_attention
 # =====================================================================
 # Flax tier (single-chip / DP)
 # =====================================================================
+class _DecoderBlock(nn.Module):
+    """One pre-norm decoder block (attention + FFN residuals)."""
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any
+    attention: str
+
+    @nn.compact
+    def __call__(self, h):
+        from chainermn_tpu.ops import flash_attention, reference_attention
+
+        T = h.shape[1]
+        D, H = self.d_model, self.n_heads
+        x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
+        qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.attention == "flash":
+            # Largest power-of-two block that divides T (flash needs T %
+            # block == 0); natural lengths work without upstream padding.
+            block = 128
+            while block > 1 and T % block:
+                block //= 2
+            a = flash_attention(q, k, v, causal=True, block_q=block,
+                                block_k=block)
+        elif self.attention == "xla":
+            a = reference_attention(q, k, v, causal=True).astype(q.dtype)
+        else:
+            raise ValueError(
+                f"attention={self.attention!r}: expected 'flash' or 'xla'"
+            )
+        o = nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype, name="proj")(a)
+        h = h + o
+        x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
+        y = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(x)
+        y = nn.Dense(D, dtype=self.dtype, name="ff2")(nn.gelu(y))
+        return h + y
+
+
 class TransformerLM(nn.Module):
     """Decoder-only LM; attention runs on the Pallas flash kernel."""
 
@@ -52,46 +92,29 @@ class TransformerLM(nn.Module):
     #: "flash" (Pallas kernel) or "xla" (materialized-scores oracle) — the
     #: switch the LM benchmark uses to measure the kernel's end-to-end value.
     attention: str = "flash"
+    #: Rematerialize each block in the backward pass (``jax.checkpoint``):
+    #: activation memory drops from O(n_layers) residuals+intermediates to
+    #: O(n_layers) residuals only, for one extra forward of compute — the
+    #: standard HBM lever for deep/long-context configs (pairs with the
+    #: optimizers' ``accum_steps``).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):  # (B, T) int32 -> (B, T, vocab) f32
-        from chainermn_tpu.ops import flash_attention, reference_attention
-
         B, T = tokens.shape
-        D, H = self.d_model, self.n_heads
+        D = self.d_model
         h = nn.Embed(self.vocab, D, dtype=self.dtype, name="embed")(tokens)
         pos = self.param(
             "pos", nn.initializers.normal(0.02), (self.max_len, D), jnp.float32
         )
         h = h + pos[None, :T].astype(self.dtype)
+        block_cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
         for i in range(self.n_layers):
-            x = nn.LayerNorm(dtype=self.dtype, name=f"ln1_{i}")(h)
-            qkv = nn.DenseGeneral(
-                (3, H, D // H), dtype=self.dtype, name=f"qkv_{i}"
-            )(x)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            if self.attention == "flash":
-                # Largest power-of-two block that divides T (flash needs T %
-                # block == 0); natural lengths work without upstream padding.
-                block = 128
-                while block > 1 and T % block:
-                    block //= 2
-                a = flash_attention(q, k, v, causal=True, block_q=block,
-                                    block_k=block)
-            elif self.attention == "xla":
-                a = reference_attention(q, k, v, causal=True).astype(q.dtype)
-            else:
-                raise ValueError(
-                    f"attention={self.attention!r}: expected 'flash' or 'xla'"
-                )
-            o = nn.DenseGeneral(
-                D, axis=(-2, -1), dtype=self.dtype, name=f"proj_{i}"
-            )(a)
-            h = h + o
-            x = nn.LayerNorm(dtype=self.dtype, name=f"ln2_{i}")(h)
-            y = nn.Dense(self.d_ff, dtype=self.dtype, name=f"ff1_{i}")(x)
-            y = nn.Dense(D, dtype=self.dtype, name=f"ff2_{i}")(nn.gelu(y))
-            h = h + y
+            h = block_cls(
+                d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
+                dtype=self.dtype, attention=self.attention,
+                name=f"block_{i}",
+            )(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
         return nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(h)
 

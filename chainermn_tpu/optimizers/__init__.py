@@ -40,7 +40,13 @@ def _accumulated_grads(grad_one, params, model_state, batch, accum_steps):
     ``batch``'s leading axis; losses/aux/grads are MEAN-accumulated in a
     ``lax.scan`` carry (a stacked scan output would materialize
     ``accum_steps × params``), model state threads sequentially.  With
-    ``accum_steps == 1`` this is exactly one ``grad_one`` call."""
+    ``accum_steps == 1`` this is exactly one ``grad_one`` call.
+
+    Weighting contract: every microbatch contributes 1/k — exact for
+    per-sample-mean losses.  A loss that normalizes by a DATA-DEPENDENT
+    count (e.g. a masked token mean) is over-weighted on microbatches with
+    fewer real tokens; when padding is uneven across microbatches this is
+    the standard equal-weight approximation, not the full-batch mean."""
     if accum_steps == 1:
         return grad_one(params, model_state, batch)
 
