@@ -36,6 +36,14 @@ def main():
     ap.add_argument("--d-model", type=int, default=128)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--accum", type=int, default=1,
+                    help="gradient-accumulation microbatches per step")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize decoder blocks (jax.checkpoint)")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-shard params/grads/optimizer state 1/N")
+    ap.add_argument("--warmup", type=int, default=0,
+                    help="linear-warmup steps into a cosine decay schedule")
     args = ap.parse_args()
 
     import jax
@@ -67,24 +75,42 @@ def main():
                            for i in range(2)])
     global_batch = args.batch_per_chip * comm.size
     it = PrefetchIterator(local, global_batch, seed=1)
+    # Device-side stage: next batches transfer while the current step runs.
+    it = cmn.create_device_prefetch_iterator(it, comm, depth=2)
 
     model = TransformerLM(
         vocab=vocab, n_layers=args.layers, d_model=args.d_model,
         n_heads=4, d_ff=4 * args.d_model, max_len=T,
         dtype=jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16,
+        remat=args.remat,
     )
     params = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
     )["params"]
-    opt = cmn.create_multi_node_optimizer(
-        optax.adamw(args.lr, weight_decay=0.01), comm
+    lr = (
+        optax.warmup_cosine_decay_schedule(
+            0.0, args.lr, args.warmup, max(args.steps, args.warmup + 1)
+        )
+        if args.warmup
+        else args.lr
+    )
+    tx = optax.adamw(lr, weight_decay=0.01)
+    # Schedules live INSIDE the optax chain (the jitted step), the TPU-native
+    # form of the reference examples' ExponentialShift trainer extension.
+    opt = (
+        cmn.create_zero_optimizer(tx, comm)
+        if args.zero
+        else cmn.create_multi_node_optimizer(tx, comm)
     )
     state = opt.init(params)
-    step = opt.make_train_step(lm_loss(model), has_aux=True)
+    step = opt.make_train_step(
+        lm_loss(model), has_aux=True, accum_steps=args.accum
+    )
 
     for i in range(args.steps):
         batch = next(it)
-        state, metrics = step(state, comm.shard_batch(batch))
+        # Batches arrive pre-sharded on device from the prefetch stage.
+        state, metrics = step(state, batch)
         if i % 20 == 0 or i == args.steps - 1:
             if jax.process_index() == 0:
                 print(f"step {i}: loss {float(metrics['loss']):.4f}",
