@@ -134,6 +134,35 @@ def launch(
         signal.signal(signal.SIGINT, prev_int)
 
 
+def supervise(
+    nproc: int,
+    argv: list,
+    restarts: int = 0,
+    backoff_s: float = 1.0,
+    grace_s: float = 10.0,
+    env_extra: dict = None,
+) -> int:
+    """Run the job, relaunching it up to ``restarts`` times on failure.
+
+    The recovery model is the reference's restart-based one (SURVEY.md
+    §2.8): a crashed job is torn down whole, then relaunched; ranks
+    ``maybe_load`` the latest complete checkpoint and continue.  With a
+    checkpointing training script this turns a transient failure into a
+    self-healing run without an external scheduler.  Each attempt gets
+    fresh coordinator/object-plane ports (``launch`` allocates per call)."""
+    attempt = 0
+    while True:
+        rc = launch(nproc, argv, grace_s=grace_s, env_extra=env_extra)
+        if rc == 0 or attempt >= restarts:
+            return rc
+        attempt += 1
+        sys.stderr.write(
+            f"[chainermn_tpu.launch] job failed (rc={rc}); "
+            f"restart {attempt}/{restarts} in {backoff_s:.1f}s\n"
+        )
+        time.sleep(backoff_s)
+
+
 def main():
     ap = argparse.ArgumentParser(
         prog="python -m chainermn_tpu.launch",
@@ -142,10 +171,21 @@ def main():
     ap.add_argument("--nproc", "-n", type=int, required=True)
     ap.add_argument("--grace", type=float, default=10.0,
                     help="seconds between SIGTERM and SIGKILL on teardown")
+    ap.add_argument("--restarts", type=int, default=0,
+                    help="relaunch the whole job up to N times on failure "
+                         "(restart-based recovery; ranks resume from their "
+                         "checkpointer's latest complete snapshot)")
+    ap.add_argument("--restart-backoff", type=float, default=1.0,
+                    help="seconds to wait before a relaunch")
     ap.add_argument("script", help="python script to run on every rank")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args()
-    sys.exit(launch(ns.nproc, [ns.script] + ns.args, grace_s=ns.grace))
+    sys.exit(
+        supervise(
+            ns.nproc, [ns.script] + ns.args, restarts=ns.restarts,
+            backoff_s=ns.restart_backoff, grace_s=ns.grace,
+        )
+    )
 
 
 if __name__ == "__main__":

@@ -29,7 +29,8 @@ WORKER = os.path.join(
 )
 
 
-def _launch(tmp_path, fault_iter=None, timeout=240):
+def _launch(tmp_path, fault_iter=None, timeout=240, extra_env=None,
+            extra_args=()):
     env = {
         k: v
         for k, v in os.environ.items()
@@ -44,10 +45,11 @@ def _launch(tmp_path, fault_iter=None, timeout=240):
     )
     if fault_iter is not None:
         env["CMN_FAULT_ITER"] = str(fault_iter)
+    env.update(extra_env or {})
     t0 = time.time()
     res = subprocess.run(
         [sys.executable, "-m", "chainermn_tpu.launch", "-n", "2",
-         "--grace", "5", WORKER],
+         "--grace", "5", *extra_args, WORKER],
         env=env,
         cwd=REPO,
         capture_output=True,
@@ -78,6 +80,12 @@ def test_crash_aborts_job_and_restart_resumes(tmp_path):
         errors="replace"
     )
     assert res.returncode == 0, log[-3000:]
+    _check_verdicts(tmp_path, log)
+
+
+def _check_verdicts(tmp_path, log):
+    """Both ranks completed all 4 epochs after resuming at the epoch-2
+    snapshot (iteration 4)."""
     for pid in range(2):
         out = tmp_path / f"verdict_{pid}.json"
         assert out.exists(), f"rank {pid} wrote no verdict:\n{log[-3000:]}"
@@ -86,3 +94,24 @@ def test_crash_aborts_job_and_restart_resumes(tmp_path):
         assert v["resumed_from"] == 4, v  # resumed at the epoch-2 snapshot
         assert v["final_iteration"] == 8, v  # 4 epochs x 2 iters completed
         assert v["checkpoint_steps"][-1] == 8, v
+
+
+def test_supervised_restart_self_heals(tmp_path):
+    """``--restarts 1`` + a one-shot (transient) fault: ONE launcher
+    invocation absorbs the crash — teardown, relaunch, checkpoint resume,
+    completion — with exit code 0 (the restart-based recovery loop of
+    SURVEY.md §2.8 run by the launcher itself instead of an operator)."""
+    res, latency = _launch(
+        tmp_path, fault_iter=5, timeout=420,
+        extra_env={"CMN_FAULT_ONCE": "1"},
+        extra_args=("--restarts", "1", "--restart-backoff", "0.5"),
+    )
+    log = res.stderr.decode(errors="replace") + res.stdout.decode(
+        errors="replace"
+    )
+    assert res.returncode == 0, log[-3000:]
+    assert "injected fault" in log, log[-3000:]
+    assert "restart 1/1" in log, log[-3000:]
+    # Crash detection + teardown + relaunch + resume must all be prompt.
+    assert latency < 300, latency
+    _check_verdicts(tmp_path, log)

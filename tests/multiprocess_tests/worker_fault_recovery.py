@@ -16,6 +16,16 @@ import traceback
 import numpy as np
 
 
+def _fault_marker() -> str:
+    return os.path.join(os.environ["CMN_TEST_TMP"], "fault_fired")
+
+
+def _fault_already_fired() -> bool:
+    return bool(
+        os.environ.get("CMN_FAULT_ONCE") and os.path.exists(_fault_marker())
+    )
+
+
 def main() -> dict:
     import jax
 
@@ -60,7 +70,7 @@ def main() -> dict:
     out["resumed_from"] = int(resumed)
 
     fault_iter = int(os.environ.get("CMN_FAULT_ITER", "-1"))
-    if pid == 1 and fault_iter >= 0:
+    if pid == 1 and fault_iter >= 0 and not _fault_already_fired():
         # Inject the failure through the real loop: an extension raising an
         # ordinary uncaught exception at the target iteration, handled by
         # the global except hook exactly as a user crash would be.
@@ -68,6 +78,11 @@ def main() -> dict:
 
         def blow_up(tr):
             if tr.iteration >= fault_iter:
+                if os.environ.get("CMN_FAULT_ONCE"):
+                    # Transient-failure model for the self-healing launcher
+                    # test: fire once, not on the supervised relaunch.
+                    with open(_fault_marker(), "w") as f:
+                        f.write("fired")
                 raise RuntimeError("injected fault for recovery test")
 
         trainer.extend(
