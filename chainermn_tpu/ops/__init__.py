@@ -7,10 +7,22 @@ has an XLA fallback so the package stays portable (CPU tests run the same
 code in interpret mode).
 """
 
+from chainermn_tpu.ops.augment import (
+    random_crop,
+    random_crop_flip,
+    random_flip,
+)
 from chainermn_tpu.ops.flash_attention import (
     flash_attention,
     flash_attention_lse,
     reference_attention,
 )
 
-__all__ = ["flash_attention", "flash_attention_lse", "reference_attention"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_lse",
+    "reference_attention",
+    "random_crop",
+    "random_crop_flip",
+    "random_flip",
+]

@@ -1,0 +1,68 @@
+"""Device-side data augmentation — runs INSIDE the jitted train step.
+
+Reference analog: the ImageNet example's random-crop/flip transforms
+(SURVEY.md §2.9 — Chainer ``TransformDataset`` on host worker processes).
+The TPU-first form moves the transform onto the chip: augmentation is a few
+elementwise/gather ops XLA fuses into the step's prologue, the host pipeline
+ships each image once (no per-epoch re-transform), and the device RNG makes
+every step's crops/flips deterministic from ``(seed, step, device)``.
+
+Use through the optimizer hook::
+
+    aug = random_crop_flip(padding=4)     # build ONCE, outside the loop
+    step = opt.make_train_step(loss_fn, augment=aug)
+
+(The eager ``opt.update(...)`` facade caches compiled steps keyed on the
+``augment`` callable's identity — passing a fresh ``random_crop_flip()``
+closure per call would recompile every step.)
+
+The hook derives a per-step, per-device key (fold_in of the step counter and
+the mesh position) so replicas augment their shards independently while the
+whole run stays bit-reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_crop(key: jax.Array, images: jax.Array, padding: int = 4,
+                mode: str = "constant") -> jax.Array:
+    """Pad spatially by ``padding`` then crop back at a random offset per
+    image (the classic ResNet recipe).  ``images``: (B, H, W, C)."""
+    B, H, W, C = images.shape
+    padded = jnp.pad(
+        images,
+        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        mode=mode,
+    )
+    offs = jax.random.randint(key, (B, 2), 0, 2 * padding + 1)
+
+    def crop_one(img, off):
+        return lax.dynamic_slice(img, (off[0], off[1], 0), (H, W, C))
+
+    return jax.vmap(crop_one)(padded, offs)
+
+
+def random_flip(key: jax.Array, images: jax.Array) -> jax.Array:
+    """Horizontal flip with probability 1/2 per image."""
+    flip = jax.random.bernoulli(key, 0.5, (images.shape[0],))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+def random_crop_flip(padding: int = 4, mode: str = "constant") -> Callable:
+    """``augment(key, batch)`` for ``(images, labels)`` classification
+    batches: random pad-crop + horizontal flip on the images, labels
+    untouched.  Pass to ``make_train_step(..., augment=...)``."""
+
+    def augment(key: jax.Array, batch: Tuple) -> Tuple:
+        x, *rest = batch
+        kc, kf = jax.random.split(key)
+        x = random_flip(kf, random_crop(kc, x, padding=padding, mode=mode))
+        return (x, *rest)
+
+    return augment

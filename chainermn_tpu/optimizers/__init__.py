@@ -32,6 +32,14 @@ from chainermn_tpu.comm.xla import XlaCommunicator
 from chainermn_tpu.utils import pvary
 
 
+def _augment_key(seed: int, step: jax.Array, axes) -> jax.Array:
+    """Per-step, per-device augmentation key: deterministic from
+    ``(seed, step counter, mesh position)`` so replicas draw independent
+    transforms while the whole run stays reproducible."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    return jax.random.fold_in(key, lax.axis_index(axes))
+
+
 def _accumulated_grads(grad_one, params, model_state, batch, accum_steps):
     """Gradient accumulation core, shared by both optimizer tiers.
 
@@ -173,6 +181,8 @@ class MultiNodeOptimizer:
         stateful: bool = False,
         donate: bool = True,
         accum_steps: int = 1,
+        augment: Optional[Callable] = None,
+        augment_seed: int = 0,
     ) -> Callable:
         """Build the jitted SPMD train step (reference hot loop §3.2).
 
@@ -189,6 +199,12 @@ class MultiNodeOptimizer:
         with the microbatch while the effective batch (and, for per-sample-
         mean losses, the numerics) matches the unsplit step.  The TPU lever
         for large global batches the reference reached by adding processes.
+
+        ``augment(key, batch) -> batch`` runs on device inside the step
+        (before any microbatch split) with a key derived from
+        ``(augment_seed, state.step, device mesh position)`` — per-step,
+        per-replica randomness, bit-reproducible across runs (see
+        ``ops/augment.py``).
         """
         comm = self.comm
         if not isinstance(comm, XlaCommunicator):
@@ -229,6 +245,9 @@ class MultiNodeOptimizer:
             vparams = jax.tree_util.tree_map(
                 lambda p: pvary(p, axes), state.params
             )
+            if augment is not None:
+                batch = augment(_augment_key(augment_seed, state.step, axes),
+                                batch)
             loss, aux, new_model_state, grads = _accumulated_grads(
                 grad_one, vparams, state.model_state, batch, accum_steps
             )
@@ -292,16 +311,19 @@ class MultiNodeOptimizer:
         has_aux: bool = False,
         stateful: bool = False,
         accum_steps: int = 1,
+        augment: Optional[Callable] = None,
+        augment_seed: int = 0,
     ) -> Tuple[TrainState, dict]:
         """Eager-style API mirroring ``_MultiNodeOptimizer.update``: caches the
         jitted step per ``loss_fn``."""
         return _eager_update(
-            self, state, batch, loss_fn, has_aux, stateful, accum_steps
+            self, state, batch, loss_fn, has_aux, stateful, accum_steps,
+            augment, augment_seed,
         )
 
 
 def _eager_update(opt, state, batch, loss_fn, has_aux, stateful,
-                  accum_steps=1):
+                  accum_steps=1, augment=None, augment_seed=0):
     """Shared eager-style update: cache the jitted step per (loss_fn, flags)
     — keyed by the FUNCTION OBJECT (holding a reference), not ``id()``,
     which can be recycled after gc — and serialize steps on the CPU
@@ -309,12 +331,23 @@ def _eager_update(opt, state, batch, loss_fn, has_aux, stateful,
     deadlock when launches overlap across the virtual device pool.  The CPU
     mesh exists only to SIMULATE a pod; real TPU/GPU paths keep async
     dispatch and compiler overlap."""
-    key = (loss_fn, has_aux, stateful, accum_steps)
+    key = (loss_fn, has_aux, stateful, accum_steps, augment, augment_seed)
     step = opt._step_cache.get(key)
     if step is None:
         step = opt._step_cache[key] = opt.make_train_step(
-            loss_fn, has_aux, stateful, accum_steps=accum_steps
+            loss_fn, has_aux, stateful, accum_steps=accum_steps,
+            augment=augment, augment_seed=augment_seed,
         )
+        if len(opt._step_cache) == 9:  # warn once, at the 9th variant
+            import warnings
+
+            warnings.warn(
+                "9+ distinct train-step variants compiled on one optimizer: "
+                "loss_fn/augment must be the SAME callable across update() "
+                "calls (build closures like random_crop_flip() once, outside "
+                "the loop) or every step pays a fresh jit compile.",
+                stacklevel=3,
+            )
     batch = opt.comm.shard_batch(batch)
     out = step(state, batch)
     try:

@@ -196,6 +196,8 @@ class ZeroMultiNodeOptimizer:
         stateful: bool = False,
         donate: bool = True,
         accum_steps: int = 1,
+        augment: Callable = None,
+        augment_seed: int = 0,
     ) -> Callable:
         comm = self.comm
         axes = comm.axes
@@ -208,7 +210,7 @@ class ZeroMultiNodeOptimizer:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         # Deferred import (same pattern as update()'s _eager_update): the
         # optimizers package imports this module at its bottom.
-        from chainermn_tpu.optimizers import _accumulated_grads
+        from chainermn_tpu.optimizers import _accumulated_grads, _augment_key
 
         wire = getattr(comm, "allreduce_grad_dtype", None)
 
@@ -264,6 +266,9 @@ class ZeroMultiNodeOptimizer:
             # accumulation scan (one gather + one reduce-scatter per step
             # regardless of accum_steps).
             params = gather_full(state.flat_params)
+            if augment is not None:
+                batch = augment(_augment_key(augment_seed, state.step, axes),
+                                batch)
             loss, aux, new_model_state, grads = _accumulated_grads(
                 grad_one, params, state.model_state, batch, accum_steps
             )
@@ -315,13 +320,16 @@ class ZeroMultiNodeOptimizer:
         has_aux: bool = False,
         stateful: bool = False,
         accum_steps: int = 1,
+        augment: Callable = None,
+        augment_seed: int = 0,
     ) -> Tuple[ZeroTrainState, dict]:
         """Eager-style API mirroring ``MultiNodeOptimizer.update`` (the
         ``training.Trainer`` contract)."""
         from chainermn_tpu.optimizers import _eager_update
 
         return _eager_update(
-            self, state, batch, loss_fn, has_aux, stateful, accum_steps
+            self, state, batch, loss_fn, has_aux, stateful, accum_steps,
+            augment, augment_seed,
         )
 
 

@@ -236,7 +236,8 @@ class Trainer:
     def __init__(self, optimizer, state, loss_fn, train_iter,
                  stop: Tuple[int, str] = (1, "epoch"),
                  extensions: Optional[List[Extension]] = None,
-                 has_aux: bool = False, stateful: bool = False):
+                 has_aux: bool = False, stateful: bool = False,
+                 step_kwargs: Optional[dict] = None):
         self.optimizer = optimizer
         self.state = state
         self.loss_fn = loss_fn
@@ -246,6 +247,9 @@ class Trainer:
         self.extensions = list(extensions or [])
         self.has_aux = has_aux
         self.stateful = stateful
+        # Extra make_train_step options threaded through optimizer.update
+        # (accum_steps, augment, ...).
+        self.step_kwargs = dict(step_kwargs or {})
         self.iteration = 0
         self._observations: List[dict] = []
 
@@ -269,7 +273,7 @@ class Trainer:
             batch = next(self.train_iter)
             self.state, metrics = self.optimizer.update(
                 self.state, batch, self.loss_fn, has_aux=self.has_aux,
-                stateful=self.stateful,
+                stateful=self.stateful, **self.step_kwargs,
             )
             self.iteration += 1
             # Keep raw device arrays — no host sync on the hot path.

@@ -30,6 +30,8 @@ def main():
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
+    p.add_argument("--augment", action="store_true",
+                   help="device-side random crop+flip inside the jitted step")
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes for CI (64px, 10 classes, resnet18)")
     p.add_argument("--force-cpu", action="store_true")
@@ -98,8 +100,15 @@ def main():
     # host→device transfer overlaps the previous step's compute (the
     # reference's pinned-buffer staging role, done with async dispatch).
     it = cmn.create_device_prefetch_iterator(it, comm, depth=2)
+    step_kwargs = {}
+    if args.augment:
+        from chainermn_tpu.ops import random_crop_flip
+
+        # Reference parity: the example's host-side random crop/flip
+        # transforms, moved onto the chip (fused into the step's prologue).
+        step_kwargs["augment"] = random_crop_flip(padding=4)
     trainer = Trainer(opt, state, loss_fn, it, stop=(args.epoch, "epoch"),
-                      stateful=True)
+                      stateful=True, step_kwargs=step_kwargs)
     trainer.extend(LogReport(trigger=(1, "epoch")))
     if args.checkpoint:
         ckpt = cmn.create_multi_node_checkpointer(
