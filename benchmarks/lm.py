@@ -32,6 +32,10 @@ def main():
                     help="gradient-accumulation microbatches per step")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize decoder blocks (jax.checkpoint)")
+    ap.add_argument("--ce-chunk", type=int, default=0,
+                    help="stream the LM head in vocab chunks of this size "
+                         "(chunked_softmax_cross_entropy) instead of "
+                         "materializing (B,T,vocab) logits")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for CPU plumbing checks")
     ap.add_argument("--out", default=None)
@@ -47,7 +51,7 @@ def main():
     import optax
 
     import chainermn_tpu as cmn
-    from chainermn_tpu.models import TransformerLM, lm_loss
+    from chainermn_tpu.models import TransformerLM, lm_loss, lm_loss_chunked
 
     platform = jax.devices()[0].platform
     n_dev = len(jax.devices())
@@ -74,6 +78,7 @@ def main():
             "batch": args.batch, "seq": args.seq, "layers": args.layers,
             "d_model": args.d_model, "heads": args.heads, "d_ff": args.d_ff,
             "vocab": args.vocab, "accum": args.accum, "remat": args.remat,
+            "ce_chunk": args.ce_chunk,
         },
     }
 
@@ -103,7 +108,12 @@ def main():
             state = opt.init(params)
         else:
             state = jax.block_until_ready(jax.jit(opt.init)(params))
-        step = opt.make_train_step(lm_loss(model), has_aux=True,
+        loss_fn = (
+            lm_loss_chunked(model, chunk_size=args.ce_chunk)
+            if args.ce_chunk
+            else lm_loss(model)
+        )
+        step = opt.make_train_step(loss_fn, has_aux=True,
                                    accum_steps=args.accum)
 
         flops = None

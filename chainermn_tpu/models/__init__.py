@@ -41,6 +41,7 @@ from chainermn_tpu.models.transformer import (
     dense_lm_reference,
     init_parallel_lm,
     lm_loss,
+    lm_loss_chunked,
     parallel_lm_specs,
 )
 
@@ -64,6 +65,7 @@ __all__ = [
     "greedy_decode",
     "TransformerLM",
     "lm_loss",
+    "lm_loss_chunked",
     "ParallelLM",
     "ParallelLMConfig",
     "init_parallel_lm",

@@ -100,7 +100,10 @@ class TransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens):  # (B, T) int32 -> (B, T, vocab) f32
+    def __call__(self, tokens, return_hidden: bool = False):
+        """(B, T) int32 → (B, T, vocab) fp32 logits; with
+        ``return_hidden=True``, the pre-head (B, T, d_model) hidden states
+        instead (for :func:`lm_loss_chunked`, which streams the head)."""
         B, T = tokens.shape
         D = self.d_model
         h = nn.Embed(self.vocab, D, dtype=self.dtype, name="embed")(tokens)
@@ -116,6 +119,8 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
+        if return_hidden:
+            return h
         return nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(h)
 
 
@@ -131,6 +136,31 @@ def lm_loss(model: nn.Module):
         safe = jnp.maximum(targets, 0)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
         loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return loss, {"ppl_log": loss}
+
+    return loss_fn
+
+
+def lm_loss_chunked(model: nn.Module, chunk_size: int = 4096):
+    """Same contract as :func:`lm_loss`, but the LM head is streamed through
+    :func:`~chainermn_tpu.ops.chunked_softmax_cross_entropy` — the
+    ``(B, T, vocab)`` logits are never materialized (working memory
+    ``O(B·T·chunk_size)``).  The head params (``lm_head/kernel|bias``) are
+    read from the tree, so the same initialized params serve both losses."""
+    from chainermn_tpu.ops import chunked_softmax_cross_entropy
+
+    def loss_fn(params, batch):
+        tokens, targets = batch
+        hidden = model.apply({"params": params}, tokens, return_hidden=True)
+        head = params["lm_head"]
+        # Match nn.Dense(dtype=fp32): inputs cast to fp32 before the matmul
+        # (the chunk einsum accumulates fp32 regardless).
+        ce = chunked_softmax_cross_entropy(
+            hidden.astype(jnp.float32), head["kernel"], targets,
+            bias=head["bias"], chunk_size=chunk_size,
+        )
+        mask = (targets >= 0).astype(jnp.float32)
+        loss = jnp.sum(ce) / jnp.maximum(jnp.sum(mask), 1.0)
         return loss, {"ppl_log": loss}
 
     return loss_fn

@@ -7,6 +7,7 @@ has an XLA fallback so the package stays portable (CPU tests run the same
 code in interpret mode).
 """
 
+from chainermn_tpu.ops.chunked_ce import chunked_softmax_cross_entropy
 from chainermn_tpu.ops.augment import (
     random_crop,
     random_crop_flip,
@@ -22,6 +23,7 @@ __all__ = [
     "flash_attention",
     "flash_attention_lse",
     "reference_attention",
+    "chunked_softmax_cross_entropy",
     "random_crop",
     "random_crop_flip",
     "random_flip",

@@ -106,5 +106,6 @@ def test_two_process_integration(tmp_path):
             "zero_optimizer",
             "checkpoint",
             "corpus_evaluator",
+            "device_prefetch",
         ):
             assert v.get(key) == "ok", (pid, key, v)

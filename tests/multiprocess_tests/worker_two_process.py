@@ -263,6 +263,31 @@ def main() -> dict:
         np.testing.assert_allclose(a, np.asarray(b), atol=3e-6, rtol=3e-6)
     out["zero_optimizer"] = "ok"
 
+    # --- device prefetch across 2 processes ------------------------------
+    # Each process feeds ITS dataset shard through the device-prefetch
+    # queue; the yielded global arrays must assemble this host's rows in
+    # order, and the optimizer path's re-shard must be the identity fast
+    # path (no host round trip of a multi-host global array — np.asarray on
+    # one would raise).
+    from chainermn_tpu.datasets import ArrayDataset
+    from chainermn_tpu.iterators import SerialIterator
+
+    pxs, pys = xs[mine], ys[mine]
+    dit = cmn.create_device_prefetch_iterator(
+        SerialIterator(ArrayDataset(pxs, pys), 2, shuffle=False,
+                       repeat=False),
+        comm, depth=2,
+    )
+    got_batches = list(dit)
+    assert len(got_batches) == 2, len(got_batches)
+    for i, (bx, by) in enumerate(got_batches):
+        assert bx.shape[0] == 4  # global leading dim: 2 rows x 2 processes
+        again_x, again_y = comm.shard_batch((bx, by))
+        assert again_x is bx and again_y is by
+        local = np.asarray(bx.addressable_shards[0].data)
+        np.testing.assert_allclose(local, pxs[2 * i : 2 * i + 2], atol=0)
+    out["device_prefetch"] = "ok"
+
     comm.barrier()
     cmn.shutdown_distributed()
     out["status"] = "ok"
