@@ -1,0 +1,140 @@
+"""Memory-lever ablation: XLA's own buffer-assignment numbers per config.
+
+For the Transformer-LM train step, compiles (never executes) each config and
+records ``compiled.memory_analysis()`` — XLA's temp/argument/output buffer
+sizes after fusion and scheduling.  This is the compiler's ground truth for
+what the levers buy:
+
+  * ``remat``    — decoder blocks rematerialized (``TransformerLM(remat=)``)
+  * ``accum``    — gradient accumulation (``make_train_step(accum_steps=)``)
+  * ``ce_chunk`` — chunked LM-head loss (``lm_loss_chunked``)
+
+Lowering uses abstract ShapeDtypeStructs (``jax.eval_shape``), so no batch
+or parameter arrays are materialized — the harness runs in seconds and needs
+the device only as a compile target.  Numbers are per-platform (buffer
+assignment differs between XLA:CPU and XLA:TPU); the TPU run is the honest
+one and the watcher captures it (``result/memory_tpu.json``).
+
+    python benchmarks/memory.py --out result/memory_tpu.json    # on TPU
+    JAX_PLATFORMS=cpu python benchmarks/memory.py --smoke       # plumbing
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--d-ff", type=int, default=3072)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--ce-chunk", type=int, default=4096)
+    ap.add_argument("--accum", type=int, default=4)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="explicitly permit a (clearly labeled) CPU run")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from chainermn_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    if (jax.devices()[0].platform != "tpu" and not args.smoke
+            and not args.allow_cpu):
+        # Same policy as the sibling benches: a CPU fallback must never
+        # claim the TPU artifact slot (--out is skipped too).
+        print(json.dumps({
+            "error": f"memory ablation wants a TPU (got "
+                     f"{jax.devices()[0].platform}); pass --smoke or "
+                     "--allow-cpu for an explicitly labeled CPU run"
+        }))
+        return
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models import (
+        TransformerLM,
+        lm_loss,
+        lm_loss_chunked,
+    )
+
+    if args.smoke:
+        args.batch, args.seq, args.layers = 2, 256, 2
+        args.d_model, args.heads, args.d_ff = 128, 4, 256
+        args.vocab, args.ce_chunk, args.accum = 1024, 256, 2
+
+    comm = cmn.create_communicator("xla")
+    out = {
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "config": vars(args).copy(),
+        "configs": {},
+    }
+    out["config"].pop("out", None)
+
+    batch_abs = (
+        jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32),
+        jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32),
+    )
+
+    def analyze(name, remat=False, accum=1, ce_chunk=0):
+        model = TransformerLM(
+            vocab=args.vocab, n_layers=args.layers, d_model=args.d_model,
+            n_heads=args.heads, d_ff=args.d_ff, max_len=args.seq,
+            remat=remat,
+        )
+        loss_fn = (
+            lm_loss_chunked(model, chunk_size=ce_chunk)
+            if ce_chunk
+            else lm_loss(model)
+        )
+        opt = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
+        # Abstract all the way down: shapes of params/state via eval_shape,
+        # so nothing is materialized on (or transferred to) the device.
+        params_abs = jax.eval_shape(
+            lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, args.seq), jnp.int32)
+            )["params"]
+        )
+        state_abs = jax.eval_shape(opt.init, params_abs)
+        step = opt.make_train_step(loss_fn, has_aux=True, accum_steps=accum)
+        mem = step.lower(state_abs, batch_abs).compile().memory_analysis()
+        rec = {}
+        for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                rec[k.replace("_in_bytes", "_mb")] = round(v / 2**20, 1)
+        out["configs"][name] = rec
+        print(json.dumps({name: rec}), flush=True)
+
+    analyze("baseline")
+    analyze("remat", remat=True)
+    analyze(f"accum{args.accum}", accum=args.accum)
+    analyze("ce_chunk", ce_chunk=args.ce_chunk)
+    analyze("remat+accum+ce_chunk", remat=True, accum=args.accum,
+            ce_chunk=args.ce_chunk)
+
+    base = out["configs"]["baseline"].get("temp_size_mb")
+    if base:
+        for name, rec in out["configs"].items():
+            if "temp_size_mb" in rec:
+                rec["temp_vs_baseline"] = round(rec["temp_size_mb"] / base, 3)
+    print(json.dumps({k: v for k, v in out.items() if k != "config"}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,10 +23,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-#: Additive bias for padded vocab columns: large enough that exp() == 0 in
-#: fp32, small enough that (lse - it) stays finite under AD.
-_PAD_NEG = -1e30
-
 
 def chunked_softmax_cross_entropy(
     hidden: jax.Array,
@@ -62,48 +58,66 @@ def chunked_softmax_cross_entropy(
     N = h.shape[0]
 
     chunk = min(chunk_size, V)
-    nc = -(-V // chunk)
-    Vp = nc * chunk
-    Wp = jnp.pad(kernel, ((0, 0), (0, Vp - V)))
-    b = bias if bias is not None else jnp.zeros((V,), jnp.float32)
-    bp = jnp.pad(
-        b.astype(jnp.float32), (0, Vp - V), constant_values=_PAD_NEG
+    # Full chunks go through the scan; a ragged tail (V % chunk) is one
+    # static extra block — no padded (D, V') copy of the head weight (at
+    # 128k vocab that copy would cost GBs, defeating the op's purpose).
+    n_full = V // chunk
+    tail = V % chunk
+    b = (bias if bias is not None else jnp.zeros((V,), jnp.float32)).astype(
+        jnp.float32
     )
 
     valid = t >= 0
     ts = jnp.where(valid, t, 0)
 
-    def body(carry, c):
+    def merge(carry, logits, start):
+        """Fold one block of logits (N, width) at vocab offset ``start``
+        into the online (max, sumexp, target-logit) carry."""
         m, s, tl = carry
-        start = c * chunk
-        w_c = lax.dynamic_slice(Wp, (0, start), (D, chunk))
-        b_c = lax.dynamic_slice(bp, (start,), (chunk,))
-        logits = (
-            jnp.einsum("nd,dc->nc", h, w_c,
-                       preferred_element_type=jnp.float32)
-            + b_c
-        )
+        width = logits.shape[1]
         m_new = jnp.maximum(m, logits.max(axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.exp(
             logits - m_new[:, None]
         ).sum(axis=-1)
         local = ts - start
-        inc = (local >= 0) & (local < chunk)
+        inc = (local >= 0) & (local < width)
         lt = jnp.take_along_axis(
-            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1
+            logits, jnp.clip(local, 0, width - 1)[:, None], axis=1
         )[:, 0]
         tl = jnp.where(inc, lt, tl)
-        return (m_new, s, tl), None
+        return m_new, s, tl
+
+    def block_logits(w_c, b_c):
+        return (
+            jnp.einsum("nd,dc->nc", h, w_c,
+                       preferred_element_type=jnp.float32)
+            + b_c
+        )
+
+    def body(carry, c):
+        start = c * chunk
+        w_c = lax.dynamic_slice(kernel, (0, start), (D, chunk))
+        b_c = lax.dynamic_slice(b, (start,), (chunk,))
+        return merge(carry, block_logits(w_c, b_c), start), None
 
     # Derive the carry init from the (device-varying) targets so its vma
     # type matches the body's outputs under shard_map's check_vma — fresh
     # jnp.zeros would be unvarying and rejected.  Integer multiply avoids
     # any 0·inf hazard a float derivation would have.
     zero = (ts * 0).astype(jnp.float32)
-    init = (zero - jnp.inf, zero, zero)
-    # checkpoint: the backward recomputes each chunk's logits instead of
-    # storing nc × (N, chunk) activations.
-    (m, s, tl), _ = lax.scan(jax.checkpoint(body), init, jnp.arange(nc))
+    carry = (zero - jnp.inf, zero, zero)
+    if n_full:
+        # checkpoint: the backward recomputes each chunk's logits instead
+        # of storing n_full × (N, chunk) activations.
+        carry, _ = lax.scan(
+            jax.checkpoint(body), carry, jnp.arange(n_full)
+        )
+    if tail:
+        start = n_full * chunk
+        carry = merge(
+            carry, block_logits(kernel[:, start:], b[start:]), start
+        )
+    m, s, tl = carry
     lse = m + jnp.log(s)
     ce = (lse - tl) * valid.astype(jnp.float32)
     return ce.reshape(lead)

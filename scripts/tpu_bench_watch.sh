@@ -47,8 +47,15 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# lm bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/memory_tpu.json ]; then
+      echo "# running memory ablation at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/memory.py --out result/memory_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# memory ablation rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ -s result/flash_tpu.json ] \
-       && [ -s result/collectives_tpu.json ] && [ -s result/lm_tpu.json ]; then
+       && [ -s result/collectives_tpu.json ] && [ -s result/lm_tpu.json ] \
+       && [ -s result/memory_tpu.json ]; then
       exit 0
     fi
   fi
