@@ -40,16 +40,18 @@ def _use_interpret() -> bool:
         return True
 
 
-def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
+def reference_attention(q, k, v, causal: bool = False,
+                        segment_ids=None) -> jax.Array:
     """Plain-XLA softmax attention over ``(B, T, H, D)`` — the single
     correctness oracle every flash test/benchmark compares against (one
     implementation, so the CPU interpret tests and the on-chip harness can
     never validate against diverging references).  Computed in fp32, cast
     back to the input dtype."""
-    return _reference_attention_lse(q, k, v, causal)[0]
+    return _reference_attention_lse(q, k, v, causal, segment_ids)[0]
 
 
-def _reference_attention_lse(q, k, v, causal: bool = False):
+def _reference_attention_lse(q, k, v, causal: bool = False,
+                             segment_ids=None):
     """:func:`reference_attention` + per-row logsumexp ``(B, H, T)`` — the
     XLA twin of :func:`flash_attention_lse` (used as its vma-checked
     interpret-mode fallback)."""
@@ -61,6 +63,9 @@ def _reference_attention_lse(q, k, v, causal: bool = False):
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask, s, NEG_INF)
+    if segment_ids is not None:
+        seg = (segment_ids[:, :, None] == segment_ids[:, None, :])
+        s = jnp.where(seg[:, None, :, :], s, NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B, H, T)
     p = jnp.exp(s - lse[..., None])
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
@@ -68,13 +73,20 @@ def _reference_attention_lse(q, k, v, causal: bool = False):
 
 
 # --------------------------------------------------------------------- fwd
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
+                block_k, causal, segmented, scale):
     # q_ref: (1, BQ, D); k/v_ref: (1, T, D); o_ref: (1, BQ, D); lse: (1, BQ)
+    # segmented: extra (1, BQ) q-segment + (1, T) k-segment int32 refs.
+    if segmented:
+        segq_ref, segk_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
     T = k_ref.shape[1]
     D = q_ref.shape[2]
     q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+    seg_q = segq_ref[0] if segmented else None  # (BQ,)
 
     n_k = T // block_k
     if causal:
@@ -100,6 +112,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal, scale):
                 jnp.int32, (bq, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if segmented:
+            seg_k = segk_ref[0, pl.ds(ki * block_k, block_k)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
@@ -133,21 +148,33 @@ def _vma_union(*arrays):
         out |= getattr(jax.typeof(a), "vma", frozenset())
     return out
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
+         interpret):
     BH, T, D = q.shape
     scale = 1.0 / math.sqrt(D)
     grid = (BH, T // block_q)
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, causal=causal, scale=scale
+        _fwd_kernel, block_k=block_k, causal=causal, segmented=segmented,
+        scale=scale,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+    ]
+    args = [q, k, v]
+    if segmented:
+        # seg stays (B, T) — every head of batch row b // heads shares it
+        # (no H-fold copy); passed twice: q-block view + full-row k view.
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i: (b // heads, i)),
+            pl.BlockSpec((1, T), lambda b, i: (b // heads, 0)),
+        ]
+        args += [seg, seg]
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
@@ -157,22 +184,27 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((BH, T), jnp.float32, vma=_vma_union(q, k, v)),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
 # --------------------------------------------------------------------- bwd
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q, causal, scale,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q, causal, segmented, scale,
 ):
     # k/v_ref, dk/dv_ref: (1, BK, D); q/do_ref: (1, T, D); lse/delta: (1, T)
+    if segmented:
+        segq_ref, segk_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     bk = k_ref.shape[1]
     T = q_ref.shape[1]
     D = k_ref.shape[2]
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
+    seg_k = segk_ref[0] if segmented else None  # (BK,)
 
     n_q = T // block_q
     if causal:
@@ -199,6 +231,9 @@ def _bwd_dkv_kernel(
                 jnp.int32, (block_q, bk), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if segmented:
+            seg_q = segq_ref[0, pl.ds(qi * block_q, block_q)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])  # (BQ, BK), exact softmax via saved LSE
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -224,9 +259,13 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k, causal, scale,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_k, causal, segmented, scale,
 ):
+    if segmented:
+        segq_ref, segk_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
     T = k_ref.shape[1]
@@ -235,6 +274,7 @@ def _bwd_dq_kernel(
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]
     delta = delta_ref[0]
+    seg_q = segq_ref[0] if segmented else None  # (BQ,)
 
     n_k = T // block_k
     if causal:
@@ -258,6 +298,9 @@ def _bwd_dq_kernel(
                 jnp.int32, (bq, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if segmented:
+            seg_k = segk_ref[0, pl.ds(ki * block_k, block_k)]
+            s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -273,12 +316,13 @@ def _bwd_dq_kernel(
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, interpret, residuals, g, dlse=None):
+def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
+         g, dlse=None):
     """Shared backward.  ``dlse`` (cotangent of the logsumexp output, used by
     the LSE-exposing API) folds into the kernels for free: ``∂lse_i/∂s_ij =
     p_ij``, so the lse cotangent just shifts the per-row delta —
     ``ds = p·(dp − (delta − dlse))`` — and both kernels run unchanged."""
-    q, k, v, o, lse = residuals
+    q, k, v, seg, o, lse = residuals
     do = g
     BH, T, D = q.shape
     scale = 1.0 / math.sqrt(D)
@@ -287,19 +331,29 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g, dlse=None):
         delta = delta - dlse.astype(jnp.float32)
 
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale
+        _bwd_dkv_kernel, block_q=block_q, causal=causal,
+        segmented=segmented, scale=scale,
     )
+    in_specs = [
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # q
+        pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # k
+        pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # v
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # do
+        pl.BlockSpec((1, T), lambda b, i: (b, 0)),             # lse
+        pl.BlockSpec((1, T), lambda b, i: (b, 0)),             # delta
+    ]
+    args = [q, k, v, do, lse, delta]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, T), lambda b, i: (b // heads, 0)),  # seg (q rows)
+            pl.BlockSpec((1, block_k),
+                         lambda b, i: (b // heads, i)),          # seg (k blk)
+        ]
+        args += [seg, seg]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, T // block_k),
-        in_specs=[
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # q
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # k
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # v
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # do
-            pl.BlockSpec((1, T), lambda b, i: (b, 0)),             # lse
-            pl.BlockSpec((1, T), lambda b, i: (b, 0)),             # delta
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
@@ -313,45 +367,63 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g, dlse=None):
             ),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*args)
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale
+        _bwd_dq_kernel, block_k=block_k, causal=causal,
+        segmented=segmented, scale=scale,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),        # k
+        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),        # v
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do
+        pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # lse
+        pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # delta
+    ]
+    args = [q, k, v, do, lse, delta]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q),
+                         lambda b, i: (b // heads, i)),          # seg (q blk)
+            pl.BlockSpec((1, T), lambda b, i: (b // heads, 0)),  # seg (k rows)
+        ]
+        args += [seg, seg]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, T // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),        # k
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),        # v
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # lse
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # delta
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(
             (BH, T, D), q.dtype, vma=_vma_union(q, k, v, do, lse, delta)
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*args)
     return dq, dk, dv
 
 
 # --------------------------------------------------------------------- api
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
-    return _fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, seg, segmented, heads, causal, block_q, block_k,
+               interpret):
+    return _fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
+                interpret)
 
 
-def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return (o, lse), (q, k, v, o, lse)
+def _flash_lse_fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
+                   interpret):
+    o, lse = _fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
+                  interpret)
+    return (o, lse), (q, k, v, seg, o, lse)
 
 
-def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_lse_bwd(segmented, heads, causal, block_q, block_k, interpret,
+                   residuals, g):
     do, dlse = g
-    return _bwd(causal, block_q, block_k, interpret, residuals, do, dlse=dlse)
+    dq, dk, dv = _bwd(segmented, heads, causal, block_q, block_k, interpret,
+                      residuals, do, dlse=dlse)
+    # seg is integer-typed: its cotangent is the symbolic zero.
+    return dq, dk, dv, None
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -362,6 +434,7 @@ def flash_attention_lse(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
+    segment_ids: Optional[jax.Array] = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
@@ -386,6 +459,12 @@ def flash_attention_lse(
             f"seq len {T} must be a multiple of block sizes "
             f"({block_q}, {block_k})"
         )
+    segmented = segment_ids is not None
+    if segmented and segment_ids.shape != (B, T):
+        raise ValueError(
+            f"segment_ids must be (batch, seq) = {(B, T)}, got "
+            f"{segment_ids.shape}"
+        )
     if interpret and _vma_union(q, k, v):
         # Interpret-mode Pallas cannot be traced through shard_map's vma
         # checker (its kernel jaxpr mixes varying refs with invariant index
@@ -393,13 +472,21 @@ def flash_attention_lse(
         # limitation).  Off-TPU inside a checked shard_map, compute the
         # mathematically identical XLA form instead; the compiled kernel is
         # unaffected (opaque to the checker).
-        return _reference_attention_lse(q, k, v, causal)
+        return _reference_attention_lse(q, k, v, causal, segment_ids)
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
+    # seg stays (B, T): the kernels' index maps read row b // H, so every
+    # head shares one copy (no H-fold materialization in the residuals).
+    seg = (
+        segment_ids.astype(jnp.int32)
+        if segmented
+        else jnp.zeros((1, 1), jnp.int32)  # unused placeholder
+    )
     o, lse = _flash_lse(
-        to_bh(q), to_bh(k), to_bh(v), causal, block_q, block_k, interpret
+        to_bh(q), to_bh(k), to_bh(v), seg, segmented, H, causal, block_q,
+        block_k, interpret,
     )
     return (
         o.reshape(B, H, T, D).transpose(0, 2, 1, 3),
@@ -412,20 +499,24 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
+    segment_ids: Optional[jax.Array] = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention over ``(batch, seq, heads, head_dim)`` inputs.
 
-    Requires ``seq % block == 0`` (pad upstream; the data layer's bucketing
-    keeps XLA-friendly static shapes anyway).  Differentiable via the flash
-    backward.  ``interpret=None`` auto-selects interpret mode off-TPU.
+    ``segment_ids`` (``(batch, seq)`` int32) masks attention to same-segment
+    pairs — packed sequences and padding (give pad positions their own id)
+    without materialized masks.  Requires ``seq % block == 0`` (pad
+    upstream; the data layer's bucketing keeps XLA-friendly static shapes
+    anyway).  Differentiable via the flash backward.  ``interpret=None``
+    auto-selects interpret mode off-TPU.
 
     Thin facade over :func:`flash_attention_lse` (one custom-VJP path to
     maintain); the dropped lse output arrives in the backward as a zero
     cotangent, which folds away inside the shared kernels."""
     return flash_attention_lse(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        q, k, v, causal=causal, segment_ids=segment_ids, block_q=block_q,
+        block_k=block_k, interpret=interpret,
     )[0]

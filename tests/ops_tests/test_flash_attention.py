@@ -71,6 +71,65 @@ def test_flash_bf16_forward_close():
     )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("blocks", [(32, 32), (64, 32)])
+def test_flash_segments_match_oracle(causal, blocks):
+    """Packed sequences: attention must stay within segment boundaries,
+    forward AND gradients (the masked pairs' grads are exactly zero)."""
+    bq, bk = blocks
+    rng = np.random.RandomState(6)
+    q, k, v = _qkv(rng, B=2, T=128, H=2, D=32)
+    # Three packed documents per row + a padding tail with its own id.
+    seg = np.zeros((2, 128), np.int32)
+    seg[:, 40:90] = 1
+    seg[:, 90:112] = 2
+    seg[:, 112:] = 3
+    seg[1, 30:] += 1  # different packing per row
+    seg = jnp.asarray(seg)
+
+    out = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                          block_q=bq, block_k=bk)
+    ref = reference_attention(q, k, v, causal, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=1e-4)
+
+    probe = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+    g = jax.grad(lambda qkv: jnp.sum(flash_attention(
+        *qkv, causal=causal, segment_ids=seg, block_q=bq, block_k=bk
+    ) * probe))((q, k, v))
+    og = jax.grad(lambda qkv: jnp.sum(reference_attention(
+        *qkv, causal, segment_ids=seg
+    ) * probe))((q, k, v))
+    for name, a, b in zip("qkv", g, og):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-3,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_segments_isolate_documents():
+    """A document's output must be identical whether the other documents
+    share its buffer or not — the packed computation leaks nothing."""
+    rng = np.random.RandomState(7)
+    q, k, v = _qkv(rng, B=1, T=64, H=2, D=16)
+    seg = jnp.asarray(
+        np.concatenate([np.zeros(32, np.int32), np.ones(32, np.int32)])
+    )[None]
+    packed = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                             block_q=32, block_k=32)
+    alone = flash_attention(q[:, :32], k[:, :32], v[:, :32], causal=True,
+                            block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(packed[:, :32]),
+                               np.asarray(alone), atol=2e-5, rtol=1e-4)
+
+
+def test_flash_segments_shape_validation():
+    q, k, v = _qkv(np.random.RandomState(8), B=2, T=64)
+    with pytest.raises(ValueError, match="segment_ids"):
+        flash_attention(q, k, v, segment_ids=jnp.zeros((2, 32), jnp.int32),
+                        block_q=32, block_k=32)
+
+
 def test_flash_rejects_ragged_seq():
     q, k, v = _qkv(np.random.RandomState(4), T=100)
     with pytest.raises(ValueError, match="multiple of block"):
