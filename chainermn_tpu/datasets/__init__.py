@@ -133,3 +133,8 @@ def make_synthetic_classification(
     logits = x @ proj + noise * rng.normal(size=(n, classes)).astype(np.float32)
     y = np.argmax(logits, axis=1).astype(np.int32)
     return ArrayDataset(x, y)
+
+from chainermn_tpu.datasets.packing import (  # noqa: E402
+    pack_sequences,
+    packing_efficiency,
+)

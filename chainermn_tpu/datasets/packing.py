@@ -1,0 +1,87 @@
+"""Sequence packing for fixed-shape LM training.
+
+XLA needs static shapes; variable-length documents either pad (wasting
+compute on pad tokens) or PACK — several documents per row, attention kept
+within each document by the flash kernel's ``segment_ids`` masking
+(:func:`chainermn_tpu.ops.flash_attention`) and positions restarting per
+document (:class:`~chainermn_tpu.models.TransformerLM` does this when given
+``segment_ids``).  The bucketing data layer (``datasets/seq.py``) is the
+padding half of that trade; this module is the packing half.
+
+Layout per row: documents first-fit greedily into ``seq_len`` slots,
+segment ids ``1, 2, …`` per document, ``0`` for the padding tail; targets
+are next-token WITHIN each document (the last token of a document and all
+padding get ``-1`` = ignore, matching ``lm_loss``'s contract).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def pack_sequences(
+    docs: Sequence[np.ndarray],
+    seq_len: int,
+    drop_overlong: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack token documents into fixed ``(N, seq_len)`` rows.
+
+    Args:
+      docs: int token arrays (1-D, any lengths ≥ 1).
+      seq_len: row width.
+      drop_overlong: documents longer than ``seq_len`` are split into
+        ``seq_len``-sized pieces (default) or dropped.
+
+    Returns ``(tokens, targets, segment_ids)``, each ``(N, seq_len)`` int32:
+    padding tokens are 0 with segment id 0 and target −1.
+    """
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    pieces: List[np.ndarray] = []
+    for d in docs:
+        d = np.asarray(d, np.int32).reshape(-1)
+        if len(d) == 0:
+            continue
+        if len(d) > seq_len:
+            if drop_overlong:
+                continue
+            pieces.extend(
+                d[i : i + seq_len] for i in range(0, len(d), seq_len)
+            )
+        else:
+            pieces.append(d)
+    # First-fit decreasing: near-optimal fill with deterministic layout.
+    pieces.sort(key=len, reverse=True)
+    rows: List[List[np.ndarray]] = []
+    space: List[int] = []
+    for p in pieces:
+        for r, free in enumerate(space):
+            if free >= len(p):
+                rows[r].append(p)
+                space[r] -= len(p)
+                break
+        else:
+            rows.append([p])
+            space.append(seq_len - len(p))
+
+    n = len(rows)
+    tokens = np.zeros((n, seq_len), np.int32)
+    targets = np.full((n, seq_len), -1, np.int32)
+    seg = np.zeros((n, seq_len), np.int32)
+    for r, row_docs in enumerate(rows):
+        at = 0
+        for s, d in enumerate(row_docs, start=1):
+            L = len(d)
+            tokens[r, at : at + L] = d
+            targets[r, at : at + L - 1] = d[1:]  # last token of doc: -1
+            seg[r, at : at + L] = s
+            at += L
+    return tokens, targets, seg
+
+
+def packing_efficiency(segment_ids: np.ndarray) -> float:
+    """Fraction of non-padding slots (segment id != 0)."""
+    seg = np.asarray(segment_ids)
+    return float((seg != 0).mean()) if seg.size else 0.0

@@ -49,7 +49,7 @@ class _DecoderBlock(nn.Module):
     attention: str
 
     @nn.compact
-    def __call__(self, h):
+    def __call__(self, h, segment_ids=None):
         from chainermn_tpu.ops import flash_attention, reference_attention
 
         T = h.shape[1]
@@ -63,10 +63,13 @@ class _DecoderBlock(nn.Module):
             block = 128
             while block > 1 and T % block:
                 block //= 2
-            a = flash_attention(q, k, v, causal=True, block_q=block,
+            a = flash_attention(q, k, v, causal=True,
+                                segment_ids=segment_ids, block_q=block,
                                 block_k=block)
         elif self.attention == "xla":
-            a = reference_attention(q, k, v, causal=True).astype(q.dtype)
+            a = reference_attention(
+                q, k, v, causal=True, segment_ids=segment_ids
+            ).astype(q.dtype)
         else:
             raise ValueError(
                 f"attention={self.attention!r}: expected 'flash' or 'xla'"
@@ -100,24 +103,45 @@ class TransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens, return_hidden: bool = False):
+    def __call__(self, tokens, segment_ids=None, return_hidden: bool = False):
         """(B, T) int32 → (B, T, vocab) fp32 logits; with
         ``return_hidden=True``, the pre-head (B, T, d_model) hidden states
-        instead (for :func:`lm_loss_chunked`, which streams the head)."""
+        instead (for :func:`lm_loss_chunked`, which streams the head).
+
+        ``segment_ids`` (``(B, T)`` int32, from
+        :func:`~chainermn_tpu.datasets.pack_sequences`) trains PACKED rows:
+        attention masked within each document and positional encodings
+        restarting at each document boundary — a packed document computes
+        exactly what it would alone."""
         B, T = tokens.shape
         D = self.d_model
         h = nn.Embed(self.vocab, D, dtype=self.dtype, name="embed")(tokens)
         pos = self.param(
             "pos", nn.initializers.normal(0.02), (self.max_len, D), jnp.float32
         )
-        h = h + pos[None, :T].astype(self.dtype)
+        if segment_ids is None:
+            h = h + pos[None, :T].astype(self.dtype)
+        else:
+            # Per-document position restart: contiguous segments, so each
+            # token's offset is its index minus its segment's start (cummax
+            # of boundary indices).
+            idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+            is_new = jnp.concatenate(
+                [
+                    jnp.ones((B, 1), bool),
+                    segment_ids[:, 1:] != segment_ids[:, :-1],
+                ],
+                axis=1,
+            )
+            starts = lax.cummax(jnp.where(is_new, idx, 0), axis=1)
+            h = h + pos[idx - starts].astype(self.dtype)
         block_cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
         for i in range(self.n_layers):
             h = block_cls(
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
                 dtype=self.dtype, attention=self.attention,
                 name=f"block_{i}",
-            )(h)
+            )(h, segment_ids)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
         if return_hidden:
             return h
@@ -126,12 +150,15 @@ class TransformerLM(nn.Module):
 
 def lm_loss(model: nn.Module):
     """``loss_fn(params, (tokens, targets)) -> (loss, aux)`` for the DP
-    optimizer (targets = next tokens, -1 = padding/ignore)."""
+    optimizer (targets = next tokens, -1 = padding/ignore).  A 3-tuple batch
+    ``(tokens, targets, segment_ids)`` trains packed rows (see
+    :func:`~chainermn_tpu.datasets.pack_sequences`)."""
     import optax
 
     def loss_fn(params, batch):
-        tokens, targets = batch
-        logits = model.apply({"params": params}, tokens)
+        tokens, targets, *rest = batch
+        seg = rest[0] if rest else None
+        logits = model.apply({"params": params}, tokens, segment_ids=seg)
         mask = (targets >= 0).astype(jnp.float32)
         safe = jnp.maximum(targets, 0)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
@@ -150,8 +177,11 @@ def lm_loss_chunked(model: nn.Module, chunk_size: int = 4096):
     from chainermn_tpu.ops import chunked_softmax_cross_entropy
 
     def loss_fn(params, batch):
-        tokens, targets = batch
-        hidden = model.apply({"params": params}, tokens, return_hidden=True)
+        tokens, targets, *rest = batch
+        seg = rest[0] if rest else None
+        hidden = model.apply(
+            {"params": params}, tokens, segment_ids=seg, return_hidden=True
+        )
         head = params["lm_head"]
         # Match nn.Dense(dtype=fp32): inputs cast to fp32 before the matmul
         # (the chunk einsum accumulates fp32 regardless).
