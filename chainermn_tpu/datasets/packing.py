@@ -37,34 +37,45 @@ def pack_sequences(
     Returns ``(tokens, targets, segment_ids)``, each ``(N, seq_len)`` int32:
     padding tokens are 0 with segment id 0 and target −1.
     """
+    import bisect
+
     if seq_len < 1:
         raise ValueError(f"seq_len must be >= 1, got {seq_len}")
-    pieces: List[np.ndarray] = []
+    # Per-doc targets computed BEFORE any splitting, so a split piece keeps
+    # the true next-token target at its boundary (only the document's final
+    # token is unsupervised).
+    pieces: List[Tuple[np.ndarray, np.ndarray]] = []
     for d in docs:
         d = np.asarray(d, np.int32).reshape(-1)
         if len(d) == 0:
             continue
+        tgt = np.concatenate([d[1:], np.array([-1], np.int32)])
         if len(d) > seq_len:
             if drop_overlong:
                 continue
             pieces.extend(
-                d[i : i + seq_len] for i in range(0, len(d), seq_len)
+                (d[i : i + seq_len], tgt[i : i + seq_len])
+                for i in range(0, len(d), seq_len)
             )
         else:
-            pieces.append(d)
-    # First-fit decreasing: near-optimal fill with deterministic layout.
-    pieces.sort(key=len, reverse=True)
-    rows: List[List[np.ndarray]] = []
-    space: List[int] = []
+            pieces.append((d, tgt))
+    # Best-fit decreasing with a bisect-maintained free-space index:
+    # near-optimal fill, deterministic layout, O(n log n).
+    pieces.sort(key=lambda p: len(p[0]), reverse=True)
+    rows: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+    free: List[Tuple[int, int]] = []  # sorted (free_space, row) pairs
     for p in pieces:
-        for r, free in enumerate(space):
-            if free >= len(p):
-                rows[r].append(p)
-                space[r] -= len(p)
-                break
+        L = len(p[0])
+        j = bisect.bisect_left(free, (L, -1))
+        if j < len(free):
+            space, r = free.pop(j)
+            rows[r].append(p)
+            if space > L:
+                bisect.insort(free, (space - L, r))
         else:
             rows.append([p])
-            space.append(seq_len - len(p))
+            if seq_len > L:
+                bisect.insort(free, (seq_len - L, len(rows) - 1))
 
     n = len(rows)
     tokens = np.zeros((n, seq_len), np.int32)
@@ -72,10 +83,10 @@ def pack_sequences(
     seg = np.zeros((n, seq_len), np.int32)
     for r, row_docs in enumerate(rows):
         at = 0
-        for s, d in enumerate(row_docs, start=1):
+        for s, (d, tg) in enumerate(row_docs, start=1):
             L = len(d)
             tokens[r, at : at + L] = d
-            targets[r, at : at + L - 1] = d[1:]  # last token of doc: -1
+            targets[r, at : at + L] = tg
             seg[r, at : at + L] = s
             at += L
     return tokens, targets, seg

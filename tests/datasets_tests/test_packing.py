@@ -60,6 +60,22 @@ def test_pack_splits_overlong():
         [tokens[r][seg[r] != 0] for r in range(len(tokens))]
     )
     assert sorted(got.tolist()) == sorted(doc.tolist())
+    # Split boundaries keep the TRUE next-token target (targets are taken
+    # from the full document before splitting); only the document's final
+    # token is unsupervised.
+    for r in range(len(tokens)):
+        for s in np.unique(seg[r]):
+            if s == 0:
+                continue
+            idx = np.where(seg[r] == s)[0]
+            piece = tokens[r, idx]
+            tgt = targets[r, idx]
+            if piece[-1] == doc[-1]:
+                assert tgt[-1] == -1
+            else:
+                where = np.where(doc == piece[-1])[0][0]
+                assert tgt[-1] == doc[where + 1]
+            np.testing.assert_array_equal(tgt[:-1], piece[1:])
     tokens2, _, seg2 = pack_sequences([doc], seq_len=32, drop_overlong=True)
     assert packing_efficiency(seg2) == 0.0 or tokens2.size == 0
 
