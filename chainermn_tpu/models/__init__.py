@@ -40,6 +40,7 @@ from chainermn_tpu.models.transformer import (
     TransformerLM,
     dense_lm_reference,
     init_parallel_lm,
+    lm_generate,
     lm_loss,
     lm_loss_chunked,
     parallel_lm_specs,
@@ -64,6 +65,7 @@ __all__ = [
     "seq2seq_loss",
     "greedy_decode",
     "TransformerLM",
+    "lm_generate",
     "lm_loss",
     "lm_loss_chunked",
     "ParallelLM",

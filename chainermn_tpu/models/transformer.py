@@ -49,7 +49,12 @@ class _DecoderBlock(nn.Module):
     attention: str
 
     @nn.compact
-    def __call__(self, h, segment_ids=None):
+    def __call__(self, h, segment_ids=None, cache=None, decode_pos=None):
+        """Full path: ``h`` (B, T, D) → (B, T, D).  Decode path (``cache``
+        given): ``h`` (B, 1, D) for position ``decode_pos``, attends against
+        the KV cache, returns ``(h, new_cache)``.  Both paths create the
+        identical parameters (Dense/LayerNorm shapes are length-free), so
+        one set of weights serves training and generation."""
         from chainermn_tpu.ops import flash_attention, reference_attention
 
         T = h.shape[1]
@@ -57,7 +62,25 @@ class _DecoderBlock(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
         qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.attention == "flash":
+        if cache is not None:
+            # Incremental: write this position's k/v, attend q over the
+            # cache prefix (small memory-bound matmuls — XLA, not flash).
+            kc = lax.dynamic_update_slice(cache["k"], k, (0, decode_pos, 0, 0))
+            vc = lax.dynamic_update_slice(cache["v"], v, (0, decode_pos, 0, 0))
+            s = jnp.einsum(
+                "bqhd,bthd->bhqt", q.astype(jnp.float32),
+                kc.astype(jnp.float32),
+            ) / math.sqrt(D // H)
+            t_idx = jnp.arange(kc.shape[1])
+            s = jnp.where(
+                (t_idx <= decode_pos)[None, None, None, :], s, -1e30
+            )
+            p = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum(
+                "bhqt,bthd->bqhd", p, vc.astype(jnp.float32)
+            ).astype(q.dtype)
+            new_cache = {"k": kc, "v": vc}
+        elif self.attention == "flash":
             # Largest power-of-two block that divides T (flash needs T %
             # block == 0); natural lengths work without upstream padding.
             block = 128
@@ -79,7 +102,8 @@ class _DecoderBlock(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
         y = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(x)
         y = nn.Dense(D, dtype=self.dtype, name="ff2")(nn.gelu(y))
-        return h + y
+        h = h + y
+        return (h, new_cache) if cache is not None else h
 
 
 class TransformerLM(nn.Module):
@@ -103,7 +127,8 @@ class TransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens, segment_ids=None, return_hidden: bool = False):
+    def __call__(self, tokens, segment_ids=None, return_hidden: bool = False,
+                 cache=None, decode_pos=None):
         """(B, T) int32 → (B, T, vocab) fp32 logits; with
         ``return_hidden=True``, the pre-head (B, T, d_model) hidden states
         instead (for :func:`lm_loss_chunked`, which streams the head).
@@ -112,14 +137,20 @@ class TransformerLM(nn.Module):
         :func:`~chainermn_tpu.datasets.pack_sequences`) trains PACKED rows:
         attention masked within each document and positional encodings
         restarting at each document boundary — a packed document computes
-        exactly what it would alone."""
+        exactly what it would alone.
+
+        Decode path (``cache`` from :meth:`init_cache`, ``decode_pos``
+        scalar): ``tokens`` is the (B, 1) token at that position; returns
+        ``(logits, new_cache)``.  See :func:`lm_generate`."""
         B, T = tokens.shape
         D = self.d_model
         h = nn.Embed(self.vocab, D, dtype=self.dtype, name="embed")(tokens)
         pos = self.param(
             "pos", nn.initializers.normal(0.02), (self.max_len, D), jnp.float32
         )
-        if segment_ids is None:
+        if cache is not None:
+            h = h + pos[decode_pos][None, None].astype(self.dtype)
+        elif segment_ids is None:
             h = h + pos[None, :T].astype(self.dtype)
         else:
             # Per-document position restart: contiguous segments, so each
@@ -136,16 +167,96 @@ class TransformerLM(nn.Module):
             starts = lax.cummax(jnp.where(is_new, idx, 0), axis=1)
             h = h + pos[idx - starts].astype(self.dtype)
         block_cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
+        new_cache = []
         for i in range(self.n_layers):
-            h = block_cls(
+            blk = block_cls(
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
                 dtype=self.dtype, attention=self.attention,
                 name=f"block_{i}",
-            )(h, segment_ids)
+            )
+            if cache is not None:
+                h, c = blk(h, None, cache[i], decode_pos)
+                new_cache.append(c)
+            else:
+                h = blk(h, segment_ids)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
         if return_hidden:
             return h
-        return nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(h)
+        logits = nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(h)
+        return (logits, new_cache) if cache is not None else logits
+
+    def init_cache(self, batch: int, max_len: int = None):
+        """Zeroed KV cache: per layer ``{"k","v"}`` of shape
+        ``(batch, max_len, heads, head_dim)`` in the compute dtype."""
+        L = max_len or self.max_len
+        shape = (batch, L, self.n_heads, self.d_model // self.n_heads)
+        return [
+            {"k": jnp.zeros(shape, self.dtype),
+             "v": jnp.zeros(shape, self.dtype)}
+            for _ in range(self.n_layers)
+        ]
+
+
+def lm_generate(
+    model: "TransformerLM",
+    params,
+    prompt,
+    n_new: int,
+    temperature: float = 0.0,
+    rng=None,
+):
+    """Autoregressive generation with the KV cache, one ``lax.scan`` over
+    positions (prefill + generation in a single compiled program — the
+    TPU-idiomatic decode loop; no Python per-token dispatch).
+
+    Args:
+      prompt: ``(B, P)`` int32 prompt tokens (``P >= 1``).
+      n_new: tokens to generate per row.
+      temperature: ``0`` = greedy argmax; ``> 0`` = softmax sampling
+        (requires ``rng``).
+
+    Returns ``(B, n_new)`` int32 generated tokens.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, P = prompt.shape
+    total = P + n_new
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt ({P}) + n_new ({n_new}) exceeds max_len "
+            f"{model.max_len}"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    # Host (numpy) params are fine to pass in — the scan indexes the
+    # positional table with a traced position, which needs device arrays.
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    # Cache sized to the live positions, not max_len: attention cost and
+    # cache memory are O(P + n_new) per step (masking is shape-agnostic).
+    cache = model.init_cache(B, total)
+    padded = jnp.pad(prompt, ((0, 0), (0, n_new)))
+
+    def body(carry, i):
+        tok, cache, key = carry
+        logits, cache = model.apply(
+            {"params": params}, tok, cache=cache, decode_pos=i
+        )
+        logits = logits[:, 0]  # (B, vocab)
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        # Teacher-force while still inside the prompt.
+        inp = jnp.where(i + 1 < P, padded[:, i + 1], nxt)
+        return (inp[:, None], cache, key), inp
+
+    key0 = rng if rng is not None else jax.random.PRNGKey(0)
+    (_, _, _), fed = lax.scan(
+        body, (prompt[:, :1], cache, key0), jnp.arange(total - 1)
+    )
+    # ``fed[i]`` is the token at position i+1; generated ones start at P.
+    return jnp.transpose(fed[P - 1 :], (1, 0))
 
 
 def lm_loss(model: nn.Module):

@@ -44,6 +44,9 @@ def main():
                     help="ZeRO-shard params/grads/optimizer state 1/N")
     ap.add_argument("--warmup", type=int, default=0,
                     help="linear-warmup steps into a cosine decay schedule")
+    ap.add_argument("--generate", type=int, default=0,
+                    help="after training, greedily generate N tokens from a "
+                         "corpus prompt (KV-cache decode)")
     ap.add_argument("--pack", action="store_true",
                     help="train on packed variable-length documents "
                          "(segment-masked attention, per-doc positions)")
@@ -141,6 +144,20 @@ def main():
                 print(f"step {i}: loss {float(metrics['loss']):.4f}",
                       flush=True)
     it.close()
+    if args.generate:
+        from chainermn_tpu.models import lm_generate
+
+        # Collective work (ZeRO gather) runs on EVERY process; only the
+        # host-local decode and printing are rank-0 gated (running mesh
+        # computations inside the guard would deadlock multi-host runs).
+        gen_params = jax.device_get(
+            opt.materialize_params(state) if args.zero else state.params
+        )
+        if jax.process_index() == 0:
+            prompt = jnp.asarray(corpus[:16][None].astype(np.int32))
+            out = lm_generate(model, gen_params, prompt, args.generate)
+            print("prompt:", corpus[:16].tolist())
+            print("generated:", np.asarray(out)[0].tolist())
     return float(metrics["loss"])
 
 
