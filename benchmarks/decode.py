@@ -1,0 +1,107 @@
+"""Autoregressive decode throughput: generated tokens/sec with the KV cache.
+
+The inference-side counterpart of the LM training bench: one ``lax.scan``
+decode program (``models.lm_generate``), measured end to end — prefill plus
+``n_new`` generated tokens — at a batch of concurrent sequences.  Decode is
+memory-bound (each step reads the whole cache + params for a (B, D) matvec
+set), so tokens/sec tracks HBM bandwidth, not MXU flops.
+
+    python benchmarks/decode.py --out result/decode_tpu.json    # real chip
+    JAX_PLATFORMS=cpu python benchmarks/decode.py --smoke       # plumbing
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--new", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--d-ff", type=int, default=3072)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from chainermn_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models import TransformerLM, lm_generate
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu" and not args.smoke:
+        print(json.dumps({
+            "error": f"decode bench needs a TPU (got {platform}); "
+                     "pass --smoke for a CPU plumbing check"
+        }))
+        return
+    if args.smoke:
+        args.batch, args.prompt, args.new = 2, 16, 32
+        args.layers, args.d_model, args.heads = 2, 128, 4
+        args.d_ff, args.vocab, args.iters = 256, 1024, 2
+    if platform == "cpu":
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    model = TransformerLM(
+        vocab=args.vocab, n_layers=args.layers, d_model=args.d_model,
+        n_heads=args.heads, d_ff=args.d_ff,
+        max_len=args.prompt + args.new,
+    )
+    params = jax.jit(
+        lambda r: model.init(
+            r, jnp.zeros((1, args.prompt), jnp.int32)
+        )
+    )(jax.random.PRNGKey(0))["params"]
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(
+        rng.randint(0, args.vocab, size=(args.batch, args.prompt)).astype(
+            np.int32
+        )
+    )
+
+    gen = jax.jit(lambda p, pr: lm_generate(model, p, pr, args.new))
+    out_tokens = jax.block_until_ready(gen(params, prompt))  # compile+warm
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out_tokens = gen(params, prompt)
+    jax.block_until_ready(out_tokens)
+    dt = time.perf_counter() - t0
+
+    steps = args.prompt + args.new - 1
+    gen_tps = args.batch * args.new * args.iters / dt
+    payload = {
+        "metric": "lm_decode_tokens_per_sec",
+        "value": round(gen_tps, 1),
+        "unit": "generated tokens/sec",
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "batch": args.batch,
+        "prompt": args.prompt,
+        "n_new": args.new,
+        "config": {"layers": args.layers, "d_model": args.d_model,
+                   "heads": args.heads, "d_ff": args.d_ff,
+                   "vocab": args.vocab},
+        "ms_per_step": round(dt / args.iters / steps * 1000.0, 3),
+    }
+    print(json.dumps(payload))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

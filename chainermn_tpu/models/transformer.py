@@ -63,8 +63,10 @@ class _DecoderBlock(nn.Module):
         qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cache is not None:
-            # Incremental: write this position's k/v, attend q over the
-            # cache prefix (small memory-bound matmuls — XLA, not flash).
+            # Incremental: write this chunk's k/v at decode_pos (T=1 per
+            # generation step; T=P for the batched prompt prefill), attend
+            # causally over the cache prefix (memory-bound — XLA, not
+            # flash).
             kc = lax.dynamic_update_slice(cache["k"], k, (0, decode_pos, 0, 0))
             vc = lax.dynamic_update_slice(cache["v"], v, (0, decode_pos, 0, 0))
             s = jnp.einsum(
@@ -72,8 +74,9 @@ class _DecoderBlock(nn.Module):
                 kc.astype(jnp.float32),
             ) / math.sqrt(D // H)
             t_idx = jnp.arange(kc.shape[1])
+            q_pos = decode_pos + jnp.arange(T)
             s = jnp.where(
-                (t_idx <= decode_pos)[None, None, None, :], s, -1e30
+                (t_idx[None, :] <= q_pos[:, None])[None, None], s, -1e30
             )
             p = jax.nn.softmax(s, axis=-1)
             a = jnp.einsum(
@@ -149,7 +152,9 @@ class TransformerLM(nn.Module):
             "pos", nn.initializers.normal(0.02), (self.max_len, D), jnp.float32
         )
         if cache is not None:
-            h = h + pos[decode_pos][None, None].astype(self.dtype)
+            h = h + lax.dynamic_slice(
+                pos, (decode_pos, 0), (T, D)
+            )[None].astype(self.dtype)
         elif segment_ids is None:
             h = h + pos[None, :T].astype(self.dtype)
         else:
@@ -233,30 +238,41 @@ def lm_generate(
     # Cache sized to the live positions, not max_len: attention cost and
     # cache memory are O(P + n_new) per step (masking is shape-agnostic).
     cache = model.init_cache(B, total)
-    padded = jnp.pad(prompt, ((0, 0), (0, n_new)))
 
-    def body(carry, i):
-        tok, cache, key = carry
-        logits, cache = model.apply(
-            {"params": params}, tok, cache=cache, decode_pos=i
-        )
-        logits = logits[:, 0]  # (B, vocab)
+    def pick(logits, key):
         if temperature > 0:
             key, sub = jax.random.split(key)
             nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
-        nxt = nxt.astype(jnp.int32)
-        # Teacher-force while still inside the prompt.
-        inp = jnp.where(i + 1 < P, padded[:, i + 1], nxt)
-        return (inp[:, None], cache, key), inp
+        return nxt.astype(jnp.int32), key
 
-    key0 = rng if rng is not None else jax.random.PRNGKey(0)
-    (_, _, _), fed = lax.scan(
-        body, (prompt[:, :1], cache, key0), jnp.arange(total - 1)
+    # Batched prefill: ONE (B, P) forward populates the whole prompt's
+    # cache (MXU-friendly), instead of P serialized single-token steps.
+    key = rng if rng is not None else jax.random.PRNGKey(0)
+    logits, cache = model.apply(
+        {"params": params}, prompt, cache=cache, decode_pos=0
     )
-    # ``fed[i]`` is the token at position i+1; generated ones start at P.
-    return jnp.transpose(fed[P - 1 :], (1, 0))
+    tok0, key = pick(logits[:, -1], key)
+
+    def body(carry, i):
+        tok, cache, key = carry
+        logits, cache = model.apply(
+            {"params": params}, tok[:, None], cache=cache, decode_pos=P + i
+        )
+        nxt, key = pick(logits[:, 0], key)
+        return (nxt, cache, key), tok
+
+    if n_new == 1:
+        return tok0[:, None]
+    (last, _, _), fed = lax.scan(
+        body, (tok0, cache, key), jnp.arange(n_new - 1)
+    )
+    # ``fed`` holds the tokens at positions P .. P+n_new-2; ``last`` is the
+    # final prediction (position P+n_new-1).
+    return jnp.concatenate(
+        [jnp.transpose(fed, (1, 0)), last[:, None]], axis=1
+    )
 
 
 def lm_loss(model: nn.Module):

@@ -51,6 +51,11 @@ def main():
                     help="train on packed variable-length documents "
                          "(segment-masked attention, per-doc positions)")
     args = ap.parse_args()
+    if args.generate and 16 + args.generate > args.seq_len:
+        # Fail fast, not after the whole training run: the 16-token prompt
+        # plus the generated tokens must fit the model's max_len.
+        ap.error(f"--generate {args.generate} + 16-token prompt exceeds "
+                 f"--seq-len {args.seq_len}")
 
     import jax
 

@@ -53,9 +53,15 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# memory ablation rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/decode_tpu.json ]; then
+      echo "# running decode bench at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/decode.py --out result/decode_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# decode bench rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ -s result/flash_tpu.json ] \
        && [ -s result/collectives_tpu.json ] && [ -s result/lm_tpu.json ] \
-       && [ -s result/memory_tpu.json ]; then
+       && [ -s result/memory_tpu.json ] && [ -s result/decode_tpu.json ]; then
       exit 0
     fi
   fi

@@ -1,0 +1,82 @@
+"""Worker for the 4-process scale test: the 2-process suite proves the
+multi-host branches execute; this proves nothing is hardwired to 2 (ring/
+tree fan-outs, rank bookkeeping, shard arithmetic at process_count == 4)."""
+
+import json
+import os
+import sys
+import traceback
+
+import numpy as np
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid}
+    assert jax.process_count() == 4, jax.process_count()
+
+    comm = cmn.create_communicator("flat")
+    assert comm.size == 4, comm.size
+
+    # Object plane: broadcast + allgather + rank-addressed p2p ring.
+    msg = comm.bcast_obj({"tag": "hello", "root": 0}, root=0)
+    assert msg == {"tag": "hello", "root": 0}
+    gathered = comm.allgather_obj(("rank", comm.rank))
+    assert gathered == [("rank", r) for r in range(4)], gathered
+    nxt, prv = (comm.rank + 1) % 4, (comm.rank - 1) % 4
+    comm.send_obj({"from": comm.rank}, dest=nxt)
+    got = comm.recv_obj(source=prv, dest=comm.rank, timeout=60.0)
+    assert got == {"from": prv}, got
+
+    # Eager collective across the 4-process mesh.
+    g = comm.tile_rankwise(np.full((2, 2), float(comm.rank + 1), np.float32))
+    red = np.asarray(
+        comm.allreduce_grad(g).addressable_shards[0].data
+    )
+    # Mean of per-rank constants: rank r holds r+1 in ITS rows; the
+    # rankwise tile means every slot averages to (1+2+3+4)/4 = 2.5.
+    np.testing.assert_allclose(red, 2.5, atol=1e-6)
+
+    # scatter_dataset: 4 shards, equal sizes, disjoint cover.
+    from chainermn_tpu.datasets import make_synthetic_classification
+
+    ds = cmn.scatter_dataset(
+        make_synthetic_classification(64, 4, seed=3), comm, shuffle=True,
+        seed=11,
+    )
+    sizes = comm.allgather_obj(len(ds))
+    assert sizes == [16, 16, 16, 16], sizes
+    first_cols = sorted(
+        float(v)
+        for shard in comm.allgather_obj([row[0][0] for row in ds[:]])
+        for v in shard
+    )
+    full = sorted(
+        float(v)
+        for v in make_synthetic_classification(64, 4, seed=3).arrays[0][:, 0]
+    )
+    assert np.allclose(first_cols, full), "shards must cover the dataset"
+
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    result_path = os.path.join(
+        os.environ["CMN_TEST_TMP"],
+        f"verdict_{os.environ['CMN_PROCESS_ID']}.json",
+    )
+    try:
+        verdict = main()
+    except BaseException:
+        verdict = {"status": "fail", "traceback": traceback.format_exc()}
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)
