@@ -138,3 +138,4 @@ from chainermn_tpu.datasets.packing import (  # noqa: E402
     pack_sequences,
     packing_efficiency,
 )
+from chainermn_tpu.datasets.seq import bucket_batches  # noqa: E402

@@ -224,6 +224,8 @@ def lm_generate(
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, P = prompt.shape
+    if n_new < 1:
+        return jnp.zeros((B, 0), jnp.int32)
     total = P + n_new
     if total > model.max_len:
         raise ValueError(
