@@ -41,30 +41,49 @@ def _use_interpret() -> bool:
 
 
 def reference_attention(q, k, v, causal: bool = False,
-                        segment_ids=None) -> jax.Array:
+                        segment_ids=None, kv_segment_ids=None) -> jax.Array:
     """Plain-XLA softmax attention over ``(B, T, H, D)`` — the single
     correctness oracle every flash test/benchmark compares against (one
     implementation, so the CPU interpret tests and the on-chip harness can
     never validate against diverging references).  Computed in fp32, cast
-    back to the input dtype."""
-    return _reference_attention_lse(q, k, v, causal, segment_ids)[0]
+    back to the input dtype.  ``k``/``v`` may have a different length
+    (cross-attention; ``causal`` then requires equal lengths)."""
+    return _reference_attention_lse(
+        q, k, v, causal, segment_ids, kv_segment_ids
+    )[0]
 
 
 def _reference_attention_lse(q, k, v, causal: bool = False,
-                             segment_ids=None):
+                             segment_ids=None, kv_segment_ids=None):
     """:func:`reference_attention` + per-row logsumexp ``(B, H, T)`` — the
     XLA twin of :func:`flash_attention_lse` (used as its vma-checked
     interpret-mode fallback)."""
     B, T, H, D = q.shape
+    S = k.shape[1]
+    # Same contracts as the flash path — the oracle must never silently
+    # compute something the kernel would reject.
+    if causal and S != T:
+        raise ValueError(
+            f"causal attention needs equal q/kv lengths, got {T} vs {S}"
+        )
+    if segment_ids is not None and kv_segment_ids is None and S != T:
+        raise ValueError(
+            "cross-attention with segment_ids needs explicit "
+            "kv_segment_ids (kv length differs from q)"
+        )
     qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
     kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
     vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
     s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / math.sqrt(D)
     if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
+        mask = jnp.tril(jnp.ones((T, S), bool))
         s = jnp.where(mask, s, NEG_INF)
-    if segment_ids is not None:
-        seg = (segment_ids[:, :, None] == segment_ids[:, None, :])
+    if segment_ids is not None or kv_segment_ids is not None:
+        if segment_ids is None:
+            segment_ids = jnp.zeros((B, T), jnp.int32)
+        if kv_segment_ids is None:
+            kv_segment_ids = segment_ids
+        seg = (segment_ids[:, :, None] == kv_segment_ids[:, None, :])
         s = jnp.where(seg[:, None, :, :], s, NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B, H, T)
     p = jnp.exp(s - lse[..., None])
@@ -148,9 +167,10 @@ def _vma_union(*arrays):
         out |= getattr(jax.typeof(a), "vma", frozenset())
     return out
 
-def _fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
+def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q, block_k,
          interpret):
     BH, T, D = q.shape
+    S = k.shape[1]
     scale = 1.0 / math.sqrt(D)
     grid = (BH, T // block_q)
     kernel = functools.partial(
@@ -159,18 +179,18 @@ def _fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
     ]
     args = [q, k, v]
     if segmented:
-        # seg stays (B, T) — every head of batch row b // heads shares it
-        # (no H-fold copy); passed twice: q-block view + full-row k view.
+        # Segments stay (B, T)/(B, S) — every head of batch row b // heads
+        # shares them (no H-fold copy): q-block view + full-row kv view.
         in_specs += [
             pl.BlockSpec((1, block_q), lambda b, i: (b // heads, i)),
-            pl.BlockSpec((1, T), lambda b, i: (b // heads, 0)),
+            pl.BlockSpec((1, S), lambda b, i: (b // heads, 0)),
         ]
-        args += [seg, seg]
+        args += [seg_q, seg_kv]
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -322,9 +342,10 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
     the LSE-exposing API) folds into the kernels for free: ``∂lse_i/∂s_ij =
     p_ij``, so the lse cotangent just shifts the per-row delta —
     ``ds = p·(dp − (delta − dlse))`` — and both kernels run unchanged."""
-    q, k, v, seg, o, lse = residuals
+    q, k, v, seg_q, seg_kv, o, lse = residuals
     do = g
     BH, T, D = q.shape
+    S = k.shape[1]
     scale = 1.0 / math.sqrt(D)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if dlse is not None:
@@ -349,10 +370,10 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
             pl.BlockSpec((1, block_k),
                          lambda b, i: (b // heads, i)),          # seg (k blk)
         ]
-        args += [seg, seg]
+        args += [seg_q, seg_kv]
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(BH, T // block_k),
+        grid=(BH, S // block_k),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
@@ -360,10 +381,10 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
         ],
         out_shape=[
             jax.ShapeDtypeStruct(
-                (BH, T, D), k.dtype, vma=_vma_union(q, k, v, do, lse, delta)
+                (BH, S, D), k.dtype, vma=_vma_union(q, k, v, do, lse, delta)
             ),
             jax.ShapeDtypeStruct(
-                (BH, T, D), v.dtype, vma=_vma_union(q, k, v, do, lse, delta)
+                (BH, S, D), v.dtype, vma=_vma_union(q, k, v, do, lse, delta)
             ),
         ],
         interpret=interpret,
@@ -375,8 +396,8 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),        # k
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),        # v
+        pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),        # k
+        pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),        # v
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do
         pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # lse
         pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # delta
@@ -386,9 +407,9 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
         in_specs += [
             pl.BlockSpec((1, block_q),
                          lambda b, i: (b // heads, i)),          # seg (q blk)
-            pl.BlockSpec((1, T), lambda b, i: (b // heads, 0)),  # seg (k rows)
+            pl.BlockSpec((1, S), lambda b, i: (b // heads, 0)),  # seg (k rows)
         ]
-        args += [seg, seg]
+        args += [seg_q, seg_kv]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, T // block_q),
@@ -403,18 +424,18 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
 
 
 # --------------------------------------------------------------------- api
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_lse(q, k, v, seg, segmented, heads, causal, block_q, block_k,
-               interpret):
-    return _fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
-                interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q,
+               block_k, interpret):
+    return _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q,
+                block_k, interpret)
 
 
-def _flash_lse_fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
-                   interpret):
-    o, lse = _fwd(q, k, v, seg, segmented, heads, causal, block_q, block_k,
-                  interpret)
-    return (o, lse), (q, k, v, seg, o, lse)
+def _flash_lse_fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal,
+                   block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q,
+                  block_k, interpret)
+    return (o, lse), (q, k, v, seg_q, seg_kv, o, lse)
 
 
 def _flash_lse_bwd(segmented, heads, causal, block_q, block_k, interpret,
@@ -422,8 +443,8 @@ def _flash_lse_bwd(segmented, heads, causal, block_q, block_k, interpret,
     do, dlse = g
     dq, dk, dv = _bwd(segmented, heads, causal, block_q, block_k, interpret,
                       residuals, do, dlse=dlse)
-    # seg is integer-typed: its cotangent is the symbolic zero.
-    return dq, dk, dv, None
+    # Segments are integer-typed: their cotangent is the symbolic zero.
+    return dq, dk, dv, None, None
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -435,6 +456,7 @@ def flash_attention_lse(
     v: jax.Array,
     causal: bool = False,
     segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
@@ -446,25 +468,55 @@ def flash_attention_lse(
         ``lse = logaddexp(lse₁, lse₂);  o = (o₁·e^{lse₁−lse} + o₂·e^{lse₂−lse})``
 
     (see :func:`chainermn_tpu.parallel.ring_attention.ring_flash_self_attention`).
-    Differentiable in both outputs."""
+    Differentiable in both outputs.
+
+    ``k``/``v`` may be a different length than ``q`` (cross-attention);
+    ``causal`` then requires equal lengths.  ``kv_segment_ids`` (``(B, S)``)
+    masks keys independently of the query segments — give pad keys an id no
+    query uses; defaults to ``segment_ids`` (self-attention packing)."""
     B, T, H, D = q.shape
+    S = k.shape[1]
+    if k.shape != (B, S, H, D) or v.shape != (B, S, H, D):
+        raise ValueError(
+            f"k/v must be (B, S, H, D) = ({B}, S, {H}, {D}); got "
+            f"{k.shape} / {v.shape}"
+        )
+    if causal and S != T:
+        raise ValueError(
+            f"causal attention needs equal q/kv lengths, got {T} vs {S}"
+        )
     if interpret is None:
         interpret = _use_interpret()
     block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
+    block_k = min(block_k, S)
+    if T % block_q or S % block_k:
         # Validate BEFORE any fallback so CPU tests reject exactly the
         # block configs the TPU kernel would.
         raise ValueError(
-            f"seq len {T} must be a multiple of block sizes "
+            f"q len {T} / kv len {S} must be multiples of block sizes "
             f"({block_q}, {block_k})"
         )
-    segmented = segment_ids is not None
-    if segmented and segment_ids.shape != (B, T):
-        raise ValueError(
-            f"segment_ids must be (batch, seq) = {(B, T)}, got "
-            f"{segment_ids.shape}"
-        )
+    segmented = segment_ids is not None or kv_segment_ids is not None
+    if segmented:
+        if segment_ids is None:
+            segment_ids = jnp.zeros((B, T), jnp.int32)
+        if kv_segment_ids is None:
+            if S != T:
+                raise ValueError(
+                    "cross-attention with segment_ids needs explicit "
+                    "kv_segment_ids (kv length differs from q)"
+                )
+            kv_segment_ids = segment_ids
+        if segment_ids.shape != (B, T):
+            raise ValueError(
+                f"segment_ids must be (batch, q_len) = {(B, T)}, got "
+                f"{segment_ids.shape}"
+            )
+        if kv_segment_ids.shape != (B, S):
+            raise ValueError(
+                f"kv_segment_ids must be (batch, kv_len) = {(B, S)}, got "
+                f"{kv_segment_ids.shape}"
+            )
     if interpret and _vma_union(q, k, v):
         # Interpret-mode Pallas cannot be traced through shard_map's vma
         # checker (its kernel jaxpr mixes varying refs with invariant index
@@ -472,21 +524,24 @@ def flash_attention_lse(
         # limitation).  Off-TPU inside a checked shard_map, compute the
         # mathematically identical XLA form instead; the compiled kernel is
         # unaffected (opaque to the checker).
-        return _reference_attention_lse(q, k, v, causal, segment_ids)
+        return _reference_attention_lse(
+            q, k, v, causal, segment_ids, kv_segment_ids
+        )
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        L = x.shape[1]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
 
-    # seg stays (B, T): the kernels' index maps read row b // H, so every
-    # head shares one copy (no H-fold materialization in the residuals).
-    seg = (
-        segment_ids.astype(jnp.int32)
-        if segmented
-        else jnp.zeros((1, 1), jnp.int32)  # unused placeholder
-    )
+    # Segments stay (B, T)/(B, S): the kernels' index maps read row b // H,
+    # so every head shares one copy (no H-fold materialization).
+    if segmented:
+        seg_q = segment_ids.astype(jnp.int32)
+        seg_kv = kv_segment_ids.astype(jnp.int32)
+    else:
+        seg_q = seg_kv = jnp.zeros((1, 1), jnp.int32)  # unused placeholder
     o, lse = _flash_lse(
-        to_bh(q), to_bh(k), to_bh(v), seg, segmented, H, causal, block_q,
-        block_k, interpret,
+        to_bh(q), to_bh(k), to_bh(v), seg_q, seg_kv, segmented, H, causal,
+        block_q, block_k, interpret,
     )
     return (
         o.reshape(B, H, T, D).transpose(0, 2, 1, 3),
@@ -500,15 +555,19 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Exact attention over ``(batch, seq, heads, head_dim)`` inputs.
+    """Exact attention over ``(batch, seq, heads, head_dim)`` inputs; ``k``/
+    ``v`` may use a different sequence length (cross-attention, non-causal).
 
-    ``segment_ids`` (``(batch, seq)`` int32) masks attention to same-segment
-    pairs — packed sequences and padding (give pad positions their own id)
-    without materialized masks.  Requires ``seq % block == 0`` (pad
+    ``segment_ids`` (``(batch, q_len)`` int32) masks attention to
+    same-segment pairs — packed sequences and padding (give pad positions
+    their own id) without materialized masks; ``kv_segment_ids``
+    (``(batch, kv_len)``) masks the key side independently (defaults to
+    ``segment_ids``).  Requires lengths divisible by the block sizes (pad
     upstream; the data layer's bucketing keeps XLA-friendly static shapes
     anyway).  Differentiable via the flash backward.  ``interpret=None``
     auto-selects interpret mode off-TPU.
@@ -517,6 +576,7 @@ def flash_attention(
     maintain); the dropped lse output arrives in the backward as a zero
     cotangent, which folds away inside the shared kernels."""
     return flash_attention_lse(
-        q, k, v, causal=causal, segment_ids=segment_ids, block_q=block_q,
-        block_k=block_k, interpret=interpret,
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        kv_segment_ids=kv_segment_ids, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )[0]

@@ -123,6 +123,56 @@ def test_flash_segments_isolate_documents():
                                np.asarray(alone), atol=2e-5, rtol=1e-4)
 
 
+def test_flash_cross_attention_matches_oracle():
+    """kv length != q length (encoder-decoder shape), fwd + grads."""
+    rng = np.random.RandomState(9)
+    B, Tq, S, H, D = 2, 64, 96, 2, 16
+    q = jnp.asarray((rng.normal(size=(B, Tq, H, D)) * 0.6).astype(np.float32))
+    k = jnp.asarray((rng.normal(size=(B, S, H, D)) * 0.6).astype(np.float32))
+    v = jnp.asarray((rng.normal(size=(B, S, H, D)) * 0.6).astype(np.float32))
+
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    ref = reference_attention(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=1e-4)
+
+    probe = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+    g = jax.grad(lambda qkv: jnp.sum(flash_attention(
+        *qkv, block_q=32, block_k=32) * probe))((q, k, v))
+    og = jax.grad(lambda qkv: jnp.sum(
+        reference_attention(*qkv, False) * probe))((q, k, v))
+    for name, a, b in zip("qkv", g, og):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-3,
+            err_msg=f"d{name} mismatch",
+        )
+
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+
+
+def test_flash_kv_padding_mask():
+    """kv_segment_ids as a key-padding mask: padded keys (id 1) must be
+    invisible — output equals attention over only the real keys."""
+    rng = np.random.RandomState(10)
+    B, Tq, S, H, D = 1, 32, 64, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, Tq, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+    real = 40
+    kv_seg = jnp.asarray(
+        np.concatenate([np.zeros(real, np.int32),
+                        np.ones(S - real, np.int32)])
+    )[None]
+
+    out = flash_attention(q, k, v, kv_segment_ids=kv_seg, block_q=32,
+                          block_k=32)
+    # Oracle: attention over the unpadded prefix only.
+    ref = reference_attention(q, k[:, :real], v[:, :real], False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=1e-4)
+
+
 def test_flash_segments_shape_validation():
     q, k, v = _qkv(np.random.RandomState(8), B=2, T=64)
     with pytest.raises(ValueError, match="segment_ids"):
@@ -132,7 +182,7 @@ def test_flash_segments_shape_validation():
 
 def test_flash_rejects_ragged_seq():
     q, k, v = _qkv(np.random.RandomState(4), T=100)
-    with pytest.raises(ValueError, match="multiple of block"):
+    with pytest.raises(ValueError, match="multiples? of block"):
         flash_attention(q, k, v, block_q=64, block_k=64)
 
 
