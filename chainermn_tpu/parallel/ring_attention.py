@@ -39,7 +39,7 @@ def _block_attend(q, k, v, m, l, o, mask):
     """One flash-style online-softmax accumulation of a visiting K/V block.
 
     q: (B, Tq, H, D); k/v: (B, Tk, H, D); m/l: (B, H, Tq); o: (B, Tq, H, D)
-    mask: (Tq, Tk) boolean (True = attend) or None.
+    mask: boolean (True = attend), (Tq, Tk) or (B, Tq, Tk), or None.
     """
     scale = 1.0 / math.sqrt(q.shape[-1])
     # scores: (B, H, Tq, Tk) in fp32.
@@ -47,7 +47,9 @@ def _block_attend(q, k, v, m, l, o, mask):
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None], s, -jnp.inf)
+        if mask.ndim == 2:
+            mask = mask[None]
+        s = jnp.where(mask[:, None], s, -jnp.inf)
     m_blk = jnp.max(s, axis=-1)  # (B, H, Tq)
     m_new = jnp.maximum(m, m_blk)
     # Fully-masked rows keep m_new == -inf; shift by a finite surrogate so
@@ -71,12 +73,19 @@ def ring_self_attention(
     axis_name,
     causal: bool = False,
     remat: bool = True,
+    segment_ids=None,
 ) -> jax.Array:
     """Exact self-attention over a sequence sharded on ``axis_name``.
 
     Call inside ``shard_map``; arguments are the local sequence blocks
     ``(batch, block_len, heads, head_dim)``.  Returns the local output block
     in ``q.dtype``.
+
+    ``segment_ids`` is the LOCAL ``(batch, block_len)`` slice of the packed
+    rows' segments (:func:`~chainermn_tpu.datasets.pack_sequences` sharded
+    like the sequence): the k-side slice rotates around the ring with its
+    K/V block, so packed documents stay isolated across the whole sharded
+    sequence.
     """
     B, T, H, D = q.shape
     S = lax.axis_size(axis_name)
@@ -93,7 +102,7 @@ def ring_self_attention(
     rel = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]  # q_pos - k_pos (local)
 
     def body(carry, step):
-        k_cur, v_cur, m, l, o = carry
+        k_cur, v_cur, seg_cur, m, l, o = carry
         if causal:
             # Visiting block originated at rank (my - step) mod S; global
             # positions differ by (my - src) * T.
@@ -102,15 +111,28 @@ def ring_self_attention(
             mask = (rel + offset) >= 0
         else:
             mask = None
+        if segment_ids is not None:
+            seg_mask = segment_ids[:, :, None] == seg_cur[:, None, :]
+            mask = seg_mask if mask is None else (mask[None] & seg_mask)
         m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, mask)
         k_nxt = lax.ppermute(k_cur, axis_name, perm=perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm=perm)
-        return (k_nxt, v_nxt, m, l, o), None
+        seg_nxt = (
+            lax.ppermute(seg_cur, axis_name, perm=perm)
+            if segment_ids is not None
+            else seg_cur
+        )
+        return (k_nxt, v_nxt, seg_nxt, m, l, o), None
 
     if remat:
         body = jax.checkpoint(body)
-    (_, _, m, l, o), _ = lax.scan(
-        body, (k, v, m0, l0, o0), jnp.arange(S)
+    seg0 = (
+        segment_ids
+        if segment_ids is not None
+        else pvary(jnp.zeros((B, T), jnp.int32), axis_name)
+    )
+    (_, _, _, m, l, o), _ = lax.scan(
+        body, (k, v, seg0, m0, l0, o0), jnp.arange(S)
     )
     # Rows with zero mass (can't happen for causal self-attention, where a
     # query always sees itself) would divide 0/0; guard anyway.
@@ -143,6 +165,7 @@ def ring_flash_self_attention(
     causal: bool = False,
     block_q: int = 128,
     block_k: int = 128,
+    segment_ids=None,
 ) -> jax.Array:
     """Ring attention whose LOCAL blocks run the Pallas flash kernel.
 
@@ -164,21 +187,31 @@ def ring_flash_self_attention(
     S = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % S) for i in range(S)]
+    segmented = segment_ids is not None
 
-    def local(qb, kb, vb, causal_blk):
+    def local(qb, kb, vb, causal_blk, seg_kv):
         o, lse = flash_attention_lse(
             qb, kb, vb, causal=causal_blk,
+            segment_ids=segment_ids if segmented else None,
+            kv_segment_ids=seg_kv,
             block_q=min(block_q, T), block_k=min(block_k, T),
         )
         return o.astype(jnp.float32), lse
 
     # Step 0 is the diagonal block on every rank (src == my).
-    o_acc, lse_acc = local(q, k, v, causal)
+    o_acc, lse_acc = local(q, k, v, causal,
+                           segment_ids if segmented else None)
     k_cur = lax.ppermute(k, axis_name, perm=perm)
     v_cur = lax.ppermute(v, axis_name, perm=perm)
+    seg_cur = (
+        lax.ppermute(segment_ids, axis_name, perm=perm)
+        if segmented
+        else pvary(jnp.zeros((B, T), jnp.int32), axis_name)
+    )
 
     def body(carry, step):
-        k_cur, v_cur, o_acc, lse_acc = carry
+        k_cur, v_cur, seg_cur, o_acc, lse_acc = carry
+        seg_arg = seg_cur if segmented else None
         if causal:
             # Visiting block originated at rank (my - step); it is visible
             # only if strictly in the past (src < my in global order).
@@ -188,7 +221,7 @@ def ring_flash_self_attention(
             src = (my - step) % S
             o_blk, lse_blk = lax.cond(
                 src < my,
-                lambda: local(q, k_cur, v_cur, False),
+                lambda: local(q, k_cur, v_cur, False, seg_arg),
                 lambda: (
                     pvary(jnp.zeros((B, T, H, D), jnp.float32), axis_name),
                     pvary(
@@ -197,16 +230,21 @@ def ring_flash_self_attention(
                 ),
             )
         else:
-            o_blk, lse_blk = local(q, k_cur, v_cur, False)
+            o_blk, lse_blk = local(q, k_cur, v_cur, False, seg_arg)
         o_acc, lse_acc = _merge_blocks(o_acc, lse_acc, o_blk, lse_blk)
         k_nxt = lax.ppermute(k_cur, axis_name, perm=perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm=perm)
-        return (k_nxt, v_nxt, o_acc, lse_acc), None
+        seg_nxt = (
+            lax.ppermute(seg_cur, axis_name, perm=perm)
+            if segmented
+            else seg_cur
+        )
+        return (k_nxt, v_nxt, seg_nxt, o_acc, lse_acc), None
 
     if S > 1:
         body = jax.checkpoint(body)
-        (_, _, o_acc, lse_acc), _ = lax.scan(
-            body, (k_cur, v_cur, o_acc, lse_acc), jnp.arange(1, S)
+        (_, _, _, o_acc, lse_acc), _ = lax.scan(
+            body, (k_cur, v_cur, seg_cur, o_acc, lse_acc), jnp.arange(1, S)
         )
     return o_acc.astype(q.dtype)
 
@@ -217,32 +255,42 @@ def ring_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
+    segment_ids=None,
 ) -> jax.Array:
     """Eager convenience wrapper: global ``(B, T, H, D)`` arrays in, attention
     out, sequence-sharded over ``comm``'s mesh axes.
 
     ``comm`` is an :class:`~chainermn_tpu.comm.XlaCommunicator` whose axes
     form the sequence ring (e.g. ``XlaCommunicator(hybrid_mesh({"seq": 8}))``).
+    ``segment_ids`` (global ``(B, T)``) packs documents across the sharded
+    sequence.
     """
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, comm.axes)  # shard dim 1 (sequence)
+    segmented = segment_ids is not None
 
     def build():
+        if segmented:
+            fn = lambda q, k, v, seg: ring_self_attention(
+                q, k, v, axis_name=comm.axis_name, causal=causal,
+                segment_ids=seg,
+            )
+            in_specs = (spec, spec, spec, P(None, comm.axes))
+        else:
+            fn = partial(
+                ring_self_attention, axis_name=comm.axis_name, causal=causal
+            )
+            in_specs = (spec, spec, spec)
         return jax.jit(
             comm.spmd(
-                partial(
-                    ring_self_attention,
-                    axis_name=comm.axis_name,
-                    causal=causal,
-                ),
-                in_specs=(spec, spec, spec),
-                out_specs=spec,
-                check_vma=True,
+                fn, in_specs=in_specs, out_specs=spec, check_vma=True
             )
         )
 
     # Reuse the communicator's jit cache — a fresh jit per call would
     # retrace/recompile the ring program every invocation.
-    f = comm._jitted(("ring_attention", causal), build)
+    f = comm._jitted(("ring_attention", causal, segmented), build)
+    if segmented:
+        return f(q, k, v, segment_ids)
     return f(q, k, v)
