@@ -10,7 +10,12 @@ from chainermn_tpu.models.resnet import (
     ResNet50,
     resnet_loss,
 )
-from chainermn_tpu.models.seq2seq import Seq2Seq, greedy_decode, seq2seq_loss
+from chainermn_tpu.models.seq2seq import (
+    Seq2Seq,
+    TransformerSeq2Seq,
+    greedy_decode,
+    seq2seq_loss,
+)
 from chainermn_tpu.models.vgg import (
     VGGHead,
     VGGStage,
@@ -62,6 +67,7 @@ __all__ = [
     "apply_sequential",
     "build_chain",
     "Seq2Seq",
+    "TransformerSeq2Seq",
     "seq2seq_loss",
     "greedy_decode",
     "TransformerLM",

@@ -67,6 +67,161 @@ class Seq2Seq(nn.Module):
         return nn.Dense(self.vocab_tgt, dtype=self.dtype, name="proj")(hs)
 
 
+def _pow2_block(n: int, cap: int = 128) -> int:
+    b = cap
+    while b > 1 and n % b:
+        b //= 2
+    return b
+
+
+def _use_flash(*lengths) -> bool:
+    """Flash is only a win with real block sizes; odd lengths whose largest
+    power-of-two factor is tiny would run 1-row blocks (each still padded
+    to a full TPU tile) — fall back to the XLA path instead."""
+    return all(_pow2_block(n) >= 8 for n in lengths)
+
+
+class _EncBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any
+    attention: str
+
+    @nn.compact
+    def __call__(self, h, seg):
+        from chainermn_tpu.ops import flash_attention, reference_attention
+
+        D, H = self.d_model, self.n_heads
+        x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
+        qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.attention == "flash" and _use_flash(h.shape[1]):
+            b = _pow2_block(h.shape[1])
+            a = flash_attention(q, k, v, segment_ids=seg, block_q=b,
+                                block_k=b)
+        else:
+            a = reference_attention(q, k, v, False,
+                                    segment_ids=seg).astype(q.dtype)
+        h = h + nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype,
+                                name="proj")(a)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
+        y = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(x)
+        return h + nn.Dense(D, dtype=self.dtype, name="ff2")(nn.gelu(y))
+
+
+class _DecBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any
+    attention: str
+
+    @nn.compact
+    def __call__(self, h, enc, src_seg):
+        from chainermn_tpu.ops import flash_attention, reference_attention
+
+        D, H = self.d_model, self.n_heads
+        B, Tt = h.shape[:2]
+        # Causal self-attention (target padding sits at the tail, so causal
+        # masking already keeps real positions clean of it).
+        x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
+        qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.attention == "flash" and _use_flash(Tt):
+            b = _pow2_block(Tt)
+            a = flash_attention(q, k, v, causal=True, block_q=b, block_k=b)
+        else:
+            a = reference_attention(q, k, v, True).astype(q.dtype)
+        h = h + nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype,
+                                name="self_proj")(a)
+        # Cross-attention over the encoder memory: every target position
+        # (segment 1) attends exactly the REAL source keys (src_seg == 1;
+        # pads carry 0) — the kernel's q-len != kv-len path.
+        x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
+        cq = nn.DenseGeneral((H, D // H), dtype=self.dtype, name="cross_q")(x)
+        ckv = nn.DenseGeneral((2, H, D // H), dtype=self.dtype,
+                              name="cross_kv")(enc)
+        ck, cv = ckv[:, :, 0], ckv[:, :, 1]
+        q_seg = jnp.ones((B, Tt), jnp.int32)
+        if self.attention == "flash" and _use_flash(Tt, enc.shape[1]):
+            a = flash_attention(
+                cq, ck, cv, segment_ids=q_seg, kv_segment_ids=src_seg,
+                block_q=_pow2_block(Tt), block_k=_pow2_block(enc.shape[1]),
+            )
+        else:
+            a = reference_attention(
+                cq, ck, cv, False, segment_ids=q_seg,
+                kv_segment_ids=src_seg,
+            ).astype(cq.dtype)
+        h = h + nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype,
+                                name="cross_proj")(a)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln3")(h)
+        y = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(x)
+        return h + nn.Dense(D, dtype=self.dtype, name="ff2")(nn.gelu(y))
+
+
+class TransformerSeq2Seq(nn.Module):
+    """Transformer encoder-decoder on the flash kernels — the modern-scale
+    tier of the seq2seq family (same ``(src, tgt_in)`` contract as
+    :class:`Seq2Seq`, so :func:`seq2seq_loss` / :func:`greedy_decode` work
+    unchanged).  Source padding is masked IN KERNEL: encoder self-attention
+    isolates pads by segment, decoder cross-attention excludes pad keys via
+    ``kv_segment_ids`` (cross-attention runs the q-len ≠ kv-len flash
+    path)."""
+
+    vocab_src: int
+    vocab_tgt: int
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 256
+    n_enc: int = 2
+    n_dec: int = 2
+    max_len: int = 128
+    dtype: Any = jnp.float32
+    attention: str = "flash"
+
+    @nn.compact
+    def __call__(self, src, tgt_in):
+        D = self.d_model
+        if D % self.n_heads:
+            raise ValueError(
+                f"d_model {D} not divisible by n_heads {self.n_heads}"
+            )
+        Ts, Tt = src.shape[1], tgt_in.shape[1]
+        if max(Ts, Tt) > self.max_len:
+            raise ValueError(
+                f"sequence length {max(Ts, Tt)} exceeds max_len "
+                f"{self.max_len} (raise max_len)"
+            )
+        pos = self.param(
+            "pos", nn.initializers.normal(0.02), (self.max_len, D),
+            jnp.float32,
+        )
+        src_seg = (src != PAD).astype(jnp.int32)  # real=1, pad=0
+        h = nn.Embed(self.vocab_src, D, dtype=self.dtype, name="embed_src")(src)
+        h = h + pos[None, :Ts].astype(self.dtype)
+        for i in range(self.n_enc):
+            h = _EncBlock(
+                d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
+                dtype=self.dtype, attention=self.attention,
+                name=f"enc_{i}",
+            )(h, src_seg)
+        enc = nn.LayerNorm(dtype=self.dtype, name="ln_enc")(h)
+
+        t = nn.Embed(self.vocab_tgt, D, dtype=self.dtype,
+                     name="embed_tgt")(tgt_in)
+        t = t + pos[None, :Tt].astype(self.dtype)
+        for i in range(self.n_dec):
+            t = _DecBlock(
+                d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
+                dtype=self.dtype, attention=self.attention,
+                name=f"dec_{i}",
+            )(t, enc, src_seg)
+        t = nn.LayerNorm(dtype=self.dtype, name="ln_dec")(t)
+        return nn.Dense(self.vocab_tgt, dtype=jnp.float32, name="proj")(t)
+
+
 def seq2seq_loss(model: nn.Module):
     """Masked token-level cross entropy.  ``batch = (src, tgt)``, both
     PAD-padded; decoder input is BOS + tgt[:-1]."""
@@ -91,10 +246,11 @@ def greedy_decode(model: nn.Module, params, src, max_len: int = 32):
     positions, full re-apply per step — an eval utility, not a serving path)."""
     B = src.shape[0]
     tgt_in = jnp.full((B, max_len), PAD, jnp.int32).at[:, 0].set(BOS)
-    if getattr(model, "axis_name", None) is not None:
-        # Inside shard_map with vma checking the fori_loop carry must start
-        # device-varying (the decoded tokens depend on the varying src).
-        tgt_in = pvary(tgt_in, model.axis_name)
+    # Inside a vma-checked shard_map the fori_loop carry must start
+    # device-varying (decoded tokens depend on the varying src).  Deriving
+    # the carry arithmetically from src inherits its vma type without
+    # needing the model to advertise an axis name — works for any model.
+    tgt_in = tgt_in + src[:, :1].astype(jnp.int32) * 0
 
     def body(i, tgt_in):
         logits = model.apply({"params": params}, src, tgt_in)

@@ -23,6 +23,9 @@ def main():
     # width 4 keeps non-pad fraction ≥ 0.85 on the synthetic task (the
     # BASELINE.md "> 80% non-pad tokens" target) at ~the same batch count.
     p.add_argument("--bucket-width", type=int, default=4)
+    p.add_argument("--arch", default="lstm", choices=["lstm", "transformer"],
+                   help="lstm = reference-parity encoder-decoder; "
+                        "transformer = flash cross-attention tier")
     p.add_argument("--force-cpu", action="store_true")
     args = p.parse_args()
 
@@ -39,12 +42,25 @@ def main():
 
     import chainermn_tpu as cmn
     from chainermn_tpu.datasets.seq import bucket_batches, make_synthetic_translation
-    from chainermn_tpu.models import Seq2Seq, greedy_decode, seq2seq_loss
+    from chainermn_tpu.models import (
+        Seq2Seq,
+        TransformerSeq2Seq,
+        greedy_decode,
+        seq2seq_loss,
+    )
 
     comm = cmn.create_communicator(args.communicator)
-    model = Seq2Seq(vocab_src=args.vocab, vocab_tgt=args.vocab,
-                    embed=args.embed, hidden=args.hidden,
-                    axis_name=comm.axis_name)
+    if args.arch == "transformer":
+        # --embed = d_model, --hidden = FFN width (both flags meaningful
+        # in either arch).
+        model = TransformerSeq2Seq(
+            vocab_src=args.vocab, vocab_tgt=args.vocab,
+            d_model=args.embed, n_heads=4, d_ff=max(args.hidden, args.embed),
+        )
+    else:
+        model = Seq2Seq(vocab_src=args.vocab, vocab_tgt=args.vocab,
+                        embed=args.embed, hidden=args.hidden,
+                        axis_name=comm.axis_name)
     pairs = make_synthetic_translation(4096, vocab=args.vocab, min_len=4,
                                        max_len=16)
     batches = bucket_batches(pairs, args.batchsize,

@@ -37,6 +37,9 @@ EXAMPLES = {
                 "--d-model", "64", "--seq-len", "64", "--zero"],
     "seq2seq": ["examples/seq2seq/seq2seq.py", "--force-cpu", "--epoch", "1",
                 "--batchsize", "64", "--embed", "16", "--hidden", "32"],
+    "seq2seq_transformer": ["examples/seq2seq/seq2seq.py", "--force-cpu",
+                            "--epoch", "1", "--batchsize", "64",
+                            "--embed", "16", "--arch", "transformer"],
     "dcgan": ["examples/dcgan/train_dcgan.py", "--force-cpu", "--epoch", "1",
               "--n-train", "256", "--ch", "8", "--out", ""],
     "parallel_convnet": ["examples/parallel_convnet/train_parallel_convnet.py",
