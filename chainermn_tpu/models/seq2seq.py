@@ -184,6 +184,10 @@ class TransformerSeq2Seq(nn.Module):
     @nn.compact
     def __call__(self, src, tgt_in):
         D = self.d_model
+        if self.attention not in ("flash", "xla"):
+            raise ValueError(
+                f"attention={self.attention!r}: expected 'flash' or 'xla'"
+            )
         if D % self.n_heads:
             raise ValueError(
                 f"d_model {D} not divisible by n_heads {self.n_heads}"

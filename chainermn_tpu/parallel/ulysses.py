@@ -20,19 +20,10 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _default_attention(q, k, v, causal):
-    import math
+def _default_attention(q, k, v, causal, segment_ids=None):
+    from chainermn_tpu.ops import reference_attention
 
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        T = q.shape[1]
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+    return reference_attention(q, k, v, causal, segment_ids=segment_ids)
 
 
 def ulysses_attention(
@@ -42,13 +33,21 @@ def ulysses_attention(
     axis_name,
     causal: bool = False,
     attn_fn: Optional[Callable] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Call inside ``shard_map`` with local blocks ``(B, T/S, H, D)``; requires
-    ``H % S == 0``.  ``attn_fn(q, k, v, causal) -> out`` runs on full-length
-    sequences with ``H/S`` heads (default: XLA softmax attention; drop in a
-    flash/Pallas kernel here).
+    ``H % S == 0``.  ``attn_fn(q, k, v, causal) -> out`` runs on
+    full-length sequences with ``H/S`` heads (default: XLA softmax
+    attention; drop in a flash/Pallas kernel here); when ``segment_ids``
+    is used, the attn_fn must accept a fifth positional argument (the
+    full-length segment array).
+
+    ``segment_ids`` is the LOCAL ``(B, T/S)`` slice of packed rows'
+    segments: it is all-gathered to the full sequence (the head dimension
+    is what gets scattered, and segments are head-invariant), so packed
+    documents stay isolated.
     """
     S = lax.axis_size(axis_name)
     B, T, H, D = q.shape
@@ -67,5 +66,13 @@ def ulysses_attention(
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
 
-    out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal)
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if segment_ids is not None:
+        # (B, T/S) → (B, T): segments have no head axis to scatter — a
+        # plain all_gather over the sequence axis reassembles them.
+        seg_full = lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        out = attn_fn(qf, kf, vf, causal, seg_full)
+    else:
+        # 4-arg call keeps existing custom attn_fns working unchanged.
+        out = attn_fn(qf, kf, vf, causal)
     return heads_to_seq(out)

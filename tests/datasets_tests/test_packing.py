@@ -119,6 +119,37 @@ def test_packed_equals_standalone():
             )
 
 
+def test_pack_fuzz_invariants():
+    """Randomized layouts: for any doc-length distribution, every token
+    appears exactly once with its true next-token target, segments are
+    contiguous per row, and padding is fully sentinel."""
+    for seed in range(8):
+        rng = np.random.RandomState(100 + seed)
+        seq_len = int(rng.choice([16, 32, 48]))
+        docs = [
+            rng.randint(1, 99, size=rng.randint(1, 2 * seq_len)).astype(
+                np.int32
+            )
+            for _ in range(rng.randint(1, 40))
+        ]
+        tokens, targets, seg = pack_sequences(docs, seq_len)
+        total = sum(len(d) for d in docs)
+        assert int((seg != 0).sum()) == total
+        for r in range(tokens.shape[0]):
+            ids = seg[r]
+            for s in np.unique(ids):
+                idx = np.where(ids == s)[0]
+                assert np.array_equal(
+                    idx, np.arange(idx[0], idx[-1] + 1)
+                ), "segments must be contiguous"
+                if s == 0:
+                    continue
+                piece, tgt = tokens[r, idx], targets[r, idx]
+                np.testing.assert_array_equal(tgt[:-1], piece[1:])
+        pad = seg == 0
+        assert np.all(tokens[pad] == 0) and np.all(targets[pad] == -1)
+
+
 def test_packed_training_runs_dp(devices):
     """Packed 3-tuple batches through the DP train step (both losses)."""
     import optax

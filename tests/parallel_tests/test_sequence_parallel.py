@@ -279,3 +279,40 @@ def test_ring_packed_gradients_match(seq_comm):
             np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-3,
             err_msg=f"d{name} mismatch",
         )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_packed_segments(seq_comm, causal):
+    """Packing through the all-to-all strategy: the local segment slices
+    all-gather to the full sequence (head axis is what scatters), so packed
+    documents stay isolated."""
+    from chainermn_tpu.ops import reference_attention
+
+    comm = seq_comm
+    rng = np.random.RandomState(14)
+    q, k, v = _qkv(rng, B=2, T=64, H=8, D=4)
+    seg = np.zeros((2, 64), np.int32)
+    seg[:, 18:41] = 1
+    seg[:, 41:] = 2
+    seg[1, 9:] += 1
+    seg = jnp.asarray(seg)
+
+    spec = P(None, comm.axes)
+    f = jax.jit(
+        comm.spmd(
+            lambda q, k, v, s: ulysses_attention(
+                q, k, v, comm.axis_name, causal=causal, segment_ids=s
+            ),
+            in_specs=(spec, spec, spec, P(None, comm.axes)),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(q, k, v, seg))
+    ref = np.asarray(
+        reference_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal,
+            segment_ids=seg,
+        )
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
