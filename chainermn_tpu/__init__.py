@@ -24,7 +24,7 @@ from chainermn_tpu.distributed import (
     shutdown_distributed,
 )
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 from chainermn_tpu import comm  # noqa: E402
 from chainermn_tpu import functions  # noqa: E402
