@@ -209,6 +209,8 @@ def lm_generate(
     n_new: int,
     temperature: float = 0.0,
     rng=None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Autoregressive generation with the KV cache, one ``lax.scan`` over
     positions (prefill + generation in a single compiled program — the
@@ -219,6 +221,11 @@ def lm_generate(
       n_new: tokens to generate per row.
       temperature: ``0`` = greedy argmax; ``> 0`` = softmax sampling
         (requires ``rng``).
+      top_k: with sampling, keep only the ``k`` most likely tokens
+        (``0`` = no truncation).
+      top_p: with sampling, nucleus truncation — keep the smallest set of
+        tokens whose cumulative probability reaches ``top_p``
+        (``1.0`` = no truncation).  Composes with ``top_k``.
 
     Returns ``(B, n_new)`` int32 generated tokens.
     """
@@ -241,10 +248,43 @@ def lm_generate(
     # cache memory are O(P + n_new) per step (masking is shape-agnostic).
     cache = model.init_cache(B, total)
 
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+
+    def truncate(scaled):
+        """top-k then nucleus filtering on TEMPERATURE-SCALED (B, V) logits
+        (the nucleus must cover top_p of the distribution actually sampled
+        from).  One descending sort serves both filters."""
+        V = scaled.shape[-1]
+        sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+        if top_k:
+            k = min(top_k, V)  # top_k > vocab = keep all (HF convention)
+            kth = sorted_l[:, k - 1][:, None]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+            sorted_l = jnp.where(
+                jnp.arange(V)[None, :] < k, sorted_l, -jnp.inf
+            )
+        if top_p < 1.0:
+            probs = jax.nn.softmax(sorted_l, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # A token is kept while the mass BEFORE it is < top_p — keeps
+            # every token up to and including the one that crosses top_p.
+            keep = (cum - probs) < top_p
+            thresh = jnp.min(
+                jnp.where(keep, sorted_l, jnp.inf), axis=-1
+            )[:, None]  # smallest KEPT logit
+            scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
+        return scaled
+
     def pick(logits, key):
         if temperature > 0:
             key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            scaled = logits / temperature
+            if top_k or top_p < 1.0:
+                scaled = truncate(scaled)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         return nxt.astype(jnp.int32), key

@@ -11,4 +11,13 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 
-python -m pytest tests/ -q "$@"
+# Stages: --quick skips the slowest tier (examples-as-subprocesses +
+# multiprocess integration, ~10 min of the ~25-min full run) for inner-loop
+# development; default runs everything (the CI contract).
+if [ "${1:-}" = "--quick" ]; then
+  shift
+  python -m pytest tests/ -q \
+    --ignore tests/examples_tests --ignore tests/multiprocess_tests "$@"
+else
+  python -m pytest tests/ -q "$@"
+fi

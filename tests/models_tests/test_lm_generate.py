@@ -68,6 +68,37 @@ def test_greedy_generate_matches_naive_rollout():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_top_k_sampling_restricts_support():
+    """top_k=1 sampling must equal greedy (the only surviving token is the
+    argmax), for any temperature."""
+    model = _model(24)
+    params = _params(model, 24)
+    rng = np.random.RandomState(4)
+    prompt = jnp.asarray(rng.randint(0, 40, size=(3, 5)).astype(np.int32))
+    greedy = lm_generate(model, params, prompt, 8)
+    k1 = lm_generate(model, params, prompt, 8, temperature=1.7,
+                     rng=jax.random.PRNGKey(5), top_k=1)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+
+def test_top_p_tiny_nucleus_equals_greedy():
+    """A nucleus small enough to hold only the top token == greedy."""
+    model = _model(24)
+    params = _params(model, 24)
+    rng = np.random.RandomState(5)
+    prompt = jnp.asarray(rng.randint(0, 40, size=(2, 5)).astype(np.int32))
+    greedy = lm_generate(model, params, prompt, 8)
+    p_tiny = lm_generate(model, params, prompt, 8, temperature=1.3,
+                         rng=jax.random.PRNGKey(6), top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(p_tiny), np.asarray(greedy))
+    with pytest.raises(ValueError, match="top_p"):
+        lm_generate(model, params, prompt, 4, temperature=1.0,
+                    rng=jax.random.PRNGKey(0), top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        lm_generate(model, params, prompt, 4, temperature=1.0,
+                    rng=jax.random.PRNGKey(0), top_k=-1)
+
+
 def test_sampling_runs_and_validates():
     model = _model(16)
     params = _params(model, 16)
