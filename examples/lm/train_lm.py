@@ -44,6 +44,9 @@ def main():
                     help="ZeRO-shard params/grads/optimizer state 1/N")
     ap.add_argument("--warmup", type=int, default=0,
                     help="linear-warmup steps into a cosine decay schedule")
+    ap.add_argument("--eval", action="store_true",
+                    help="after training, validation perplexity over a "
+                         "held-out split via the multi-node evaluator")
     ap.add_argument("--generate", type=int, default=0,
                     help="after training, greedily generate N tokens from a "
                          "corpus prompt (KV-cache decode)")
@@ -98,6 +101,12 @@ def main():
         tokens = corpus[: n_seq * T].reshape(n_seq, T)
         targets = corpus[1 : n_seq * T + 1].reshape(n_seq, T)
         arrays = (tokens, targets)
+    # A REAL held-out split: validation rows are removed from the arrays
+    # BEFORE the training dataset is built.
+    n_val = max(len(arrays[0]) // 10, comm.size) if args.eval else 0
+    val_arrays = tuple(a[-n_val:] for a in arrays) if n_val else None
+    if n_val:
+        arrays = tuple(a[:-n_val] for a in arrays)
     ds = scatter_dataset(  # host-level shard (process_index)
         ArrayDataset(*arrays), comm, shuffle=True, seed=0
     )
@@ -149,15 +158,66 @@ def main():
                 print(f"step {i}: loss {float(metrics['loss']):.4f}",
                       flush=True)
     it.close()
+    # One materialization serves both --eval and --generate (under ZeRO
+    # this is a full cross-device param all-gather; don't repeat it).
+    full_params = None
+    if args.eval or args.generate:
+        full_params = (
+            opt.materialize_params(state) if args.zero else state.params
+        )
+    if args.eval:
+        from chainermn_tpu.extensions import (
+            Evaluator,
+            create_multi_node_evaluator,
+        )
+        from chainermn_tpu.iterators import SerialIterator
+
+        # The evaluator's multi-host contract: every process iterates the
+        # same GLOBAL batches in lockstep; SerialIterator carries the fixed
+        # batch_size so every batch (incl. the tail) pads to ONE compiled
+        # shape.
+        eval_bs = min(64, n_val)
+
+        def val_batches():
+            return SerialIterator(ArrayDataset(*val_arrays), eval_bs,
+                                  repeat=False, shuffle=False)
+
+        def metric_fn(params, batch):
+            toks, tgts, *rest = batch  # packed batches carry segment ids
+            logits = model.apply(
+                {"params": params}, toks,
+                segment_ids=rest[0] if rest else None,
+            )
+            m = (tgts >= 0).astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, jnp.maximum(tgts, 0)
+            )
+            # Token-weighted sums; finalize divides AFTER the global psum —
+            # the exact corpus perplexity, not a mean of batch means.
+            return {"ce_sum": (ce * m).sum(-1), "tokens": m.sum(-1)}
+
+        def finalize(sums, count):
+            return {
+                "val_ppl": jnp.exp(
+                    sums["ce_sum"] / jnp.maximum(sums["tokens"], 1.0)
+                ),
+                "val_tokens": sums["tokens"],
+            }
+
+        ev = create_multi_node_evaluator(
+            Evaluator(val_batches, metric_fn, comm, finalize=finalize), comm
+        )
+        scores = ev.evaluate(params=full_params)
+        if jax.process_index() == 0:
+            print(f"val_ppl {scores['val_ppl']:.3f}  "
+                  f"({int(scores['val_tokens'])} tokens)", flush=True)
     if args.generate:
         from chainermn_tpu.models import lm_generate
 
-        # Collective work (ZeRO gather) runs on EVERY process; only the
-        # host-local decode and printing are rank-0 gated (running mesh
-        # computations inside the guard would deadlock multi-host runs).
-        gen_params = jax.device_get(
-            opt.materialize_params(state) if args.zero else state.params
-        )
+        # Collective work (the ZeRO gather above) already ran on EVERY
+        # process; only the host-local decode and printing are rank-0 gated
+        # (mesh computations inside the guard would deadlock multi-host).
+        gen_params = jax.device_get(full_params)
         if jax.process_index() == 0:
             prompt = jnp.asarray(corpus[:16][None].astype(np.int32))
             out = lm_generate(model, gen_params, prompt, args.generate)

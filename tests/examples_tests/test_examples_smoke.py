@@ -32,7 +32,8 @@ EXAMPLES = {
     "lm_packed_recipe": ["examples/lm/train_lm.py", "--steps", "4",
                          "--layers", "1", "--d-model", "64",
                          "--seq-len", "64", "--pack", "--accum", "2",
-                         "--remat", "--warmup", "2"],
+                         "--remat", "--warmup", "2", "--eval",
+                         "--generate", "8"],
     "lm_zero": ["examples/lm/train_lm.py", "--steps", "4", "--layers", "1",
                 "--d-model", "64", "--seq-len", "64", "--zero"],
     "seq2seq": ["examples/seq2seq/seq2seq.py", "--force-cpu", "--epoch", "1",
