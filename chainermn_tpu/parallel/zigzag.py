@@ -74,6 +74,7 @@ def zigzag_ring_self_attention(
     v: jax.Array,
     axis_name,
     remat: bool = True,
+    segment_ids=None,
 ) -> jax.Array:
     """Causal self-attention over a ZIGZAG-sharded sequence.
 
@@ -82,7 +83,12 @@ def zigzag_ring_self_attention(
     half = late chunk ``2S-1-my``).  Returns the local output block in the
     same layout.  Always causal — the balanced schedule is only meaningful
     under causal masking (full attention is already balanced on the plain
-    ring)."""
+    ring).
+
+    ``segment_ids`` is the local ``(B, 2c)`` ZIGZAG-SHARDED slice of the
+    packed rows' segments (shard with :func:`zigzag_shard` like q/k/v); the
+    k-side slice rotates with its K/V pair so packed documents stay
+    isolated."""
     B, T2, H, D = q.shape
     if T2 % 2:
         raise ValueError("local zigzag block must hold an even chunk pair")
@@ -90,6 +96,7 @@ def zigzag_ring_self_attention(
     S = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % S) for i in range(S)]
+    segmented = segment_ids is not None
 
     def chunk_ids(rank):
         return rank, 2 * S - 1 - rank  # (early, late) global chunk index
@@ -97,18 +104,28 @@ def zigzag_ring_self_attention(
     def split(x):
         return x[:, :c], x[:, c:]
 
-    def attend_pair(qc, q_id, kc, vc, k_id, m, l, o):
+    def attend_pair(qc, q_id, sq, kc, vc, k_id, sk, m, l, o):
         """Attend one (q_chunk, k_chunk) quadrant under the chunk-level
         causal structure; skipped entirely when the quadrant is fully
         masked.  All three cases keep the same static shapes."""
         rel = jnp.arange(c)[:, None] - jnp.arange(c)[None, :]
         diag_mask = rel >= 0
+        seg_mask = (
+            sq[:, :, None] == sk[:, None, :] if segmented else None
+        )
+
+        def combine(base):
+            if seg_mask is None:
+                return base
+            if base is None:
+                return seg_mask
+            return base[None] & seg_mask
 
         def full():
-            return _block_attend(qc, kc, vc, m, l, o, None)
+            return _block_attend(qc, kc, vc, m, l, o, combine(None))
 
         def diag():
-            return _block_attend(qc, kc, vc, m, l, o, diag_mask)
+            return _block_attend(qc, kc, vc, m, l, o, combine(diag_mask))
 
         def skip():
             return m, l, o
@@ -119,17 +136,28 @@ def zigzag_ring_self_attention(
             lambda: lax.cond(q_id == k_id, diag, skip),
         )
 
-    def attend_block(k_blk, v_blk, src, acc):
+    def attend_block(k_blk, v_blk, seg_blk, src, acc):
         """Attend all needed quadrants of the visiting rank's pair."""
         (m_e, l_e, o_e), (m_l, l_l, o_l) = acc
         q_e, q_l = split(q)
         k_e, k_l = split(k_blk)
         v_e, v_l = split(v_blk)
+        if segmented:
+            sq_e, sq_l = split(segment_ids)
+            sk_e, sk_l = split(seg_blk)
+        else:
+            sq_e = sq_l = sk_e = sk_l = None
         my_e, my_l = chunk_ids(my)
         src_e, src_l = chunk_ids(src)
-        for kc, vc, k_id in ((k_e, v_e, src_e), (k_l, v_l, src_l)):
-            m_e, l_e, o_e = attend_pair(q_e, my_e, kc, vc, k_id, m_e, l_e, o_e)
-            m_l, l_l, o_l = attend_pair(q_l, my_l, kc, vc, k_id, m_l, l_l, o_l)
+        for kc, vc, k_id, sk in (
+            (k_e, v_e, src_e, sk_e), (k_l, v_l, src_l, sk_l)
+        ):
+            m_e, l_e, o_e = attend_pair(
+                q_e, my_e, sq_e, kc, vc, k_id, sk, m_e, l_e, o_e
+            )
+            m_l, l_l, o_l = attend_pair(
+                q_l, my_l, sq_l, kc, vc, k_id, sk, m_l, l_l, o_l
+            )
         return (m_e, l_e, o_e), (m_l, l_l, o_l)
 
     def fresh():
@@ -139,17 +167,28 @@ def zigzag_ring_self_attention(
         return m0, l0, o0
 
     def body(carry, step):
-        k_cur, v_cur, acc_e, acc_l = carry
+        k_cur, v_cur, seg_cur, acc_e, acc_l = carry
         src = (my - step) % S
-        acc_e, acc_l = attend_block(k_cur, v_cur, src, (acc_e, acc_l))
+        acc_e, acc_l = attend_block(k_cur, v_cur, seg_cur, src,
+                                    (acc_e, acc_l))
         k_nxt = lax.ppermute(k_cur, axis_name, perm=perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm=perm)
-        return (k_nxt, v_nxt, acc_e, acc_l), None
+        seg_nxt = (
+            lax.ppermute(seg_cur, axis_name, perm=perm)
+            if segmented
+            else seg_cur
+        )
+        return (k_nxt, v_nxt, seg_nxt, acc_e, acc_l), None
 
     if remat:
         body = jax.checkpoint(body)
-    (_, _, (m_e, l_e, o_e), (m_l, l_l, o_l)), _ = lax.scan(
-        body, (k, v, fresh(), fresh()), jnp.arange(S)
+    seg0 = (
+        segment_ids
+        if segmented
+        else pvary(jnp.zeros((B, T2), jnp.int32), axis_name)
+    )
+    (_, _, _, (m_e, l_e, o_e), (m_l, l_l, o_l)), _ = lax.scan(
+        body, (k, v, seg0, fresh(), fresh()), jnp.arange(S)
     )
 
     def finish(m, l, o):
@@ -160,32 +199,45 @@ def zigzag_ring_self_attention(
     return out.astype(q.dtype)
 
 
-def zigzag_attention(comm, q, k, v) -> jax.Array:
+def zigzag_attention(comm, q, k, v, segment_ids=None) -> jax.Array:
     """Eager convenience wrapper: CONTIGUOUS global ``(B, T, H, D)`` arrays
     in, causal attention out (contiguous layout restored) — the zigzag
     shuffle, the balanced ring, and the unshuffle in one jitted program,
-    sequence-sharded over ``comm``'s axes."""
-    from functools import partial
-
+    sequence-sharded over ``comm``'s axes.  ``segment_ids`` (contiguous
+    global ``(B, T)``) packs documents; it rides the same zigzag shuffle."""
     from jax.sharding import PartitionSpec as P
 
     S = comm.size
     spec = P(None, comm.axes)
+    segmented = segment_ids is not None
 
     def build():
+        def fn(q, k, v, *seg):
+            return zigzag_ring_self_attention(
+                q, k, v, axis_name=comm.axis_name,
+                segment_ids=seg[0] if seg else None,
+            )
+
         inner = comm.spmd(
-            partial(zigzag_ring_self_attention, axis_name=comm.axis_name),
-            in_specs=(spec, spec, spec),
+            fn,
+            in_specs=(spec, spec, spec) + ((spec,) if segmented else ()),
             out_specs=spec,
             check_vma=True,
         )
 
-        def run(q, k, v):
+        def run(q, k, v, *seg):
             zq = zigzag_shard(q, S)
             zk = zigzag_shard(k, S)
             zv = zigzag_shard(v, S)
-            return zigzag_unshard(inner(zq, zk, zv), S)
+            if seg:
+                out = inner(zq, zk, zv, zigzag_shard(seg[0], S))
+            else:
+                out = inner(zq, zk, zv)
+            return zigzag_unshard(out, S)
 
         return jax.jit(run)
 
-    return comm._jitted(("zigzag_attention",), build)(q, k, v)
+    f = comm._jitted(("zigzag_attention", segmented), build)
+    if segmented:
+        return f(q, k, v, segment_ids)
+    return f(q, k, v)

@@ -104,6 +104,11 @@ def main():
     # A REAL held-out split: validation rows are removed from the arrays
     # BEFORE the training dataset is built.
     n_val = max(len(arrays[0]) // 10, comm.size) if args.eval else 0
+    if n_val >= len(arrays[0]):
+        ap.error(
+            f"--eval needs more data: {len(arrays[0])} rows can't spare a "
+            f"{n_val}-row validation split (shorten --seq-len or drop --eval)"
+        )
     val_arrays = tuple(a[-n_val:] for a in arrays) if n_val else None
     if n_val:
         arrays = tuple(a[:-n_val] for a in arrays)
