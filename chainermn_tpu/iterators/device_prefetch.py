@@ -130,6 +130,23 @@ class DevicePrefetchIterator:
             raise AttributeError(name)
         return getattr(it, name)
 
+    @property
+    def _pos(self):
+        """Consumption-adjusted cursor.  The checkpointer's raw-attribute
+        fallback (for inner iterators exposing ``_pos`` but neither
+        checkpoint protocol) must not see the inner SUBMISSION cursor —
+        it runs up to ``depth`` batches ahead of what the trainer consumed."""
+        pos = getattr(self.__dict__["_it"], "_pos", 0)
+        queued = sum(e.n_samples for e in self._queue)
+        boundary = any(e.is_new_epoch for e in self._queue)
+        if queued and not boundary and pos >= queued:
+            return pos - queued
+        return pos
+
+    @_pos.setter
+    def _pos(self, value):
+        setattr(self.__dict__["_it"], "_pos", value)
+
     # ------------------------------------------------------- checkpointing
     def checkpoint_loop_state(self) -> Optional[dict]:
         """Consumption-granular cursor for the multi-node checkpointer.

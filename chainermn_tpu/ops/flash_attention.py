@@ -191,6 +191,9 @@ def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q, block_k,
             pl.BlockSpec((1, S), lambda b, i: (b // heads, 0)),
         ]
         args += [seg_q, seg_kv]
+    # Outputs vary as the union of ALL inputs — including the segment
+    # arrays (a device-varying packing mask alone makes outputs vary).
+    vma = _vma_union(q, k, v, *(args[3:] if segmented else []))
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -200,8 +203,8 @@ def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q, block_k,
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), q.dtype, vma=_vma_union(q, k, v)),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32, vma=_vma_union(q, k, v)),
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, T), jnp.float32, vma=vma),
         ],
         interpret=interpret,
     )(*args)
@@ -371,6 +374,8 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
                          lambda b, i: (b // heads, i)),          # seg (k blk)
         ]
         args += [seg_q, seg_kv]
+    vma = _vma_union(q, k, v, do, lse, delta,
+                     *([seg_q, seg_kv] if segmented else []))
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, S // block_k),
@@ -380,12 +385,8 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(
-                (BH, S, D), k.dtype, vma=_vma_union(q, k, v, do, lse, delta)
-            ),
-            jax.ShapeDtypeStruct(
-                (BH, S, D), v.dtype, vma=_vma_union(q, k, v, do, lse, delta)
-            ),
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype, vma=vma),
         ],
         interpret=interpret,
     )(*args)
@@ -415,9 +416,7 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
         grid=(BH, T // block_q),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(
-            (BH, T, D), q.dtype, vma=_vma_union(q, k, v, do, lse, delta)
-        ),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype, vma=vma),
         interpret=interpret,
     )(*args)
     return dq, dk, dv

@@ -40,6 +40,29 @@ def _augment_key(seed: int, step: jax.Array, axes) -> jax.Array:
     return jax.random.fold_in(key, lax.axis_index(axes))
 
 
+def _make_grad_one(loss_fn, has_aux, stateful):
+    """Shared per-microbatch gradient closure: ``grad_one(params,
+    model_state, mb) -> (loss, aux, new_model_state, grads)`` under the
+    three loss contracts (plain / has_aux / stateful)."""
+
+    def grad_one(params, model_state, mb):
+        if stateful:
+            (loss, (aux, ms)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, model_state, mb)
+        elif has_aux:
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, mb)
+            ms = model_state
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            aux, ms = {}, model_state
+        return loss, aux, ms, grads
+
+    return grad_one
+
+
 def _accumulated_grads(grad_one, params, model_state, batch, accum_steps):
     """Gradient accumulation core, shared by both optimizer tiers.
 
@@ -216,21 +239,7 @@ class MultiNodeOptimizer:
         dbuf = self.double_buffering
         tx = self.tx
 
-        def grad_one(vparams, model_state, mb):
-            """One microbatch's (loss, aux, new_model_state, grads)."""
-            if stateful:
-                (loss, (aux, ms)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(vparams, model_state, mb)
-            elif has_aux:
-                (loss, aux), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(vparams, mb)
-                ms = model_state
-            else:
-                loss, grads = jax.value_and_grad(loss_fn)(vparams, mb)
-                aux, ms = {}, model_state
-            return loss, aux, ms, grads
+        grad_one = _make_grad_one(loss_fn, has_aux, stateful)
 
         def body(state: TrainState, batch):
             # Differentiate w.r.t. an explicitly device-varying copy of the

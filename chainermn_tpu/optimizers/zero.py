@@ -210,7 +210,11 @@ class ZeroMultiNodeOptimizer:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         # Deferred import (same pattern as update()'s _eager_update): the
         # optimizers package imports this module at its bottom.
-        from chainermn_tpu.optimizers import _accumulated_grads, _augment_key
+        from chainermn_tpu.optimizers import (
+            _accumulated_grads,
+            _augment_key,
+            _make_grad_one,
+        )
 
         wire = getattr(comm, "allreduce_grad_dtype", None)
 
@@ -246,20 +250,7 @@ class ZeroMultiNodeOptimizer:
                 out.append(r)
             return out
 
-        def grad_one(params, model_state, mb):
-            if stateful:
-                (loss, (aux, ms)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params, model_state, mb)
-            elif has_aux:
-                (loss, aux), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params, mb)
-                ms = model_state
-            else:
-                loss, grads = jax.value_and_grad(loss_fn)(params, mb)
-                aux, ms = {}, model_state
-            return loss, aux, ms, grads
+        grad_one = _make_grad_one(loss_fn, has_aux, stateful)
 
         def body(state: ZeroTrainState, batch):
             # Params are all-gathered ONCE per step and reused across the

@@ -51,7 +51,6 @@ def zigzag_shard(x: jax.Array, S: int, axis: int = 1) -> jax.Array:
     T = x.shape[axis]
     if T % (2 * S):
         raise ValueError(f"seq len {T} must divide into 2*{S} chunks")
-    c = T // (2 * S)
     parts = jnp.split(x, 2 * S, axis=axis)
     return jnp.concatenate([parts[j] for j in zigzag_order(S)], axis=axis)
 
