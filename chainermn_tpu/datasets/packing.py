@@ -32,7 +32,9 @@ def pack_sequences(
       docs: int token arrays (1-D, any lengths ≥ 1).
       seq_len: row width.
       drop_overlong: documents longer than ``seq_len`` are split into
-        ``seq_len``-sized pieces (default) or dropped.
+        ``seq_len``-sized pieces (default) or dropped.  Split pieces get
+        independent segment ids, so each piece attends only within itself
+        — boundary predictions across a split are context-truncated.
 
     Returns ``(tokens, targets, segment_ids)``, each ``(N, seq_len)`` int32:
     padding tokens are 0 with segment id 0 and target −1.
@@ -43,7 +45,12 @@ def pack_sequences(
         raise ValueError(f"seq_len must be >= 1, got {seq_len}")
     # Per-doc targets computed BEFORE any splitting, so a split piece keeps
     # the true next-token target at its boundary (only the document's final
-    # token is unsupervised).
+    # token is unsupervised).  Each piece gets its OWN segment id and is
+    # placed independently, so a piece attends only within itself: the
+    # boundary prediction (last token of piece i → first token of piece
+    # i+1) is trained with zero context from the preceding piece — the
+    # standard truncated-context approximation, not full-context training
+    # of overlong documents.
     pieces: List[Tuple[np.ndarray, np.ndarray]] = []
     for d in docs:
         d = np.asarray(d, np.int32).reshape(-1)

@@ -93,6 +93,11 @@ class MultiNodeCheckpointer(Extension):
             out["rng_pos"] = np.asarray(st["rng_pos"], np.int64)
             out["rng_has_gauss"] = np.asarray(st["rng_has_gauss"], np.int64)
             out["rng_cached"] = np.asarray(st["rng_cached"], np.float64)
+            if "inexact" in st:
+                # Boundary-degraded cursor (see DevicePrefetchIterator):
+                # recorded so the snapshot itself says it may replay/skip
+                # up to this many samples on restore.
+                out["it_inexact"] = np.asarray(st["inexact"], np.int64)
             return out
         out["it_pos"] = np.asarray(getattr(it, "_pos", 0), np.int64)
         # Exact mid-epoch resume needs the iterator's in-flight permutation

@@ -184,6 +184,23 @@ class DevicePrefetchIterator:
         if queued and not boundary and state.get("pos", 0) >= queued:
             state = dict(state)
             state["pos"] = int(state["pos"]) - queued
+        elif queued:
+            # Exact adjustment impossible (a queued batch crosses an epoch
+            # boundary, or the inner cursor sits below the queue depth): the
+            # inner submission-side cursor passes through unchanged, so a
+            # restore from THIS snapshot replays or skips up to `queued`
+            # samples.  Mark the snapshot so the operator can tell a
+            # boundary-degraded checkpoint from an exact one.
+            import warnings
+
+            state = dict(state)
+            state["inexact"] = int(queued)
+            warnings.warn(
+                "DevicePrefetchIterator checkpoint taken with an epoch "
+                f"boundary in the prefetch queue: cursor is inexact by up "
+                f"to {queued} samples (snapshot carries inexact={queued}).",
+                stacklevel=2,
+            )
         return state
 
     def restore_loop_state(self, epoch: int, state: dict) -> None:

@@ -66,17 +66,33 @@ class _DecoderBlock(nn.Module):
             # Incremental: write this chunk's k/v at decode_pos (T=1 per
             # generation step; T=P for the batched prompt prefill), attend
             # causally over the cache prefix (memory-bound — XLA, not
-            # flash).
-            kc = lax.dynamic_update_slice(cache["k"], k, (0, decode_pos, 0, 0))
-            vc = lax.dynamic_update_slice(cache["v"], v, (0, decode_pos, 0, 0))
+            # flash).  decode_pos may be a (B,) vector (ragged prompts:
+            # each row writes at its own position, T must be 1) — per-row
+            # causal masking then keeps the not-yet-overwritten pad slots
+            # of shorter rows unattended.
+            B = k.shape[0]
+            if jnp.ndim(decode_pos) == 0:
+                kc = lax.dynamic_update_slice(
+                    cache["k"], k, (0, decode_pos, 0, 0)
+                )
+                vc = lax.dynamic_update_slice(
+                    cache["v"], v, (0, decode_pos, 0, 0)
+                )
+                q_pos = jnp.broadcast_to(
+                    (decode_pos + jnp.arange(T))[None], (B, T)
+                )
+            else:
+                kc = cache["k"].at[jnp.arange(B), decode_pos].set(k[:, 0])
+                vc = cache["v"].at[jnp.arange(B), decode_pos].set(v[:, 0])
+                q_pos = decode_pos[:, None]  # (B, 1)
             s = jnp.einsum(
                 "bqhd,bthd->bhqt", q.astype(jnp.float32),
                 kc.astype(jnp.float32),
             ) / math.sqrt(D // H)
             t_idx = jnp.arange(kc.shape[1])
-            q_pos = decode_pos + jnp.arange(T)
             s = jnp.where(
-                (t_idx[None, :] <= q_pos[:, None])[None, None], s, -1e30
+                t_idx[None, None, None, :] <= q_pos[:, None, :, None],
+                s, -1e30,
             )
             p = jax.nn.softmax(s, axis=-1)
             a = jnp.einsum(
@@ -152,9 +168,13 @@ class TransformerLM(nn.Module):
             "pos", nn.initializers.normal(0.02), (self.max_len, D), jnp.float32
         )
         if cache is not None:
-            h = h + lax.dynamic_slice(
-                pos, (decode_pos, 0), (T, D)
-            )[None].astype(self.dtype)
+            if jnp.ndim(decode_pos) == 0:
+                h = h + lax.dynamic_slice(
+                    pos, (decode_pos, 0), (T, D)
+                )[None].astype(self.dtype)
+            else:
+                # Per-row positions (ragged-prompt decode, T == 1).
+                h = h + pos[decode_pos][:, None].astype(self.dtype)
         elif segment_ids is None:
             h = h + pos[None, :T].astype(self.dtype)
         else:
@@ -211,13 +231,16 @@ def lm_generate(
     rng=None,
     top_k: int = 0,
     top_p: float = 1.0,
+    prompt_lengths=None,
 ):
     """Autoregressive generation with the KV cache, one ``lax.scan`` over
     positions (prefill + generation in a single compiled program — the
     TPU-idiomatic decode loop; no Python per-token dispatch).
 
     Args:
-      prompt: ``(B, P)`` int32 prompt tokens (``P >= 1``).
+      prompt: ``(B, P)`` int32 prompt tokens (``P >= 1``).  Without
+        ``prompt_lengths`` every row must be a FULL-length (un-padded)
+        prompt — the prefill conditions on ``prompt[:, -1]`` for all rows.
       n_new: tokens to generate per row.
       temperature: ``0`` = greedy argmax; ``> 0`` = softmax sampling
         (requires ``rng``).
@@ -226,8 +249,14 @@ def lm_generate(
       top_p: with sampling, nucleus truncation — keep the smallest set of
         tokens whose cumulative probability reaches ``top_p``
         (``1.0`` = no truncation).  Composes with ``top_k``.
+      prompt_lengths: optional ``(B,)`` int32 per-row real lengths for
+        RIGHT-PADDED ragged prompts (``1 <= length <= P``).  Each row
+        conditions on its own last real token and generates at positions
+        ``length, length+1, …``; the generated KVs overwrite the pad slots
+        progressively, so per-row causal masking keeps pads unattended.
 
-    Returns ``(B, n_new)`` int32 generated tokens.
+    Returns ``(B, n_new)`` int32 generated tokens (row ``i``'s tokens at
+    positions ``length_i … length_i + n_new - 1`` when ragged).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, P = prompt.shape
@@ -289,18 +318,32 @@ def lm_generate(
             nxt = jnp.argmax(logits, axis=-1)
         return nxt.astype(jnp.int32), key
 
+    if prompt_lengths is not None:
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        if lengths.shape != (B,):
+            raise ValueError(
+                f"prompt_lengths must be ({B},), got {lengths.shape}"
+            )
+
     # Batched prefill: ONE (B, P) forward populates the whole prompt's
     # cache (MXU-friendly), instead of P serialized single-token steps.
     key = rng if rng is not None else jax.random.PRNGKey(0)
     logits, cache = model.apply(
         {"params": params}, prompt, cache=cache, decode_pos=0
     )
-    tok0, key = pick(logits[:, -1], key)
+    if prompt_lengths is None:
+        tok0, key = pick(logits[:, -1], key)
+    else:
+        # Each row conditions on its own last real token's logits; pad-slot
+        # prefill logits are simply never read.
+        tok0, key = pick(logits[jnp.arange(B), lengths - 1], key)
 
     def body(carry, i):
         tok, cache, key = carry
+        step_pos = (P + i) if prompt_lengths is None else (lengths + i)
         logits, cache = model.apply(
-            {"params": params}, tok[:, None], cache=cache, decode_pos=P + i
+            {"params": params}, tok[:, None], cache=cache,
+            decode_pos=step_pos,
         )
         nxt, key = pick(logits[:, 0], key)
         return (nxt, cache, key), tok

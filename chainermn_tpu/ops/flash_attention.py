@@ -86,8 +86,13 @@ def _reference_attention_lse(q, k, v, causal: bool = False,
         seg = (segment_ids[:, :, None] == kv_segment_ids[:, None, :])
         s = jnp.where(seg[:, None, :, :], s, NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B, H, T)
-    p = jnp.exp(s - lse[..., None])
+    # Match the kernel's fully-masked-row contract: rows where every key is
+    # NEG_INF emit zeros + lse = NEG_INF ("no mass"), and the p mask also
+    # zeroes their q/k/v gradients under AD (the kernel's bwd guard twin).
+    alive = jnp.max(s, axis=-1) > NEG_INF * 0.5  # (B, H, T)
+    p = jnp.exp(s - lse[..., None]) * alive[..., None]
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    lse = jnp.where(alive, lse, NEG_INF)
     return o.transpose(0, 2, 1, 3).astype(q.dtype), lse
 
 
@@ -150,8 +155,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     acc0 = jnp.zeros((bq, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_k_eff, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    # A fully-masked row (every key NEG_INF — e.g. a query segment with no
+    # matching kv id) leaves m at NEG_INF; the finite-NEG_INF rescue would
+    # then make p = exp(0) = 1 for every key and o a uniform average of V.
+    # Emit zeros and the canonical "no mass" lse = NEG_INF instead (exact
+    # log-0 mass, so ring/blockwise merges weight these rows to zero).
+    alive = m > NEG_INF * 0.5
+    o_ref[0] = jnp.where(
+        alive[:, None], acc / l_safe[:, None], 0.0
+    ).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(alive, m + jnp.log(l_safe), NEG_INF)
 
 
 
@@ -257,7 +270,12 @@ def _bwd_dkv_kernel(
         if segmented:
             seg_q = segq_ref[0, pl.ds(qi * block_q, block_q)]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # (BQ, BK), exact softmax via saved LSE
+        # Exact softmax via saved LSE.  Rows with lse == NEG_INF carried no
+        # mass in the forward (fully masked); s - lse would cancel the
+        # finite NEG_INF there (p = 1), so mask them to zero explicitly.
+        p = jnp.where(
+            (lse > NEG_INF * 0.5)[:, None], jnp.exp(s - lse[:, None]), 0.0
+        )  # (BQ, BK)
         dv_new = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -324,7 +342,10 @@ def _bwd_dq_kernel(
         if segmented:
             seg_k = segk_ref[0, pl.ds(ki * block_k, block_k)]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        # Same fully-masked-row guard as the dK/dV kernel.
+        p = jnp.where(
+            (lse > NEG_INF * 0.5)[:, None], jnp.exp(s - lse[:, None]), 0.0
+        )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,

@@ -2,12 +2,16 @@
 # Watch the axon TPU tunnel and run bench.py the moment it answers.
 # The tunnel wedges for long stretches; polling with short probes and firing
 # immediately on recovery is the only strategy that has worked.
+# Every probe attempt is timestamped into result/tpu_probe_log.txt so that a
+# round where the tunnel never answers still leaves a committed artifact.
 #   usage: scripts/tpu_bench_watch.sh [max_minutes] [per_chip_batch]
 set -u
 MAX_MIN=${1:-120}
 BATCH=${2:-64}
 DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 cd "$(dirname "$0")/.."
+mkdir -p result
+PROBE_LOG=result/tpu_probe_log.txt
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout 90 python -c "
 import jax, jax.numpy as jnp
@@ -15,10 +19,11 @@ x = jnp.ones((256,256), jnp.bfloat16)
 assert jax.devices()[0].platform != 'cpu'
 print(float((x@x).sum()))
 " >/dev/null 2>&1; then
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) UP" >>"$PROBE_LOG"
     if [ ! -s result/bench_tpu_done.json ]; then
       echo "# tunnel up at $(date +%H:%M:%S); running bench (batch $BATCH)" >&2
       CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH \
-        CMN_BENCH_PROFILE=result/profile_r02 python bench.py \
+        CMN_BENCH_PROFILE=result/profile_r03 python bench.py \
         >result/bench_tpu_last.json 2>>result/bench_watch_stderr.log
       rc=$?
       cat result/bench_tpu_last.json  # accumulate every attempt on our stdout
@@ -34,6 +39,14 @@ print(float((x@x).sum()))
       timeout 1800 python benchmarks/flash_tpu.py --out result/flash_tpu.json \
         >>result/bench_watch_stderr.log 2>&1
       echo "# flash sweep rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/flash_tests_tpu.txt ]; then
+      echo "# running flash TPU test module at $(date +%H:%M:%S)" >&2
+      timeout 1200 env CMN_TESTS_TPU=1 python -m pytest \
+        tests/ops_tests/test_flash_tpu.py -q --no-header \
+        >result/flash_tests_tpu.txt.tmp 2>&1 \
+        && mv result/flash_tests_tpu.txt.tmp result/flash_tests_tpu.txt
+      echo "# flash tests rc=$? at $(date +%H:%M:%S)" >&2
     fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/collectives_tpu.json ]; then
       echo "# running collectives sweep at $(date +%H:%M:%S)" >&2
@@ -53,6 +66,12 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# memory ablation rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/overlap_tpu.json ]; then
+      echo "# running overlap (double-buffer) ablation at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/overlap.py --out result/overlap_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# overlap rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/decode_tpu.json ]; then
       echo "# running decode bench at $(date +%H:%M:%S)" >&2
       timeout 1800 python benchmarks/decode.py --out result/decode_tpu.json \
@@ -60,10 +79,14 @@ print(float((x@x).sum()))
       echo "# decode bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
     if [ -s result/bench_tpu_done.json ] && [ -s result/flash_tpu.json ] \
+       && [ -s result/flash_tests_tpu.txt ] \
        && [ -s result/collectives_tpu.json ] && [ -s result/lm_tpu.json ] \
-       && [ -s result/memory_tpu.json ] && [ -s result/decode_tpu.json ]; then
+       && [ -s result/memory_tpu.json ] && [ -s result/overlap_tpu.json ] \
+       && [ -s result/decode_tpu.json ]; then
       exit 0
     fi
+  else
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) DOWN" >>"$PROBE_LOG"
   fi
   sleep 90
 done

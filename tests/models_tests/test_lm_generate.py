@@ -111,3 +111,49 @@ def test_sampling_runs_and_validates():
         lm_generate(model, params, prompt, 5, temperature=0.8)
     with pytest.raises(ValueError, match="exceeds max_len"):
         lm_generate(model, params, prompt, 20)
+
+
+def test_ragged_prompts_match_per_row_generation():
+    """Right-padded unequal-length prompts with ``prompt_lengths`` must
+    generate exactly what each row generates alone with its un-padded
+    prompt (greedy) — i.e. no row ever conditions on pad tokens."""
+    T = 32
+    model = _model(T)
+    params = _params(model, T)
+    rng = np.random.RandomState(3)
+    P = 8
+    lengths = [8, 5, 3]
+    rows = [rng.randint(0, 40, size=(L,)).astype(np.int32) for L in lengths]
+    padded = np.zeros((len(rows), P), np.int32)  # pad id 0 = a real token id
+    for i, r in enumerate(rows):
+        padded[i, : len(r)] = r
+    n_new = 6
+
+    got = lm_generate(
+        model, params, jnp.asarray(padded), n_new,
+        prompt_lengths=jnp.asarray(lengths, jnp.int32),
+    )
+    assert got.shape == (len(rows), n_new)
+
+    for i, r in enumerate(rows):
+        solo = lm_generate(model, params, jnp.asarray(r)[None], n_new)
+        np.testing.assert_array_equal(
+            np.asarray(got)[i], np.asarray(solo)[0],
+            err_msg=f"row {i} (len {lengths[i]}) diverged from solo run",
+        )
+
+    # Full-length lengths vector == the equal-length path exactly.
+    eq_prompt = jnp.asarray(rng.randint(0, 40, size=(2, P)).astype(np.int32))
+    a = lm_generate(model, params, eq_prompt, n_new)
+    b = lm_generate(model, params, eq_prompt, n_new,
+                    prompt_lengths=jnp.full((2,), P, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ragged_prompt_lengths_shape_validated():
+    model = _model(16)
+    params = _params(model, 16)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        lm_generate(model, params, prompt, 2,
+                    prompt_lengths=jnp.ones((3,), jnp.int32))

@@ -232,3 +232,73 @@ def test_flash_inside_ulysses(devices):
     out = np.asarray(f(q, k, v))
     ref = np.asarray(_oracle(q, k, v, True))
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+
+def test_flash_fully_masked_rows_zero():
+    """A query row whose segment matches no kv id (e.g. a pad query, or
+    cross-attention against an all-pad source row) must yield EXACT zeros,
+    lse = "no mass", and zero gradients for that row — not a uniform average
+    of V (the finite-NEG_INF rescue failure mode)."""
+    from chainermn_tpu.ops.flash_attention import (
+        NEG_INF, flash_attention_lse, _reference_attention_lse,
+    )
+
+    rng = np.random.RandomState(3)
+    B, T, H, D = 2, 64, 2, 16
+    q, k, v = _qkv(rng, B=B, T=T, H=H, D=D)
+    # Row 0 of the batch: queries in the back half get segment id 7, which
+    # appears nowhere in the kv segments -> those rows are fully masked.
+    seg_q = np.zeros((B, T), np.int32)
+    seg_q[0, T // 2:] = 7
+    seg_kv = np.zeros((B, T), np.int32)
+
+    out, lse = flash_attention_lse(
+        q, k, v, segment_ids=jnp.asarray(seg_q),
+        kv_segment_ids=jnp.asarray(seg_kv), block_q=32, block_k=32,
+    )
+    dead = np.asarray(out)[0, T // 2:]
+    np.testing.assert_array_equal(dead, np.zeros_like(dead))
+    assert np.all(np.asarray(lse)[0, :, T // 2:] <= NEG_INF * 0.5)
+    # Live rows still match the oracle.
+    ref, ref_lse = _reference_attention_lse(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        False, jnp.asarray(seg_q), jnp.asarray(seg_kv),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(lse)[0, :, : T // 2], np.asarray(ref_lse)[0, :, : T // 2],
+        atol=2e-5, rtol=1e-4,
+    )
+
+    # Gradients: dead q rows get zero grad; dK/dV receive nothing from them.
+    probe = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+
+    def loss(qkv, fn):
+        o = fn(
+            *qkv, segment_ids=jnp.asarray(seg_q),
+            kv_segment_ids=jnp.asarray(seg_kv),
+        )
+        o = o[0] if isinstance(o, tuple) else o
+        return jnp.sum(o * probe)
+
+    def flash_fn(q, k, v, **kw):
+        return flash_attention_lse(q, k, v, block_q=32, block_k=32, **kw)
+
+    def oracle_fn(q, k, v, *, segment_ids, kv_segment_ids):
+        return _reference_attention_lse(
+            q, k, v, False, segment_ids, kv_segment_ids
+        )
+
+    g = jax.grad(loss)((jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)),
+                       flash_fn)
+    og = jax.grad(loss)((jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)),
+                        oracle_fn)
+    dq_dead = np.asarray(g[0])[0, T // 2:]
+    np.testing.assert_array_equal(dq_dead, np.zeros_like(dq_dead))
+    for name, a, b in zip("qkv", g, og):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-3,
+            err_msg=f"d{name} mismatch",
+        )
