@@ -63,7 +63,22 @@ class MultiNodeCheckpointer(Extension):
         import orbax.checkpoint as ocp
 
         step = int(trainer.iteration if trainer is not None else state.step)
-        payload = {"train_state": state, "loop": self._loop_state(trainer)}
+        loop = self._loop_state(trainer)
+        inexact = int(loop.get("it_inexact", 0))
+        if inexact > 0:
+            # Warn at SAVE time only — _loop_state also runs during restore
+            # to build the orbax template, where this condition is noise.
+            import warnings
+
+            warnings.warn(
+                "checkpoint saved with prefetch lookahead skew: the "
+                f"iterator cursor is inexact by up to {inexact} samples "
+                "(epoch boundary or shallow cursor in the prefetch "
+                "queue); a restore from this snapshot may replay or skip "
+                "that many samples.",
+                stacklevel=2,
+            )
+        payload = {"train_state": state, "loop": loop}
         self._mngr.save(step, args=ocp.args.StandardSave(payload))
 
     @staticmethod
@@ -93,11 +108,11 @@ class MultiNodeCheckpointer(Extension):
             out["rng_pos"] = np.asarray(st["rng_pos"], np.int64)
             out["rng_has_gauss"] = np.asarray(st["rng_has_gauss"], np.int64)
             out["rng_cached"] = np.asarray(st["rng_cached"], np.float64)
-            if "inexact" in st:
-                # Boundary-degraded cursor (see DevicePrefetchIterator):
-                # recorded so the snapshot itself says it may replay/skip
-                # up to this many samples on restore.
-                out["it_inexact"] = np.asarray(st["inexact"], np.int64)
+            # Degraded-cursor flag (see DevicePrefetchIterator): > 0 means
+            # the snapshot may replay/skip up to this many samples on
+            # restore.  ALWAYS present so the orbax tree structure is
+            # deterministic (StandardRestore templates must match).
+            out["it_inexact"] = np.asarray(st.get("inexact", 0), np.int64)
             return out
         out["it_pos"] = np.asarray(getattr(it, "_pos", 0), np.int64)
         # Exact mid-epoch resume needs the iterator's in-flight permutation
@@ -128,7 +143,23 @@ class MultiNodeCheckpointer(Extension):
             ),
             "loop": self._loop_state(trainer),
         }
-        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+        try:
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        except Exception:
+            if "it_inexact" not in template["loop"]:
+                raise
+            # Snapshot predates the always-present it_inexact leaf: retry
+            # with a matching (key-less) template so old runs stay
+            # resumable.
+            template["loop"] = {
+                k: v for k, v in template["loop"].items()
+                if k != "it_inexact"
+            }
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
         new_state = restored["train_state"]
         # Re-place on the communicator's mesh, honoring each INPUT leaf's
         # sharding (ZeRO states carry 1/N shards — blanket replication would

@@ -189,18 +189,11 @@ class DevicePrefetchIterator:
             # boundary, or the inner cursor sits below the queue depth): the
             # inner submission-side cursor passes through unchanged, so a
             # restore from THIS snapshot replays or skips up to `queued`
-            # samples.  Mark the snapshot so the operator can tell a
-            # boundary-degraded checkpoint from an exact one.
-            import warnings
-
+            # samples.  Flag it so the snapshot records the degradation
+            # (the checkpointer warns at save time; no warning here — this
+            # also runs during restore-template construction).
             state = dict(state)
             state["inexact"] = int(queued)
-            warnings.warn(
-                "DevicePrefetchIterator checkpoint taken with an epoch "
-                f"boundary in the prefetch queue: cursor is inexact by up "
-                f"to {queued} samples (snapshot carries inexact={queued}).",
-                stacklevel=2,
-            )
         return state
 
     def restore_loop_state(self, epoch: int, state: dict) -> None:

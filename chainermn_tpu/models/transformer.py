@@ -82,6 +82,11 @@ class _DecoderBlock(nn.Module):
                     (decode_pos + jnp.arange(T))[None], (B, T)
                 )
             else:
+                if T != 1:
+                    raise ValueError(
+                        "per-row decode_pos requires single-token chunks "
+                        f"(T == 1), got T = {T}"
+                    )
                 kc = cache["k"].at[jnp.arange(B), decode_pos].set(k[:, 0])
                 vc = cache["v"].at[jnp.arange(B), decode_pos].set(v[:, 0])
                 q_pos = decode_pos[:, None]  # (B, 1)
@@ -323,6 +328,16 @@ def lm_generate(
         if lengths.shape != (B,):
             raise ValueError(
                 f"prompt_lengths must be ({B},), got {lengths.shape}"
+            )
+        try:  # concrete values (the usual case): enforce 1 <= length <= P
+            lv = np.asarray(lengths)
+        except Exception:  # traced under jit — contract is documented
+            lv = None
+        if lv is not None and (lv.min() < 1 or lv.max() > P):
+            raise ValueError(
+                f"prompt_lengths must be in [1, {P}], got range "
+                f"[{lv.min()}, {lv.max()}] (length 0 would wrap to the "
+                "last pad position under negative indexing)"
             )
 
     # Batched prefill: ONE (B, P) forward populates the whole prompt's
