@@ -361,6 +361,32 @@ class XlaCommunicator(CommunicatorBase):
 
         return self._rankwise_map(("allgather",), body)(x)
 
+    #: gather/scatter are O(size×)-traffic control-plane facades; payloads
+    #: past this size trigger a loud warning steering users to the real
+    #: data-plane paths (shard_batch / in-graph collectives).
+    _CONTROL_PLANE_WARN_BYTES = 1 << 20
+
+    def _warn_if_tensor_sized(self, x: Any, op: str) -> None:
+        try:
+            nbytes = sum(
+                int(np.prod(np.shape(leaf)))
+                * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+                for leaf in jax.tree_util.tree_leaves(x)
+            )
+        except Exception:
+            return
+        if nbytes > self._CONTROL_PLANE_WARN_BYTES:
+            import warnings
+
+            warnings.warn(
+                f"{op}() moved {nbytes / 2**20:.1f} MiB through an "
+                f"O(size x) broadcast facade (every device receives the "
+                "full payload under SPMD). These exist for control-plane "
+                "data; route tensor-sized data through shard_batch / "
+                "in-graph collectives instead.",
+                stacklevel=3,
+            )
+
     def gather(self, x: Any, root: int = 0) -> Any:
         # SPMD note: every slot receives the stack (root only matters for the
         # object plane); documented deviation from the MPMD reference.
@@ -370,6 +396,7 @@ class XlaCommunicator(CommunicatorBase):
         # the same program.  Fine for the control-plane uses these facades
         # exist for; route tensor-sized data through ``shard_batch`` /
         # in-graph collectives instead.
+        self._warn_if_tensor_sized(x, "gather")
         return self.allgather(x)
 
     def scatter(self, x: Any, root: int = 0) -> Any:
@@ -380,6 +407,7 @@ class XlaCommunicator(CommunicatorBase):
         to every device before each picks its row — O(size×) the per-rank
         payload, the SPMD cost of a root-scatter (see :meth:`gather`).
         Control-plane sized data only."""
+        self._warn_if_tensor_sized(x, "scatter")
         axes = self.axis_name
 
         def body(z):  # z: (1, size, ...)

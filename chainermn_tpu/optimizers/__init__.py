@@ -387,26 +387,43 @@ def create_multi_node_optimizer(
 def optimizer_state_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
     """PartitionSpecs for an optax state, mirroring the params' specs.
 
-    Assumes the state's leaf sequence is param-structure-periodic (each
-    momentum/variance buffer repeats the params' leaf order) — true for
-    sgd/momentum/adamw-style transforms whose per-param buffers dominate;
-    the assert trips for states with stray scalar leaves (wrap those
-    transforms with their own spec handling).
+    Structural matching, not positional periodicity: any subtree of the
+    state that is exactly param-shaped (same tree structure AND same leaf
+    shapes — momentum/variance buffers) gets ``param_specs``; every other
+    leaf (step counters from ``scale_by_schedule``/``scale_by_adam``,
+    EMA scalars, …) replicates (``P()``).  Handles arbitrarily chained/
+    injected transforms without the param-periodic assumption.
     """
     from jax.sharding import PartitionSpec as P
 
-    flat, treedef = jax.tree_util.tree_flatten(opt_state)
-    spec_leaves = jax.tree_util.tree_leaves(
-        param_specs, is_leaf=lambda x: isinstance(x, P)
-    )
-    n = len(jax.tree_util.tree_leaves(params))
-    if not flat:
-        return opt_state
-    assert len(flat) % n == 0, (
-        f"optimizer state has {len(flat)} leaves, not a multiple of the "
-        f"{n} param leaves — build its specs explicitly"
-    )
-    return jax.tree_util.tree_unflatten(treedef, spec_leaves * (len(flat) // n))
+    pdef = jax.tree_util.tree_structure(params)
+    pshapes = [
+        getattr(leaf, "shape", None)
+        for leaf in jax.tree_util.tree_leaves(params)
+    ]
+
+    def param_shaped(sub) -> bool:
+        if jax.tree_util.tree_structure(sub) != pdef:
+            return False
+        return [
+            getattr(leaf, "shape", None)
+            for leaf in jax.tree_util.tree_leaves(sub)
+        ] == pshapes
+
+    def rec(sub):
+        if param_shaped(sub):
+            return param_specs
+        # One-level decomposition: every proper child is treated as a leaf.
+        children, one_level = jax.tree_util.tree_flatten(
+            sub, is_leaf=lambda y: y is not sub
+        )
+        if len(children) == 1 and children[0] is sub:
+            return P()  # a true leaf not shaped like params: replicate
+        return jax.tree_util.tree_unflatten(
+            one_level, [rec(c) for c in children]
+        )
+
+    return rec(opt_state)
 
 
 def model_parallel_grad_reduce(data_comm, model_comm) -> Callable:

@@ -232,3 +232,27 @@ def test_in_graph_psum(devices):
 
     x = comm.shard_rankwise(np.ones((8, 2), np.float32))
     np.testing.assert_allclose(np.asarray(f(x)), np.full((8, 2), 8.0))
+
+
+def test_gather_scatter_warn_on_tensor_sized_payloads(devices):
+    """gather/scatter are O(size x)-traffic control-plane facades: payloads
+    past 1 MiB must warn (steering users to shard_batch / in-graph
+    collectives), small ones must stay silent."""
+    import warnings
+
+    comm = make_comm("xla", devices)
+    small = rankwise(comm, lambda r: np.zeros((4, 4), np.float32))
+    big = rankwise(comm, lambda r: np.zeros((1024, 512), np.float32))  # 16 MiB
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        comm.gather(small)
+        comm.scatter(
+            rankwise(comm, lambda r: np.zeros((8, 4), np.float32)), root=0
+        )
+
+    with pytest.warns(UserWarning, match="control-plane"):
+        comm.gather(big)
+    with pytest.warns(UserWarning, match="control-plane"):
+        comm.scatter(rankwise(comm, lambda r: np.zeros((8, 256, 256),
+                                                       np.float32)), root=0)

@@ -157,3 +157,67 @@ def test_zero_global_norm_clip_matches_oracle(devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=3e-6, rtol=3e-6
         )
+
+
+def test_zero_schedule_bearing_transform(devices):
+    """Transforms with non-param-shaped leaves (scale_by_schedule's scalar
+    count) must work under ZeRO — the state mapping is structural
+    (optax.tree_map_params), not param-periodic."""
+    comm, model, params, loss_fn = _setup(devices)
+    tx = optax.chain(
+        optax.scale_by_adam(),
+        optax.scale_by_schedule(lambda c: -0.05 / (1.0 + 0.1 * c)),
+    )
+    opt = cmn.create_zero_optimizer(tx, comm)
+    state = opt.init(params)
+    step = opt.make_train_step(loss_fn, has_aux=True)
+    batches = _batches(3, 64)
+
+    oparams, oopt = params, tx.init(params)
+    for b in batches:
+        (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(oparams, b)
+        up, oopt = tx.update(grads, oopt, oparams)
+        oparams = optax.apply_updates(oparams, up)
+
+    for b in batches:
+        state, _ = step(state, comm.shard_batch(b))
+        jax.block_until_ready(state)
+
+    got = opt.materialize_params(state)
+    assert jax.tree_util.tree_structure(got) == jax.tree_util.tree_structure(
+        oparams
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(oparams)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        )
+
+
+def test_optimizer_state_specs_structural():
+    """Structural spec matching: param-shaped subtrees mirror param_specs;
+    counters/scalars replicate — no param-periodic assumption."""
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu.optimizers import optimizer_state_specs
+
+    params = {
+        "dense": {"kernel": np.zeros((8, 4)), "bias": np.zeros((4,))},
+    }
+    pspecs = {
+        "dense": {"kernel": P("model", None), "bias": P(None)},
+    }
+    tx = optax.chain(
+        optax.scale_by_adam(),
+        optax.scale_by_schedule(lambda c: 0.1),
+        optax.add_decayed_weights(1e-4),
+    )
+    opt_state = tx.init(jax.tree_util.tree_map(jnp.asarray, params))
+    specs = optimizer_state_specs(opt_state, params, pspecs)
+
+    adam_state = specs[0]
+    assert adam_state.mu == pspecs and adam_state.nu == pspecs
+    assert adam_state.count == P()
+    sched_state = specs[1]
+    assert sched_state.count == P()
