@@ -16,6 +16,8 @@ from chainermn_tpu.comm import (
     create_communicator,
     flat_mesh,
     hybrid_mesh,
+    ragged_permute,
+    ragged_send,
     topology_mesh,
 )
 from chainermn_tpu.distributed import (
@@ -66,6 +68,8 @@ __all__ = [
     "flat_mesh",
     "hybrid_mesh",
     "topology_mesh",
+    "ragged_permute",
+    "ragged_send",
     "comm",
     "functions",
     "links",

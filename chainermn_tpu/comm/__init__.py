@@ -15,6 +15,7 @@ import jax
 from . import mesh as mesh_lib
 from .base import CommunicatorBase
 from .mesh import flat_mesh, hybrid_mesh, topology_mesh, Topology
+from .ragged import ragged_permute, ragged_send, round_up_to_bucket
 from .xla import DummyCommunicator, XlaCommunicator
 
 __all__ = [
@@ -22,6 +23,9 @@ __all__ = [
     "XlaCommunicator",
     "DummyCommunicator",
     "create_communicator",
+    "ragged_permute",
+    "ragged_send",
+    "round_up_to_bucket",
     "flat_mesh",
     "hybrid_mesh",
     "topology_mesh",

@@ -1,0 +1,134 @@
+"""Ragged (variable-length) point-to-point on the array plane.
+
+The reference's eager MPI ``send``/``recv`` (``chainermn/communicators/
+mpi_communicator_base.py`` — pickled ndarray per call) accepted a different
+array length on every call.  XLA's array plane is static-shape: every new
+length would be a fresh compile.  The TPU-native rewrite is PAD-TO-BUCKET —
+lengths round up to a multiple of ``bucket_width``, so the number of
+compiled programs is bounded by the number of buckets actually touched
+(compile keys are the padded shape), while the true lengths ride the same
+permute as an int32 sideband and the receiver unpads exactly.
+
+This is the tensor-sized complement of the object plane (``send_obj`` /
+``recv_obj``): control traffic goes through pickles, bulk arrays through
+here — one fused ppermute per call, ICI-resident under SPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+
+def round_up_to_bucket(n: int, bucket_width: int) -> int:
+    """Smallest positive multiple of ``bucket_width`` >= ``n`` (a length-0
+    row still occupies one bucket — the compiled shape can't be empty)."""
+    if bucket_width < 1:
+        raise ValueError(f"bucket_width must be >= 1, got {bucket_width}")
+    return bucket_width * max(1, -(-n // bucket_width))
+
+
+def _local_rows(comm, out, out_lens) -> List[np.ndarray]:
+    """Unpad a rankwise result back to per-rank variable-length arrays.
+
+    Single-process: one entry per rank.  Multi-process: one entry per THIS
+    process's ranks (rank order) — assembled from addressable shards, never
+    materializing the global array on one host."""
+    if jax.process_count() == 1:
+        data = np.asarray(out)
+        lens = np.asarray(out_lens)
+        return [data[r, : lens[r]] for r in range(comm.size)]
+    by_rank: Dict[int, np.ndarray] = {}
+    len_by_rank: Dict[int, int] = {}
+    for shard in out_lens.addressable_shards:
+        sl = shard.index[0]
+        vals = np.asarray(shard.data)
+        for i, r in enumerate(range(sl.start, sl.stop)):
+            len_by_rank[r] = int(vals[i])
+    for shard in out.addressable_shards:
+        sl = shard.index[0]
+        arr = np.asarray(shard.data)
+        for i, r in enumerate(range(sl.start, sl.stop)):
+            by_rank[r] = arr[i, : len_by_rank[r]]
+    return [by_rank[r] for r in sorted(by_rank)]
+
+
+def ragged_permute(
+    comm,
+    rows: Sequence[np.ndarray],
+    perm: Sequence[Tuple[int, int]],
+    bucket_width: int = 128,
+) -> List[np.ndarray]:
+    """Variable-length rankwise point-to-point: slot ``src`` of ``rows`` is
+    delivered to slot ``dst`` for every ``(src, dst)`` in ``perm``.
+
+    Args:
+      rows: per-rank arrays, ragged in axis 0 (trailing dims and dtype must
+        agree).  Single-process: one per rank.  Multi-process: one per THIS
+        process's ranks, in rank order.  Ranks that send nothing pass a
+        length-0 array of the right trailing shape/dtype.
+      perm: ``[(src_rank, dst_rank), ...]`` — each dst at most once.
+      bucket_width: pad granularity.  All rows share one padded length (the
+        max length rounded up), so a call's compile key is its bucket — a
+        handful of buckets covers any workload, vs one compile per length.
+
+    Returns per-rank RECEIVED arrays, exactly unpadded; ranks with no
+    incoming edge get a length-0 array.  Multi-process: entries for this
+    process's ranks only (rank order).
+    """
+    rows = [np.asarray(r) for r in rows]
+    if not rows:
+        raise ValueError("rows must be non-empty")
+    trailing = rows[0].shape[1:]
+    dtype = rows[0].dtype
+    for i, r in enumerate(rows):
+        if r.ndim < 1:
+            raise ValueError(f"rows[{i}] must have a (ragged) leading axis")
+        if r.shape[1:] != trailing or r.dtype != dtype:
+            raise ValueError(
+                f"rows[{i}] has shape {r.shape} / dtype {r.dtype}; expected "
+                f"trailing {trailing} / {dtype} (only axis 0 may be ragged)"
+            )
+    max_len = max(r.shape[0] for r in rows)
+    if jax.process_count() > 1:
+        # The padded (compiled) shape must agree across processes.
+        max_len = max(comm.allgather_obj(max_len))
+    L = round_up_to_bucket(max_len, bucket_width)
+
+    padded = np.zeros((len(rows), L) + trailing, dtype)
+    for i, r in enumerate(rows):
+        padded[i, : r.shape[0]] = r
+    lengths = np.array([r.shape[0] for r in rows], np.int32)
+
+    # One fused call moves payload + length sideband (the permute body
+    # tree-maps over the tuple, so both ride the same compiled program).
+    out, out_lens = comm.permute(
+        comm.shard_rankwise((padded, lengths)), perm
+    )
+    return _local_rows(comm, out, out_lens)
+
+
+def ragged_send(
+    comm,
+    row: Any,
+    dest: int,
+    source: int,
+    bucket_width: int = 128,
+) -> np.ndarray:
+    """One ragged edge ``source → dest`` (reference analog: one eager
+    ``send``/``recv`` pair).  Every rank calls this (SPMD); ``row`` is
+    read from slot ``source`` and the return value is meaningful on slot
+    ``dest`` (a length-0 array elsewhere).
+
+    Single-process convenience over :func:`ragged_permute`: the caller
+    holds all slots, so ``row`` is just the payload."""
+    row = np.asarray(row)
+    empty = np.zeros((0,) + row.shape[1:], row.dtype)
+    rows = [row if r == source else empty for r in range(comm.size)]
+    received = ragged_permute(
+        comm, rows, [(source, dest)], bucket_width=bucket_width
+    )
+    return received[dest]

@@ -1,0 +1,98 @@
+"""Ragged point-to-point on the array plane (pad-to-bucket).
+
+The one reference capability with no static-shape equivalent until now:
+eager MPI send/recv took a different array length every call
+(``mpi_communicator_base.py``).  These tests pin the bucket contract —
+exact unpadded round-trips, bounded compile keys, empty-edge zeros."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.comm import round_up_to_bucket
+
+
+def make_comm(devices):
+    return cmn.create_communicator("xla", devices=devices)
+
+
+def test_round_up_to_bucket():
+    assert round_up_to_bucket(0, 128) == 128  # empty row still one bucket
+    assert round_up_to_bucket(1, 128) == 128
+    assert round_up_to_bucket(128, 128) == 128
+    assert round_up_to_bucket(129, 128) == 256
+    with pytest.raises(ValueError):
+        round_up_to_bucket(5, 0)
+
+
+def test_ragged_ring_roundtrip(devices):
+    """Ring with a different length per rank: every payload arrives exactly
+    (contents + length), pads stripped."""
+    comm = make_comm(devices)
+    n = comm.size
+    rng = np.random.RandomState(0)
+    rows = [
+        rng.normal(size=(7 + 13 * r, 3)).astype(np.float32) for r in range(n)
+    ]
+    perm = [(r, (r + 1) % n) for r in range(n)]
+    got = cmn.ragged_permute(comm, rows, perm, bucket_width=32)
+    for dst in range(n):
+        src = (dst - 1) % n
+        np.testing.assert_array_equal(got[dst], rows[src])
+
+
+def test_ragged_no_incoming_edge_is_empty(devices):
+    comm = make_comm(devices)
+    n = comm.size
+    rows = [np.full((5,), float(r), np.float32) for r in range(n)]
+    got = cmn.ragged_permute(comm, rows, [(0, 1)], bucket_width=16)
+    np.testing.assert_array_equal(got[1], rows[0])
+    for r in range(n):
+        if r != 1:
+            assert got[r].shape == (0,), r
+
+
+def test_ragged_send_single_edge(devices):
+    comm = make_comm(devices)
+    payload = np.arange(37, dtype=np.int32)
+    got = cmn.ragged_send(comm, payload, dest=3, source=1, bucket_width=16)
+    np.testing.assert_array_equal(got, payload)
+
+
+def test_ragged_dtype_and_trailing_dims_validated(devices):
+    comm = make_comm(devices)
+    n = comm.size
+    rows = [np.zeros((4, 3), np.float32) for _ in range(n)]
+    rows[1] = np.zeros((4, 2), np.float32)
+    with pytest.raises(ValueError, match="trailing"):
+        cmn.ragged_permute(comm, rows, [(0, 1)])
+    rows[1] = np.zeros((4, 3), np.float64)
+    with pytest.raises(ValueError, match="trailing|dtype"):
+        cmn.ragged_permute(comm, rows, [(0, 1)])
+
+
+def test_ragged_bucket_bounds_compiles(devices):
+    """Two calls whose max lengths land in the SAME bucket reuse one
+    compiled program; a new bucket adds exactly one more (the whole point
+    of pad-to-bucket vs compile-per-length)."""
+    comm = make_comm(devices)
+    n = comm.size
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    def rows_of(maxlen):
+        return [
+            np.ones((1 + (maxlen - 1) * (r == 0),), np.float32)
+            for r in range(n)
+        ]
+
+    traces = []
+    fn = comm._fn_cache.get(("permute", tuple(perm)))
+    cmn.ragged_permute(comm, rows_of(10), perm, bucket_width=64)
+    fn = comm._fn_cache[("permute", tuple(perm))]
+    base = fn._cache_size()
+    cmn.ragged_permute(comm, rows_of(60), perm, bucket_width=64)  # same bucket
+    assert fn._cache_size() == base
+    cmn.ragged_permute(comm, rows_of(100), perm, bucket_width=64)  # new bucket
+    assert fn._cache_size() == base + 1
