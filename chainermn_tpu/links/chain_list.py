@@ -17,6 +17,11 @@ SPMD re-design, two tiers:
   device computes each stage, only the owner's result propagates).  Capability
   parity, not a speedup — for distributed speedup use :class:`PipelineChain`.
 
+* :class:`HeteroPipelineChain` — distributed compute for HETEROGENEOUS
+  stages (different functions/widths per rank, the reference's VGG example
+  shape): per-device ``lax.switch`` over a flat activation buffer + GPipe
+  microbatching; device ``s`` executes only stage ``s``.
+
 * :class:`PipelineChain` — the TPU-idiomatic upgrade the reference lacked
   (its chains were sequential; SURVEY.md §2.3 "no microbatch interleaving"):
   homogeneous stacked stages whose parameters are SHARDED over the ``stage``
@@ -31,6 +36,7 @@ from typing import Any, Callable, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from chainermn_tpu.functions.point_to_point import send_recv
@@ -164,3 +170,163 @@ class PipelineChain:
         # Microbatch m leaves the last stage at tick (S - 1 + m).
         valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
         return valid.reshape(B, *valid.shape[2:])
+
+
+class HeteroPipelineChain:
+    """GPipe pipelining for HETEROGENEOUS stages — the distributed-speedup
+    path :class:`MultiNodeChainList` cannot provide under GSPMD (where a
+    per-device branch predicate forces compute replication).
+
+    The SPMD trick: all inter-stage activations live in one flat ``(b, F)``
+    buffer (``F`` = the largest stage-boundary feature count, zero-padded),
+    and each tick runs ``lax.switch(axis_index, branches, buffer)`` — XLA's
+    ``Conditional`` executes ONLY the selected branch at runtime, so device
+    ``s`` computes just stage ``s``: true heterogeneous compute
+    distribution (device ``s`` still *holds* all stages' params — memory is
+    replicated, compute is not; the per-step ravel+pad param stack adds a
+    further ``S x max_stage_size`` live buffer per device, so strongly
+    size-skewed stage splits pay for their largest stage S times — rebalance
+    the split or bucket stages by size if that bites).  Microbatch schedule, output collection
+    (psum mask at the last stage), and the ``ppermute`` shift are exactly
+    :class:`PipelineChain`'s; backward is AD through scan + switch, and
+    non-owner devices contribute zero grads for a stage, so the hybrid
+    DP×MP reducer (:func:`~chainermn_tpu.optimizers.model_parallel_grad_reduce`'s
+    pmean over the stage axis) restores full gradients everywhere.
+
+    Args:
+      comm: communicator whose (single) axis is the stage dimension; its
+        size must equal ``len(stages)``.
+      stages: per-stage ``apply(params, x) -> y`` callables.
+      io_shapes: per-stage ``(in_shape, out_shape)`` tuples WITHOUT the
+        batch dim; consecutive stages must chain
+        (``out_shape[i] == in_shape[i+1]``).
+      n_microbatches: GPipe microbatch count (bubble fraction
+        ``(S-1)/(S-1+M)``).
+
+    Call inside ``shard_map``: ``chain(params_list, x)`` with ``x`` of
+    shape ``(B, *io_shapes[0][0])`` replicated; returns the final stage's
+    output ``(B, *io_shapes[-1][1])`` replicated.
+
+    .. warning:: wrap with ``check_vma=False`` (:meth:`as_spmd_fn` does).
+       The current JAX release mis-routes ``lax.switch`` cotangents under
+       the ``check_vma=True`` transpose when the branch index is
+       device-varying (all closures collapse onto branch 0's operands);
+       with the checker off, switch AD is exact — pinned by
+       ``tests/links_tests/test_hetero_pipeline.py``'s upstream-defect
+       regression test.
+    """
+
+    def __init__(self, comm, stages: Sequence[Callable],
+                 io_shapes: Sequence[Tuple[tuple, tuple]],
+                 n_microbatches: int):
+        if len(stages) != len(io_shapes):
+            raise ValueError(
+                f"{len(stages)} stages but {len(io_shapes)} io_shapes"
+            )
+        for i in range(len(stages) - 1):
+            if tuple(io_shapes[i][1]) != tuple(io_shapes[i + 1][0]):
+                raise ValueError(
+                    f"stage {i} outputs {io_shapes[i][1]} but stage "
+                    f"{i + 1} expects {io_shapes[i + 1][0]}"
+                )
+        self.comm = comm
+        self.stages = list(stages)
+        self.io_shapes = [
+            (tuple(a), tuple(b)) for a, b in io_shapes
+        ]
+        self.n_micro = n_microbatches
+        self._feat = [
+            (int(np.prod(a)) if a else 1, int(np.prod(b)) if b else 1)
+            for a, b in self.io_shapes
+        ]
+        self.buf_features = max(max(f) for f in self._feat)
+
+    def __call__(self, params_list: Sequence[Any], x):
+        comm = self.comm
+        S = comm.size
+        M = self.n_micro
+        if S != len(self.stages):
+            raise ValueError(
+                f"{len(self.stages)} stages on a size-{S} axis (must match)"
+            )
+        idx = comm.axis_index()
+        B = x.shape[0]
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+        b = B // M
+        F = self.buf_features
+        dtype = x.dtype
+        micro = x.reshape(M, b, -1)
+        if micro.shape[-1] < F:
+            micro = jnp.pad(micro, ((0, 0), (0, 0),
+                                    (0, F - micro.shape[-1])))
+
+        # Each device needs only ITS stage's params inside the tick loop.
+        # Feeding all stages' trees as switch operands every tick costs a
+        # full copy of every stage's weights per tick (measured ~3x step
+        # time); instead ravel each stage's tree to a flat vector, pad to
+        # the longest, stack, and let each device select its row ONCE per
+        # step — the switch then carries one vector + the activation buffer.
+        from jax.flatten_util import ravel_pytree
+
+        flat_vecs, unravels = [], []
+        for p in params_list:
+            vec, unravel = ravel_pytree(p)
+            flat_vecs.append(vec)
+            unravels.append(unravel)
+        Lmax = max(max((v.shape[0] for v in flat_vecs), default=0), 1)
+        stacked = jnp.stack([
+            jnp.pad(v, (0, Lmax - v.shape[0])) for v in flat_vecs
+        ])  # (S, Lmax)
+        mine = lax.dynamic_index_in_dim(stacked, idx, axis=0, keepdims=False)
+
+        def apply_stage(s, pv, buf):  # (b, F) -> (b, F)
+            in_feat, _ = self._feat[s]
+            in_shape = self.io_shapes[s][0]
+            inp = buf[:, :in_feat].reshape(b, *in_shape)
+            p = unravels[s](pv[: flat_vecs[s].shape[0]])
+            y = self.stages[s](p, inp)
+            yf = y.reshape(b, -1).astype(dtype)
+            return jnp.pad(yf, ((0, 0), (0, F - yf.shape[1])))
+
+        branches = [
+            (lambda op, s=s: apply_stage(s, op[0], op[1])) for s in range(S)
+        ]
+        fwd_pairs = [(s, s + 1) for s in range(S - 1)]
+
+        def tick(buf, t):
+            t_in = jnp.minimum(t, M - 1)
+            inj = lax.dynamic_index_in_dim(micro, t_in, axis=0,
+                                           keepdims=False)
+            cur = jnp.where(idx == 0, inj, buf)
+            y = lax.switch(idx, branches, (mine, cur))
+            mask = (idx == S - 1).astype(y.dtype)
+            out = lax.psum(y * mask, comm.axis_name)
+            nxt = send_recv(y, comm, fwd_pairs)
+            return nxt, out
+
+        T = S + M - 1
+        from chainermn_tpu.utils import pvary
+
+        # The carry becomes device-varying after the first tick (switch on
+        # axis_index); the initial zeros must carry the same vma type.
+        buf0 = pvary(jnp.zeros((b, F), dtype), comm.axis_name)
+        _, outs = lax.scan(tick, buf0, jnp.arange(T))
+        valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
+        out_feat = self._feat[-1][1]
+        out_shape = self.io_shapes[-1][1]
+        return valid[:, :, :out_feat].reshape(B, *out_shape)
+
+    def as_spmd_fn(self):
+        """``jit(shard_map(...))``-wrapped forward ``(params_list, x) -> y``
+        with replicated in/out specs and ``check_vma=False`` (see the class
+        warning).  For custom losses, wrap :meth:`__call__` in
+        ``comm.spmd(..., check_vma=False)`` yourself."""
+        from jax.sharding import PartitionSpec as P
+
+        f = self.comm.spmd(
+            lambda pl, xx: self(pl, xx),
+            in_specs=(P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(f)

@@ -103,8 +103,9 @@ def init_stage_params(modules: Sequence[nn.Module], rng, x) -> List[Any]:
     for i, m in enumerate(modules):
         key = jax.random.fold_in(rng, i)
         variables = m.init(key, x)
-        params.append(variables["params"])
-        x = m.apply({"params": variables["params"]}, x)
+        p = variables.get("params", {})  # pool-only stages are param-free
+        params.append(p)
+        x = m.apply({"params": p}, x)
     return params
 
 
@@ -132,3 +133,47 @@ def build_chain(modules: Sequence[nn.Module], comm):
             rank_out=s + 1 if s < S - 1 else None,
         )
     return chain
+
+
+def build_hetero_pipeline(
+    modules: Sequence[nn.Module],
+    comm,
+    sample_input,
+    n_microbatches: int = 4,
+):
+    """Port the VGG chain onto :class:`~chainermn_tpu.links.HeteroPipelineChain`
+    — the distributed-speedup path (device ``s`` computes ONLY stage ``s``;
+    :func:`build_chain`'s GSPMD form replicates every stage's compute).
+
+    ``sample_input`` is one example batch row batch ``(1, H, W, C)`` used to
+    derive each stage's activation shapes via ``jax.eval_shape`` (no FLOPs
+    spent).  Wrap with ``check_vma=False`` (see HeteroPipelineChain's
+    warning); ``chain.as_spmd_fn()`` does this for plain forwards.
+    """
+    from chainermn_tpu.links import HeteroPipelineChain
+
+    S = len(modules)
+    if S != comm.size:
+        raise ValueError(
+            f"{S} stages must equal the stage-axis size {comm.size}"
+        )
+    # Trace activation shapes: init_stage_params needs real params, but
+    # shapes only need abstract evaluation against dummy params.
+    io_shapes = []
+    x_spec = jax.eval_shape(lambda x: x, jnp.zeros(np.shape(sample_input),
+                                                   jnp.float32))
+    rng = jax.random.PRNGKey(0)
+    for i, m in enumerate(modules):
+        v_spec = jax.eval_shape(m.init, jax.random.fold_in(rng, i), x_spec)
+        p_spec = v_spec.get("params", {})  # pool-only stages are param-free
+        y_spec = jax.eval_shape(
+            lambda p, x, m=m: m.apply({"params": p}, x),
+            p_spec, x_spec,
+        )
+        io_shapes.append((tuple(x_spec.shape[1:]), tuple(y_spec.shape[1:])))
+        x_spec = y_spec
+    stages = [
+        (lambda mod: lambda p, x: mod.apply({"params": p}, x))(m)
+        for m in modules
+    ]
+    return HeteroPipelineChain(comm, stages, io_shapes, n_microbatches)
