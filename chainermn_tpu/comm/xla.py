@@ -552,12 +552,20 @@ class XlaCommunicator(CommunicatorBase):
     def _hostcomm(self):
         """Native TCP object plane for multi-process point-to-point
         (``chainermn_tpu.hostcomm.HostComm``), bootstrapped from the
-        ``CMN_TPU_HOSTS``/``CMN_TPU_RANK`` env, lazily."""
+        ``CMN_TPU_HOSTS``/``CMN_TPU_RANK`` env, lazily.
+
+        Construction is locked: concurrent first use from several threads
+        (send + receivers racing) would otherwise build SEVERAL peer
+        meshes in one process — the duplicate listeners/dials poison every
+        rank's bootstrap."""
         hc = getattr(self, "_hostcomm_cached", None)
         if hc is None:
-            from chainermn_tpu.hostcomm import HostComm
+            with self._demux_mu:
+                hc = getattr(self, "_hostcomm_cached", None)
+                if hc is None:
+                    from chainermn_tpu.hostcomm import HostComm
 
-            hc = self._hostcomm_cached = HostComm()
+                    hc = self._hostcomm_cached = HostComm()
         return hc
 
     def _self_q(self, source: int, dest: int) -> _queue.SimpleQueue:

@@ -30,7 +30,7 @@ WORKER = os.path.join(
 
 
 def _launch(tmp_path, fault_iter=None, timeout=240, extra_env=None,
-            extra_args=()):
+            extra_args=(), nproc=2):
     env = {
         k: v
         for k, v in os.environ.items()
@@ -48,7 +48,7 @@ def _launch(tmp_path, fault_iter=None, timeout=240, extra_env=None,
     env.update(extra_env or {})
     t0 = time.time()
     res = subprocess.run(
-        [sys.executable, "-m", "chainermn_tpu.launch", "-n", "2",
+        [sys.executable, "-m", "chainermn_tpu.launch", "-n", str(nproc),
          "--grace", "5", *extra_args, WORKER],
         env=env,
         cwd=REPO,
@@ -58,9 +58,18 @@ def _launch(tmp_path, fault_iter=None, timeout=240, extra_env=None,
     return res, time.time() - t0
 
 
-def test_crash_aborts_job_and_restart_resumes(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_crash_aborts_job_and_restart_resumes(tmp_path, nproc):
+    """n=2 and n=4 (VERDICT r2 item 5: chaos beyond the 2-process toy) —
+    the batch scales so every config runs 2 iters/epoch, keeping the
+    checkpoint/resume arithmetic identical."""
+    env = {"CMN_BATCH": str(256 // (2 * nproc))}
     # ---- phase 1: inject a fault on rank 1 at iteration 5 ---------------
-    res, latency = _launch(tmp_path, fault_iter=5, timeout=180)
+    res, latency = _launch(tmp_path, fault_iter=5, timeout=240,
+                           extra_env=env, nproc=nproc)
     log = res.stderr.decode(errors="replace") + res.stdout.decode(
         errors="replace"
     )
@@ -75,18 +84,19 @@ def test_crash_aborts_job_and_restart_resumes(tmp_path):
     assert (tmp_path / "fault").exists(), list(tmp_path.iterdir())
 
     # ---- phase 2: restart; must resume, not start over ------------------
-    res, _ = _launch(tmp_path, fault_iter=None, timeout=240)
+    res, _ = _launch(tmp_path, fault_iter=None, timeout=300, extra_env=env,
+                     nproc=nproc)
     log = res.stderr.decode(errors="replace") + res.stdout.decode(
         errors="replace"
     )
     assert res.returncode == 0, log[-3000:]
-    _check_verdicts(tmp_path, log)
+    _check_verdicts(tmp_path, log, nproc=nproc)
 
 
-def _check_verdicts(tmp_path, log):
-    """Both ranks completed all 4 epochs after resuming at the epoch-2
+def _check_verdicts(tmp_path, log, nproc=2):
+    """All ranks completed all 4 epochs after resuming at the epoch-2
     snapshot (iteration 4)."""
-    for pid in range(2):
+    for pid in range(nproc):
         out = tmp_path / f"verdict_{pid}.json"
         assert out.exists(), f"rank {pid} wrote no verdict:\n{log[-3000:]}"
         v = json.loads(out.read_text())

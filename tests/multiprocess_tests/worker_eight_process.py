@@ -1,0 +1,134 @@
+"""Worker for the 8-process scale + hostcomm stress test (VERDICT r2 item 5).
+
+Beyond the 4-process bookkeeping checks, this tier stresses the object
+plane's demux under the loads it had never seen:
+
+* CONCURRENT PAIRS — every process runs several receiver threads at once,
+  each on a different (source, dest) pair, while senders interleave.  The
+  per-source-process drain-lock design must neither serialize unrelated
+  pairs nor starve a pair parked behind a busy one.
+* MB-SIZED FRAMES — payloads are ~1 MiB numpy arrays (the reference's
+  pickled-ndarray send/recv habit), exercising hostcomm framing well past
+  control-message sizes.
+* PER-PAIR FIFO — each pair's messages carry sequence numbers; receivers
+  assert exact order.
+* RAGGED ARRAY PLANE at nproc=8 — ragged_permute's bucket agreement runs
+  over allgather_obj across all 8 processes.
+"""
+
+import json
+import os
+import sys
+import threading
+import traceback
+
+import numpy as np
+
+
+N = 8
+MSGS_PER_PAIR = 4
+ROWS = 128 * 1024  # x 2 float32 cols = 1 MiB per payload
+
+
+def _payload(src: int, seq: int) -> np.ndarray:
+    base = np.arange(ROWS, dtype=np.float32)
+    return np.stack([base + src, np.full(ROWS, seq, np.float32)], axis=1)
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid}
+    assert jax.process_count() == N, jax.process_count()
+
+    comm = cmn.create_communicator("flat")
+    assert comm.size == N, comm.size
+
+    # --- basic object plane at n=8 --------------------------------------
+    msg = comm.bcast_obj({"tag": "hello"}, root=0)
+    assert msg == {"tag": "hello"}
+    gathered = comm.allgather_obj(("rank", comm.rank))
+    assert gathered == [("rank", r) for r in range(N)], gathered
+
+    # --- concurrent-pair MB-frame stress --------------------------------
+    # Pair plan: for offset j in {1, 2, 3}, rank r sends MSGS_PER_PAIR
+    # 1-MiB frames to rank (r + j) % N and receives from (r - j) % N.
+    # All three receiver threads run CONCURRENTLY while sends interleave.
+    offsets = (1, 2, 3)
+    errors: list = []
+
+    def send_all():
+        try:
+            for seq in range(MSGS_PER_PAIR):
+                for j in offsets:
+                    comm.send_obj(
+                        _payload(comm.rank, seq), dest=(comm.rank + j) % N
+                    )
+        except BaseException:
+            errors.append(traceback.format_exc())
+
+    def recv_from(j):
+        try:
+            src = (comm.rank - j) % N
+            for seq in range(MSGS_PER_PAIR):
+                got = comm.recv_obj(source=src, dest=comm.rank, timeout=120.0)
+                expect = _payload(src, seq)
+                assert got.shape == expect.shape, (got.shape, expect.shape)
+                assert np.array_equal(got, expect), (
+                    f"pair ({src}->{comm.rank}) seq {seq}: payload corrupt "
+                    f"or out of order (got seq {got[0, 1]})"
+                )
+        except BaseException:
+            errors.append(traceback.format_exc())
+
+    threads = [threading.Thread(target=send_all)] + [
+        threading.Thread(target=recv_from, args=(j,)) for j in offsets
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180.0)
+        assert not t.is_alive(), "stress thread hung"
+    assert not errors, errors[0]
+    out["stress_mib_moved"] = MSGS_PER_PAIR * len(offsets) * 1.0
+
+    # --- ragged array plane across 8 processes --------------------------
+    # Each process contributes ITS rank's row (lengths differ per rank);
+    # ring permute; every rank must receive its predecessor's exact row.
+    my_len = 5 + 11 * comm.rank
+    row = np.full((my_len, 2), float(comm.rank), np.float32)
+    got_rows = cmn.ragged_permute(
+        comm, [row], [(r, (r + 1) % N) for r in range(N)], bucket_width=16
+    )
+    assert len(got_rows) == 1, len(got_rows)
+    prev = (comm.rank - 1) % N
+    expect = np.full((5 + 11 * prev, 2), float(prev), np.float32)
+    np.testing.assert_array_equal(got_rows[0], expect)
+
+    # --- eager collective sanity at n=8 ---------------------------------
+    g = comm.tile_rankwise(np.full((2,), float(comm.rank + 1), np.float32))
+    red = np.asarray(comm.allreduce_grad(g).addressable_shards[0].data)
+    np.testing.assert_allclose(red, (N + 1) / 2.0, atol=1e-6)
+
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    result_path = os.path.join(
+        os.environ["CMN_TEST_TMP"],
+        f"verdict_{os.environ['CMN_PROCESS_ID']}.json",
+    )
+    try:
+        verdict = main()
+    except BaseException:
+        verdict = {"status": "fail", "traceback": traceback.format_exc()}
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)

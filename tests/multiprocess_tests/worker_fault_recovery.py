@@ -53,7 +53,8 @@ def main() -> dict:
         "params"
     ]
     opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
-    it = SerialIterator(ds, 64, shuffle=True, seed=2)
+    batch = int(os.environ.get("CMN_BATCH", "64"))
+    it = SerialIterator(ds, batch, shuffle=True, seed=2)
     trainer = Trainer(
         opt, opt.init(params), classification_loss(model), it,
         stop=(4, "epoch"), has_aux=True,
