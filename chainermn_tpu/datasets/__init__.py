@@ -37,6 +37,18 @@ class SubDataset:
             return [self._dataset[int(j)] for j in self._indices[i]]
         return self._dataset[int(self._indices[i])]
 
+    @property
+    def base(self):
+        """The underlying dataset this view selects from (public accessor —
+        array-aware consumers like PrefetchIterator compose index maps
+        through it instead of re-gathering rows one by one)."""
+        return self._dataset
+
+    @property
+    def indices(self) -> np.ndarray:
+        """This view's row indices into :attr:`base`."""
+        return self._indices
+
 
 def scatter_dataset(
     dataset,
@@ -108,6 +120,67 @@ class ArrayDataset:
     @property
     def arrays(self):
         return self._arrays
+
+
+class NpzDataset(ArrayDataset):
+    """File-backed dataset (the reference's on-disk ImageNet role,
+    ``examples/imagenet/train_imagenet.py`` ``PreprocessedDataset`` over image
+    files — here numpy containers, the idiomatic zero-copy format for array
+    data).
+
+    Accepts either
+
+    * a ``.npz`` archive — members are loaded via numpy's lazy ``NpzFile``
+      (each member materializes once, on open; zipped members cannot be
+      memory-mapped), or
+    * a directory of ``.npy`` files — each memory-mapped (``mmap_mode='r'``),
+      so rows are paged from disk on access and the resident set stays at
+      the OS page cache's discretion.  This is the path that exercises real
+      input-pipeline pressure: the prefetch workers fault pages in while the
+      chip runs the current step.
+
+    ``keys`` orders the member arrays into the example tuple (default: the
+    container's sorted key order, with ``x``/``y``-style names first when
+    present).  All members must share their leading dimension.
+    """
+
+    _PREFERRED = ("x", "images", "data", "y", "labels", "targets")
+
+    def __init__(self, path, keys=None, mmap_mode: str = "r"):
+        import os
+
+        self.path = str(path)
+        if os.path.isdir(self.path):
+            found = {
+                fn[:-4]: os.path.join(self.path, fn)
+                for fn in sorted(os.listdir(self.path))
+                if fn.endswith(".npy")
+            }
+            if not found:
+                raise ValueError(f"no .npy files in directory {self.path}")
+            keys = keys or self._order_keys(found)
+            arrays = [np.load(found[k], mmap_mode=mmap_mode) for k in keys]
+        else:
+            with np.load(self.path) as npz:  # members materialize here;
+                # close the zip handle rather than hold it for our lifetime
+                keys = keys or self._order_keys(npz.files)
+                arrays = [npz[k] for k in keys]
+        self.keys = tuple(keys)
+        ns = {len(a) for a in arrays}
+        if len(ns) != 1:
+            raise ValueError(
+                f"members {self.keys} disagree on leading dim: "
+                f"{[len(a) for a in arrays]}"
+            )
+        # Bypass ArrayDataset.__init__'s np.asarray (it would materialize a
+        # memory-mapped member into RAM); np.memmap is already an ndarray.
+        self._arrays = tuple(arrays)
+
+    @classmethod
+    def _order_keys(cls, names):
+        names = sorted(names)
+        pref = [k for k in cls._PREFERRED if k in names]
+        return pref + [k for k in names if k not in pref]
 
 
 def make_synthetic_classification(

@@ -20,7 +20,10 @@ def _next_epoch_indices(it):
     :class:`~chainermn_tpu.iterators.prefetch.PrefetchIterator` (duck-typed on
     ``_pos``/``_order``/``_n``/``batch_size``/``_repeat``/``_new_order``) so
     their epoch semantics cannot drift apart.  Returns ``(indices,
-    completes_epoch)`` or ``None`` when a non-repeating pass is exhausted.
+    completes_epoch, wrapped)`` — ``wrapped`` is how many of the indices
+    came from the NEXT epoch's order (a boundary-spanning batch when
+    ``n % batch_size != 0``) — or ``None`` when a non-repeating pass is
+    exhausted.
 
     Semantics: epoch bookkeeping belongs to the batch that COMPLETES a pass
     (also with ``repeat=False``, so ``(N, 'epoch')``-triggered extensions fire
@@ -37,12 +40,14 @@ def _next_epoch_indices(it):
     idx = it._order[it._pos : it._pos + it.batch_size]
     it._pos += it.batch_size
     completes = it._pos >= n
+    wrapped = 0
     if len(idx) < it.batch_size and it._repeat:
         it._order = it._new_order()
         extra = it._order[: it.batch_size - len(idx)]
         idx = np.concatenate([idx, extra])
         it._pos = len(extra)
-    return np.asarray(idx, np.int64), completes
+        wrapped = len(extra)
+    return np.asarray(idx, np.int64), completes, wrapped
 
 
 class SerialIterator:
@@ -78,7 +83,7 @@ class SerialIterator:
         nxt = _next_epoch_indices(self)
         if nxt is None:
             raise StopIteration
-        idx, completes = nxt
+        idx, completes, _wrapped = nxt
         self.iteration += 1
         if completes:
             self.epoch += 1

@@ -35,6 +35,10 @@ class _Entry(NamedTuple):
     iteration: int
     epoch_detail: float
     n_samples: int
+    # Inner iterator's checkpoint state captured just BEFORE this batch was
+    # pulled: restoring from it replays this batch and everything after it —
+    # the exact resume point while the batch sits unconsumed in the queue.
+    resume: Optional[dict]
 
 
 class DevicePrefetchIterator:
@@ -66,6 +70,7 @@ class DevicePrefetchIterator:
     # ------------------------------------------------------------- pipeline
     def _fill(self) -> None:
         while not self._exhausted and len(self._queue) < self._depth:
+            resume = self._snapshot_inner()
             try:
                 host = next(self._it)
             except StopIteration:
@@ -85,6 +90,7 @@ class DevicePrefetchIterator:
                         getattr(self._it, "epoch_detail", 0.0)
                     ),
                     n_samples=_leading_dim(host),
+                    resume=resume,
                 )
             )
 
@@ -148,28 +154,20 @@ class DevicePrefetchIterator:
         setattr(self.__dict__["_it"], "_pos", value)
 
     # ------------------------------------------------------- checkpointing
-    def checkpoint_loop_state(self) -> Optional[dict]:
-        """Consumption-granular cursor for the multi-node checkpointer.
-
-        The wrapped iterator's own cursor runs up to ``depth`` batches ahead
-        (those batches sit in this queue); when none of the queued batches
-        crosses an epoch boundary the skew is subtracted exactly, so a
-        restore replays precisely the unconsumed batches.  With a boundary
-        in flight the inner state is passed through unchanged (best-effort —
-        same contract as the native ring's in-flight lookahead).
-
-        Works over both iterator protocols: an inner
-        ``checkpoint_loop_state`` (PrefetchIterator) is delegated to; a
-        SerialIterator-shaped inner (``_pos``/``_order``/``_rng``) has the
-        equivalent state synthesized here.  Returns ``None`` (checkpointer
-        falls back to raw attributes) only when the inner is neither."""
+    def _snapshot_inner(self) -> Optional[dict]:
+        """Inner iterator's current checkpoint state.  Works over both
+        protocols: an inner ``checkpoint_loop_state`` (PrefetchIterator) is
+        delegated to; a SerialIterator-shaped inner
+        (``_pos``/``_order``/``_rng``) has the equivalent state synthesized
+        here.  ``None`` when the inner is neither (checkpointer falls back
+        to raw attributes)."""
         inner = getattr(self._it, "checkpoint_loop_state", None)
         if inner is not None:
-            state = inner()
-        elif hasattr(self._it, "_order") and hasattr(self._it, "_rng"):
+            return inner()
+        if hasattr(self._it, "_order") and hasattr(self._it, "_rng"):
             it = self._it
             mt, keys, pos, has_gauss, cached = it._rng.get_state()
-            state = {
+            return {
                 "pos": int(it._pos),
                 "order": np.asarray(it._order, np.int64),
                 "rng_keys": np.asarray(keys, np.uint32),
@@ -177,24 +175,20 @@ class DevicePrefetchIterator:
                 "rng_has_gauss": int(has_gauss),
                 "rng_cached": float(cached),
             }
-        else:
-            return None
-        queued = sum(e.n_samples for e in self._queue)
-        boundary = any(e.is_new_epoch for e in self._queue)
-        if queued and not boundary and state.get("pos", 0) >= queued:
-            state = dict(state)
-            state["pos"] = int(state["pos"]) - queued
-        elif queued:
-            # Exact adjustment impossible (a queued batch crosses an epoch
-            # boundary, or the inner cursor sits below the queue depth): the
-            # inner submission-side cursor passes through unchanged, so a
-            # restore from THIS snapshot replays or skips up to `queued`
-            # samples.  Flag it so the snapshot records the degradation
-            # (the checkpointer warns at save time; no warning here — this
-            # also runs during restore-template construction).
-            state = dict(state)
-            state["inexact"] = int(queued)
-        return state
+        return None
+
+    def checkpoint_loop_state(self) -> Optional[dict]:
+        """Consumption-granular cursor for the multi-node checkpointer.
+
+        EXACT at every tick: each queue entry carries the inner state
+        captured just before that batch was pulled, so the snapshot for the
+        oldest unconsumed batch replays the queue's contents precisely —
+        epoch boundaries in flight included.  (The former pos-arithmetic
+        adjustment degraded to a flagged best-effort cursor whenever a
+        queued batch crossed an epoch boundary.)"""
+        if self._queue:
+            return self._queue[0].resume
+        return self._snapshot_inner()
 
     def restore_loop_state(self, epoch: int, state: dict) -> None:
         self._queue.clear()

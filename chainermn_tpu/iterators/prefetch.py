@@ -40,8 +40,32 @@ class PrefetchIterator:
         n_workers: int = 4,
         copy: bool = True,
     ):
-        arrays = tuple(np.ascontiguousarray(a) for a in dataset.arrays)
+        # A scatter_dataset SubDataset view composes for free: gather from
+        # the BASE arrays through the view's index map, so the native
+        # workers page rows (mmap'd file-backed data included) off the
+        # consumer thread instead of materializing the shard up front.
+        translate = None
+        src = dataset
+        if not hasattr(src, "arrays"):
+            inner = getattr(src, "base", None)
+            if inner is not None and hasattr(inner, "arrays") and hasattr(
+                src, "indices"
+            ):
+                translate = np.ascontiguousarray(
+                    np.asarray(src.indices, np.int64)
+                )
+                src = inner
+            else:
+                raise TypeError(
+                    "PrefetchIterator needs an array-backed dataset "
+                    "(`.arrays`) or a SubDataset view of one; got "
+                    f"{type(dataset).__name__}"
+                )
+        # No-copy for already-contiguous arrays (incl. np.memmap — the
+        # file stays the backing store).
+        arrays = tuple(np.ascontiguousarray(a) for a in src.arrays)
         self._arrays = arrays  # keep alive: native loader reads these bases
+        self._translate = translate
         self.dataset = dataset
         self.batch_size = batch_size
         self._repeat = repeat
@@ -49,7 +73,7 @@ class PrefetchIterator:
         self._rng = np.random.RandomState(seed)
         self._depth = depth
         self._copy = copy
-        self._n = len(arrays[0])
+        self._n = len(dataset)
 
         lib = _native.load_dataloader()
         self._lib = lib
@@ -87,6 +111,11 @@ class PrefetchIterator:
         self.iteration = 0
         self.is_new_epoch = False
         self._consumed = 0  # samples consumed this epoch (not submitted)
+        # Per-epoch (order, rng before/after its draw) in draw order; front =
+        # the epoch currently being CONSUMED.  Lets the checkpoint cursor
+        # stay exact even when the submission side has already drawn later
+        # epochs' permutations (lookahead ring).
+        self._epoch_log = []
         self._order = self._new_order()
         self._pos = 0
         # Per submitted batch: (epoch_completing, short_tail_indices_or_None).
@@ -96,14 +125,21 @@ class PrefetchIterator:
                 self._submit_next()
 
     def _new_order(self):
-        return (
+        rng_before = self._rng.get_state()
+        order = (
             self._rng.permutation(self._n)
             if self._shuffle
             else np.arange(self._n)
         )
+        self._epoch_log.append({
+            "order": np.asarray(order, np.int64),
+            "rng_before": rng_before,
+            "rng_after": self._rng.get_state(),
+        })
+        return order
 
-    def _next_indices(self) -> Optional[Tuple[np.ndarray, bool]]:
-        """Next batch's row indices + whether it completes an epoch — the
+    def _next_indices(self):
+        """Next batch's ``(row indices, completes_epoch, wrapped)`` — the
         exact semantics shared with SerialIterator (one implementation, so
         the two iterators cannot drift)."""
         from chainermn_tpu.iterators import _next_epoch_indices
@@ -114,17 +150,19 @@ class PrefetchIterator:
         nxt = self._next_indices()
         if nxt is None:
             return False
-        idx, completes = nxt
+        idx, completes, wrapped = nxt
+        if self._translate is not None:  # shard position → base row
+            idx = np.ascontiguousarray(self._translate[idx])
         if len(idx) < self.batch_size:
             # repeat=False short tail: the native ring is fixed-batch, so
             # assemble this one in Python at consume time.
-            self._pending.append((completes, idx))
+            self._pending.append((completes, idx, wrapped))
             return True
         buf = idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
         seq = self._lib.loader_submit(self._h, buf, len(idx))
         if seq < 0:
             raise RuntimeError(f"loader_submit failed (rc={seq})")
-        self._pending.append((completes, None))
+        self._pending.append((completes, None, wrapped))
         return True
 
     # ------------------------------------------------------------ iteration
@@ -144,9 +182,9 @@ class PrefetchIterator:
         if self._held_slot is not None:
             self._lib.loader_release(self._h, self._held_slot)
             self._held_slot = None
-        completes, tail_idx = self._pending.pop(0)
+        completes, tail_idx, wrapped = self._pending.pop(0)
         if tail_idx is not None:  # Python-assembled short tail (repeat=False)
-            self._finish_tick(completes, len(tail_idx))
+            self._finish_tick(completes, len(tail_idx), wrapped)
             return tuple(a[tail_idx] for a in self._arrays)
         slot = self._lib.loader_next(self._h, -1)
         if slot < 0:
@@ -164,7 +202,7 @@ class PrefetchIterator:
             self._lib.loader_release(self._h, slot)
         else:
             self._held_slot = slot
-        self._finish_tick(completes, self.batch_size)
+        self._finish_tick(completes, self.batch_size, wrapped)
         self._submit_next()  # keep the ring full
         return tuple(out)
 
@@ -172,17 +210,25 @@ class PrefetchIterator:
         nxt = self._next_indices()
         if nxt is None:
             raise StopIteration
-        idx, completes = nxt
-        self._finish_tick(completes, len(idx))
+        idx, completes, wrapped = nxt
+        if self._translate is not None:  # shard position → base row
+            idx = self._translate[idx]
+        self._finish_tick(completes, len(idx), wrapped)
         return tuple(a[idx] for a in self._arrays)
 
-    def _finish_tick(self, completes: bool, n_samples: int):
+    def _finish_tick(self, completes: bool, n_samples: int, wrapped: int = 0):
         self.iteration += 1
         self._consumed += n_samples
         if completes:
             self.epoch += 1
             self.is_new_epoch = True
-            self._consumed = 0
+            # A boundary-spanning batch (n % batch_size != 0, repeat=True)
+            # already consumed `wrapped` samples of the NEXT epoch — the
+            # cursor must carry them or a mid-epoch checkpoint in the new
+            # epoch is silently offset by that many samples.
+            self._consumed = int(wrapped)
+            if self._epoch_log:  # consumed epoch done; front = next epoch
+                self._epoch_log.pop(0)
         else:
             self.is_new_epoch = False
 
@@ -193,17 +239,35 @@ class PrefetchIterator:
         The submission cursor (``_pos``) runs ``depth`` batches ahead of
         consumption in native mode, so the raw attributes must never be
         saved/restored directly (stale in-flight batches + a skewed cursor).
-        ``pos`` here is SAMPLES CONSUMED this epoch; exact when checkpoints
-        fire at epoch boundaries (all examples' ``(1, 'epoch')`` trigger —
-        ``pos == 0``, a fresh permutation is drawn on restore) and
-        best-effort mid-epoch (the epoch's remaining order is preserved,
-        in-flight lookahead is discarded)."""
-        mt, keys, pos, has_gauss, cached = self._rng.get_state()
+        ``pos`` here is SAMPLES CONSUMED this epoch.  EXACT everywhere: the
+        per-epoch draw log reconstructs the consumption epoch's permutation
+        and the RNG state as of just after (mid-epoch) or just before
+        (boundary — restore's fresh draw then reproduces the very same
+        upcoming permutation) its draw, no matter how far the lookahead has
+        run ahead."""
+        ent = self._epoch_log[0] if self._epoch_log else None
+        if int(self._consumed) > 0 and ent is not None:
+            # Mid-epoch: this epoch's order + the RNG just after its draw,
+            # so post-restore wraps continue the original draw sequence.
+            rng_state = ent["rng_after"]
+            order = ent["order"]
+            pos = int(self._consumed)
+        else:
+            # Epoch boundary: restore draws fresh from this state, which is
+            # the state the upcoming epoch's order was (or will be) drawn
+            # from — the draw reproduces it exactly.
+            if ent is not None and ent["rng_before"] is not None:
+                rng_state = ent["rng_before"]
+            else:
+                rng_state = self._rng.get_state()
+            order = self._order
+            pos = 0
+        mt, keys, rpos, has_gauss, cached = rng_state
         return {
-            "pos": int(self._consumed),
-            "order": np.asarray(self._order, np.int64),
+            "pos": pos,
+            "order": np.asarray(order, np.int64),
             "rng_keys": np.asarray(keys, np.uint32),
-            "rng_pos": int(pos),
+            "rng_pos": int(rpos),
             "rng_has_gauss": int(has_gauss),
             "rng_cached": float(cached),
         }
@@ -232,11 +296,20 @@ class PrefetchIterator:
         ))
         self._consumed = int(state["pos"])
         self._pos = int(state["pos"])
-        self._order = (
-            np.asarray(state["order"]).astype(np.int64)
-            if int(state["pos"]) > 0
-            else self._new_order()  # epoch boundary: fresh permutation
-        )
+        self._epoch_log = []
+        if int(state["pos"]) > 0:
+            self._order = np.asarray(state["order"]).astype(np.int64)
+            # Seed the draw log: RNG is this epoch's post-draw state, so
+            # later wraps continue the original permutation sequence.
+            self._epoch_log.append({
+                "order": self._order,
+                "rng_before": None,
+                "rng_after": self._rng.get_state(),
+            })
+        else:
+            # Epoch boundary: fresh draw (reproduces the upcoming epoch's
+            # permutation — the saved RNG state predates its draw).
+            self._order = self._new_order()
         self._pending = []
         if self._h:
             for _ in range(self._depth):

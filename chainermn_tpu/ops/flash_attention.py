@@ -99,8 +99,11 @@ def _reference_attention_lse(q, k, v, causal: bool = False,
 # --------------------------------------------------------------------- fwd
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 block_k, causal, segmented, scale):
-    # q_ref: (1, BQ, D); k/v_ref: (1, T, D); o_ref: (1, BQ, D); lse: (1, BQ)
-    # segmented: extra (1, BQ) q-segment + (1, T) k-segment int32 refs.
+    # q_ref: (1, BQ, D); k/v_ref: (1, T, D); o_ref: (1, BQ, D).
+    # Per-row refs (lse, segments) carry a trailing singleton lane dim —
+    # (1, BQ, 1) / (1, T, 1) — because Mosaic requires each block's last two
+    # dims to be (divisible by 8, divisible by 128) or equal to the array's;
+    # a (1, BQ) block over a (BH, T) array violates the sublane rule.
     if segmented:
         segq_ref, segk_ref, o_ref, lse_ref = rest
     else:
@@ -110,7 +113,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     T = k_ref.shape[1]
     D = q_ref.shape[2]
     q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
-    seg_q = segq_ref[0] if segmented else None  # (BQ,)
+    seg_q = segq_ref[0, :, 0] if segmented else None  # (BQ,)
 
     n_k = T // block_k
     if causal:
@@ -137,7 +140,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if segmented:
-            seg_k = segk_ref[0, pl.ds(ki * block_k, block_k)]
+            seg_k = segk_ref[0, pl.ds(ki * block_k, block_k), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
@@ -164,7 +167,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     o_ref[0] = jnp.where(
         alive[:, None], acc / l_safe[:, None], 0.0
     ).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(alive, m + jnp.log(l_safe), NEG_INF)
+    lse_ref[0] = jnp.where(alive, m + jnp.log(l_safe), NEG_INF)[:, None]
 
 
 
@@ -199,11 +202,12 @@ def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q, block_k,
     if segmented:
         # Segments stay (B, T)/(B, S) — every head of batch row b // heads
         # shares them (no H-fold copy): q-block view + full-row kv view.
+        # Trailing singleton lane dim for Mosaic's block tiling rule.
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda b, i: (b // heads, i)),
-            pl.BlockSpec((1, S), lambda b, i: (b // heads, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b // heads, i, 0)),
+            pl.BlockSpec((1, S, 1), lambda b, i: (b // heads, 0, 0)),
         ]
-        args += [seg_q, seg_kv]
+        args += [seg_q[..., None], seg_kv[..., None]]
     # Outputs vary as the union of ALL inputs — including the segment
     # arrays (a device-varying packing mask alone makes outputs vary).
     vma = _vma_union(q, k, v, *(args[3:] if segmented else []))
@@ -213,15 +217,15 @@ def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q, block_k,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32, vma=vma),
         ],
         interpret=interpret,
     )(*args)
-    return o, lse
+    return o, lse[..., 0]
 
 
 # --------------------------------------------------------------------- bwd
@@ -229,7 +233,8 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     block_q, causal, segmented, scale,
 ):
-    # k/v_ref, dk/dv_ref: (1, BK, D); q/do_ref: (1, T, D); lse/delta: (1, T)
+    # k/v_ref, dk/dv_ref: (1, BK, D); q/do_ref: (1, T, D); per-row refs
+    # (lse/delta/segments) carry the trailing singleton lane dim (1, T, 1).
     if segmented:
         segq_ref, segk_ref, dk_ref, dv_ref = rest
     else:
@@ -240,7 +245,7 @@ def _bwd_dkv_kernel(
     D = k_ref.shape[2]
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
-    seg_k = segk_ref[0] if segmented else None  # (BK,)
+    seg_k = segk_ref[0, :, 0] if segmented else None  # (BK,)
 
     n_q = T // block_q
     if causal:
@@ -253,8 +258,8 @@ def _bwd_dkv_kernel(
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -268,7 +273,7 @@ def _bwd_dkv_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if segmented:
-            seg_q = segq_ref[0, pl.ds(qi * block_q, block_q)]
+            seg_q = segq_ref[0, pl.ds(qi * block_q, block_q), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         # Exact softmax via saved LSE.  Rows with lse == NEG_INF carried no
         # mass in the forward (fully masked); s - lse would cancel the
@@ -313,9 +318,9 @@ def _bwd_dq_kernel(
     D = q_ref.shape[2]
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
-    seg_q = segq_ref[0] if segmented else None  # (BQ,)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    seg_q = segq_ref[0, :, 0] if segmented else None  # (BQ,)
 
     n_k = T // block_k
     if causal:
@@ -340,7 +345,7 @@ def _bwd_dq_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if segmented:
-            seg_k = segk_ref[0, pl.ds(ki * block_k, block_k)]
+            seg_k = segk_ref[0, pl.ds(ki * block_k, block_k), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
         # Same fully-masked-row guard as the dK/dV kernel.
         p = jnp.where(
@@ -384,17 +389,18 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
         pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # k
         pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # v
         pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # do
-        pl.BlockSpec((1, T), lambda b, i: (b, 0)),             # lse
-        pl.BlockSpec((1, T), lambda b, i: (b, 0)),             # delta
+        pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),       # lse
+        pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),       # delta
     ]
-    args = [q, k, v, do, lse, delta]
+    args = [q, k, v, do, lse[..., None], delta[..., None]]
     if segmented:
         in_specs += [
-            pl.BlockSpec((1, T), lambda b, i: (b // heads, 0)),  # seg (q rows)
-            pl.BlockSpec((1, block_k),
-                         lambda b, i: (b // heads, i)),          # seg (k blk)
+            pl.BlockSpec((1, T, 1),
+                         lambda b, i: (b // heads, 0, 0)),       # seg (q rows)
+            pl.BlockSpec((1, block_k, 1),
+                         lambda b, i: (b // heads, i, 0)),       # seg (k blk)
         ]
-        args += [seg_q, seg_kv]
+        args += [seg_q[..., None], seg_kv[..., None]]
     vma = _vma_union(q, k, v, do, lse, delta,
                      *([seg_q, seg_kv] if segmented else []))
     dk, dv = pl.pallas_call(
@@ -421,17 +427,18 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
         pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),        # k
         pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),        # v
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do
-        pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # lse
-        pl.BlockSpec((1, block_q), lambda b, i: (b, i)),        # delta
+        pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # lse
+        pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # delta
     ]
-    args = [q, k, v, do, lse, delta]
+    args = [q, k, v, do, lse[..., None], delta[..., None]]
     if segmented:
         in_specs += [
-            pl.BlockSpec((1, block_q),
-                         lambda b, i: (b // heads, i)),          # seg (q blk)
-            pl.BlockSpec((1, S), lambda b, i: (b // heads, 0)),  # seg (k rows)
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, i: (b // heads, i, 0)),       # seg (q blk)
+            pl.BlockSpec((1, S, 1),
+                         lambda b, i: (b // heads, 0, 0)),       # seg (k rows)
         ]
-        args += [seg_q, seg_kv]
+        args += [seg_q[..., None], seg_kv[..., None]]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, T // block_q),

@@ -30,6 +30,11 @@ def main():
     p.add_argument("--double-buffering", action="store_true")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
+    p.add_argument("--train-npz", default=None,
+                   help="file-backed training data: a .npz archive or a "
+                        "directory of memory-mapped .npy files (members: "
+                        "images NHWC float + integer labels); sharded "
+                        "across host processes via scatter_dataset")
     p.add_argument("--augment", action="store_true",
                    help="device-side random crop+flip inside the jitted step")
     p.add_argument("--smoke", action="store_true",
@@ -76,26 +81,43 @@ def main():
     state = opt.init(variables["params"], model_state=variables["batch_stats"])
     loss_fn = resnet_loss(model)
 
-    # Synthetic epoch-resident image pool fed through the NATIVE prefetch
-    # loader (the reference example's MultiprocessIterator role): C++ worker
-    # threads assemble the next batches into a ring of reusable buffers
-    # while the chip runs the current step.
-    from chainermn_tpu.datasets import ArrayDataset
+    from chainermn_tpu.datasets import ArrayDataset, NpzDataset
     from chainermn_tpu.iterators import PrefetchIterator
 
-    pool = args.iters_per_epoch * args.batchsize
-    # Generate directly in float32 (rng.uniform would materialize a float64
-    # intermediate — 2x the pool, ~15 GB at default args).
-    rng = np.random.default_rng(0)
-    xs = rng.random(
-        (pool, args.image_size, args.image_size, 3), dtype=np.float32
-    )
-    ys = (xs.mean(axis=(1, 2, 3)) * args.num_classes).astype(np.int32).clip(
-        0, args.num_classes - 1
-    )
-    it = PrefetchIterator(
-        ArrayDataset(xs, ys), args.batchsize, shuffle=True, seed=0
-    )
+    if args.train_npz:
+        # File-backed path: on-disk numpy data (mmap'd when a .npy dir),
+        # sharded across host processes exactly as the reference's
+        # scatter_dataset split the corpus across MPI ranks; the per-chip
+        # split happens at batch time (shard_batch), the two-level path.
+        ds = cmn.scatter_dataset(
+            NpzDataset(args.train_npz), comm, shuffle=True, seed=0
+        )
+        nproc = max(jax.process_count(), 1)
+        if args.batchsize % nproc:
+            raise SystemExit(
+                f"--batchsize {args.batchsize} must be divisible by the "
+                f"process count ({nproc}): a truncated per-host batch would "
+                "silently change the effective global batch"
+            )
+        local_bs = args.batchsize // nproc
+    else:
+        # Synthetic epoch-resident image pool fed through the NATIVE
+        # prefetch loader (the reference example's MultiprocessIterator
+        # role): C++ worker threads assemble the next batches into a ring
+        # of reusable buffers while the chip runs the current step.
+        pool = args.iters_per_epoch * args.batchsize
+        # Generate directly in float32 (rng.uniform would materialize a
+        # float64 intermediate — 2x the pool, ~15 GB at default args).
+        rng = np.random.default_rng(0)
+        xs = rng.random(
+            (pool, args.image_size, args.image_size, 3), dtype=np.float32
+        )
+        ys = (xs.mean(axis=(1, 2, 3)) * args.num_classes).astype(
+            np.int32
+        ).clip(0, args.num_classes - 1)
+        ds = ArrayDataset(xs, ys)
+        local_bs = args.batchsize
+    it = PrefetchIterator(ds, local_bs, shuffle=True, seed=0)
     # Second pipeline stage: keep the next batches resident ON DEVICE so the
     # host→device transfer overlaps the previous step's compute (the
     # reference's pinned-buffer staging role, done with async dispatch).

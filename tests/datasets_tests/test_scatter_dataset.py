@@ -129,6 +129,49 @@ def test_evaluator_partial_batch_exact(devices):
     np.testing.assert_allclose(m["val/accuracy"], oracle_acc, rtol=1e-6)
 
 
+def test_npz_dataset_archive_and_npy_dir(tmp_path):
+    """NpzDataset: .npz archive + memory-mapped .npy directory forms agree,
+    key ordering puts x/y-style names first, and the mmap'd form feeds the
+    native PrefetchIterator through a SubDataset view without materializing
+    the base arrays."""
+    from chainermn_tpu.datasets import NpzDataset, SubDataset
+    from chainermn_tpu.iterators import PrefetchIterator
+
+    x = np.arange(60, dtype=np.float32).reshape(20, 3)
+    y = np.arange(20, dtype=np.int32)
+    np.savez(tmp_path / "d.npz", y=y, x=x)  # insertion order ≠ key order
+    d = tmp_path / "npy"
+    d.mkdir()
+    np.save(d / "x.npy", x)
+    np.save(d / "y.npy", y)
+
+    a = NpzDataset(tmp_path / "d.npz")
+    b = NpzDataset(d)
+    assert a.keys == b.keys == ("x", "y")
+    assert isinstance(b.arrays[0], np.memmap)
+    assert len(a) == len(b) == 20
+    for i in (0, 7, 19):
+        np.testing.assert_array_equal(a[i][0], b[i][0])
+        assert int(a[i][1]) == int(b[i][1]) == i
+
+    # SubDataset view of the mmap'd form through the prefetch iterator:
+    # every yielded row must be the base row its composed index names.
+    view = SubDataset(b, np.asarray([3, 1, 17, 9, 12, 5, 8, 2]))
+    it = PrefetchIterator(view, 4, shuffle=True, seed=0, repeat=False)
+    seen = []
+    for bx, by in it:
+        np.testing.assert_array_equal(bx, x[by])
+        seen.extend(int(v) for v in by)
+    assert sorted(seen) == [1, 2, 3, 5, 8, 9, 12, 17]
+    it.close()
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        np.save(d / "bad.npy", np.zeros((3, 2), np.float32))
+        NpzDataset(d)  # leading-dim mismatch
+
+
 def test_trainer_epoch_count(devices):
     """stop=(2,'epoch') runs ceil(2n/bs) iterations: the epoch-boundary batch
     wraps into the NEXT epoch's fresh order (no sample duplicated within a

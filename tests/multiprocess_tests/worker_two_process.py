@@ -288,6 +288,84 @@ def main() -> dict:
         np.testing.assert_allclose(local, pxs[2 * i : 2 * i + 2], atol=0)
     out["device_prefetch"] = "ok"
 
+    # --- file-backed data path (VERDICT r2 item 7) -----------------------
+    # Real on-disk data through the two-level path: process 0 writes a .npy
+    # directory (memory-mapped on load), both processes scatter_dataset it,
+    # iterate a full epoch through the prefetch iterator, and the union of
+    # consumed sample ids must cover the corpus exactly once.  Then a
+    # mid-epoch checkpoint of the file-backed iterator is restored into a
+    # FRESH iterator and the resumed stream must continue sample-exact.
+    from chainermn_tpu.datasets import NpzDataset
+    from chainermn_tpu.iterators import PrefetchIterator
+
+    data_dir = os.path.join(os.environ["CMN_TEST_TMP"], "npydata")
+    n_corpus = 40
+    if pid == 0:
+        os.makedirs(data_dir + ".tmp", exist_ok=True)
+        fx = np.arange(n_corpus, dtype=np.float32)[:, None] * np.ones(
+            (1, 5), np.float32
+        )
+        fy = np.arange(n_corpus, dtype=np.int32)  # y IS the sample id
+        np.save(os.path.join(data_dir + ".tmp", "x.npy"), fx)
+        np.save(os.path.join(data_dir + ".tmp", "y.npy"), fy)
+        os.rename(data_dir + ".tmp", data_dir)  # atomic publish
+    comm.bcast_obj("npy_ready", root=0)
+
+    fds = NpzDataset(data_dir)
+    assert fds.keys == ("x", "y"), fds.keys
+    assert isinstance(fds.arrays[0], np.memmap), type(fds.arrays[0])
+    fshard = cmn.scatter_dataset(fds, comm, shuffle=True, seed=13)
+    assert len(fshard) == n_corpus // 2
+
+    fit = PrefetchIterator(fshard, 4, shuffle=True, seed=7)
+    seen = []
+    for _ in range(len(fshard) // 4):  # one full epoch
+        bx, by = next(fit)
+        np.testing.assert_allclose(bx[:, 0], by.astype(np.float32))
+        seen.extend(int(i) for i in by)
+    both = comm.allgather_obj(seen)
+    assert sorted(both[0] + both[1]) == list(range(n_corpus)), both
+    fit.close()
+
+    # Mid-epoch resume of the file-backed iterator through the checkpointer.
+    fit1 = PrefetchIterator(fshard, 4, shuffle=True, seed=99)
+    first2 = [next(fit1) for _ in range(2)]  # consume 2 of 5 batches
+
+    class _FT:
+        iteration = 2
+        state = None
+        train_iter = fit1
+        extensions = ()
+
+    fdir = os.path.join(os.environ["CMN_TEST_TMP"], "ck_filebacked")
+    fcp = create_multi_node_checkpointer("filebacked", comm, path=fdir)
+    fstate = {"step": comm.replicate(np.int64(2))}
+    fcp.save(fstate, _FT())
+    fcp.finalize()
+    rest_of_epoch = [next(fit1) for _ in range(3)]  # ground truth: batches 3-5
+    fit1.close()
+
+    fit2 = PrefetchIterator(fshard, 4, shuffle=True, seed=5)  # wrong seed on
+    # purpose: restore must overwrite the in-flight permutation + RNG state
+
+    class _FT2:
+        iteration = 0
+        state = None
+        train_iter = fit2
+        extensions = ()
+
+    fcp2 = create_multi_node_checkpointer("filebacked", comm, path=fdir)
+    _, it_no = fcp2.maybe_load(fstate, _FT2())
+    assert it_no == 2, it_no
+    resumed = [next(fit2) for _ in range(3)]
+    for (ax, ay), (bx, by) in zip(rest_of_epoch, resumed):
+        np.testing.assert_allclose(np.asarray(ax), np.asarray(bx))
+        np.testing.assert_array_equal(np.asarray(ay), np.asarray(by))
+    fit2.close()
+    fcp.close()
+    fcp2.close()
+    out["file_backed_data"] = "ok"
+
     comm.barrier()
     cmn.shutdown_distributed()
     out["status"] = "ok"
