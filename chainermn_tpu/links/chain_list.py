@@ -15,7 +15,8 @@ SPMD re-design, two tiers:
   sequence away.  Note on cost: GSPMD cannot skip a branch whose predicate
   varies per device, so heterogeneous stages are *compute-replicated* (every
   device computes each stage, only the owner's result propagates).  Capability
-  parity, not a speedup — for distributed speedup use :class:`PipelineChain`.
+  parity, not a speedup — linear chains lower to the distributed tier with
+  one call (:meth:`MultiNodeChainList.to_pipeline`).
 
 * :class:`HeteroPipelineChain` — distributed compute for HETEROGENEOUS
   stages (different functions/widths per rank, the reference's VGG example
@@ -115,6 +116,56 @@ class MultiNodeChainList:
                 # terminal send (to the output consumer)
                 h = send_recv(h, self.comm, [(link.rank, link.rank_out)])
         return h
+
+    def to_pipeline(self, io_shapes, n_microbatches: int):
+        """Lower a LINEAR chain onto :class:`HeteroPipelineChain` — the
+        distributed-speedup path (device ``s`` computes only stage ``s``)
+        for the reference-shaped ``add_link`` API.
+
+        Linear means: link ``i`` is owned by rank ``i`` and every edge goes
+        ``i-1 → i`` (explicitly declared or implied), with no terminal
+        send — exactly the shape of the reference's model-parallel examples
+        (MNIST 2-rank split, VGG stacks).  Anything else (fan-in/fan-out,
+        rank reuse, skips) stays on :class:`MultiNodeChainList`'s
+        compute-replicated walk, which handles arbitrary graphs.
+
+        ``io_shapes``/``n_microbatches`` are :class:`HeteroPipelineChain`'s:
+        per-stage (in, out) shapes without the batch dim, and the GPipe
+        microbatch count.  Returns the new chain; oracle-equivalence with
+        the replicated walk is pinned by
+        ``tests/links_tests/test_hetero_pipeline.py``.
+        """
+        S = len(self._links)
+        if self.comm.size != S:
+            raise ValueError(
+                f"{S} links on a size-{self.comm.size} axis: the pipeline "
+                "lowering needs exactly one stage per device"
+            )
+        for i, ln in enumerate(self._links):
+            if ln.rank != i:
+                raise ValueError(
+                    f"link {i} owned by rank {ln.rank}: pipeline lowering "
+                    "needs the identity placement (link i on rank i)"
+                )
+            if ln.rank_in not in (None, i - 1) or (
+                i == 0 and ln.rank_in is not None
+            ):
+                raise ValueError(
+                    f"link {i} has rank_in={ln.rank_in}: not a linear chain"
+                )
+            if ln.rank_out not in (None, i + 1) or (
+                i == S - 1 and ln.rank_out is not None
+            ):
+                raise ValueError(
+                    f"link {i} has rank_out={ln.rank_out}: not a linear "
+                    "chain (terminal sends have no pipeline equivalent)"
+                )
+        return HeteroPipelineChain(
+            self.comm,
+            [ln.apply for ln in self._links],
+            io_shapes,
+            n_microbatches,
+        )
 
 
 class PipelineChain:

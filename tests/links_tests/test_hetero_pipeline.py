@@ -57,6 +57,37 @@ def test_hetero_forward_matches_sequential(devices):
     np.testing.assert_allclose(np.asarray(out), h, atol=1e-5, rtol=1e-5)
 
 
+def test_chain_list_to_pipeline_lowering(devices):
+    """MultiNodeChainList.to_pipeline: the reference-shaped add_link API
+    lowers a linear chain onto the distributed HeteroPipelineChain, and the
+    result matches the sequential oracle.  Non-linear chains are rejected."""
+    from chainermn_tpu.links import MultiNodeChainList
+
+    comm = cmn.create_communicator("xla", devices=devices)
+    params, stages, io, dims = _hetero_mlp(comm)
+    S = comm.size
+
+    chain = MultiNodeChainList(comm)
+    for s in range(S):
+        chain.add_link(stages[s], rank=s,
+                       rank_out=s + 1 if s + 1 < S else None)
+    pipe = chain.to_pipeline(io, n_microbatches=4)
+    rng = np.random.RandomState(2)
+    x = rng.normal(size=(32, dims[0])).astype(np.float32)
+    out = pipe.as_spmd_fn()(params, x)
+    h = x
+    for p in params:
+        h = np.tanh(h @ p)
+    np.testing.assert_allclose(np.asarray(out), h, atol=1e-5, rtol=1e-5)
+
+    bad = MultiNodeChainList(comm)
+    for s in range(S):
+        # all links on rank 0: valid for the replicated walk, not linear
+        bad.add_link(stages[s], rank=0)
+    with pytest.raises(ValueError):
+        bad.to_pipeline(io, n_microbatches=4)
+
+
 def test_hetero_gradients_match_sequential(devices):
     comm = cmn.create_communicator("xla", devices=devices)
     params, stages, io, dims = _hetero_mlp(comm)
