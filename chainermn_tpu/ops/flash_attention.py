@@ -477,6 +477,22 @@ def _flash_lse_bwd(segmented, heads, causal, block_q, block_k, interpret,
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _default_block(length: int, cap: int, floor: int = 128) -> int:
+    """Largest power-of-2 block in [floor, cap] dividing ``length``; falls
+    back to the legacy ``min(floor, length)`` (validated downstream) when
+    nothing in that range divides.  The on-chip sweep (result/flash_tpu.json,
+    TPU v5 lite, T=2048) showed (block_q=128, block_k=128) — the old
+    defaults — running 0.78× of XLA attention while (256, 512) runs 2.1×
+    faster fwd+bwd: bigger kv blocks amortize the online-softmax rescale
+    over more MXU work."""
+    b = cap
+    while b >= floor:
+        if length % b == 0:
+            return b
+        b //= 2
+    return min(floor, length)
+
+
 def flash_attention_lse(
     q: jax.Array,
     k: jax.Array,
@@ -484,8 +500,8 @@ def flash_attention_lse(
     causal: bool = False,
     segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Like :func:`flash_attention` but also returns the per-row logsumexp
@@ -514,6 +530,9 @@ def flash_attention_lse(
         )
     if interpret is None:
         interpret = _use_interpret()
+    # Sweep-informed defaults (see _default_block); explicit args win.
+    block_q = _default_block(T, 256) if block_q is None else block_q
+    block_k = _default_block(S, 512) if block_k is None else block_k
     block_q = min(block_q, T)
     block_k = min(block_k, S)
     if T % block_q or S % block_k:
@@ -583,8 +602,8 @@ def flash_attention(
     causal: bool = False,
     segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention over ``(batch, seq, heads, head_dim)`` inputs; ``k``/
@@ -596,8 +615,10 @@ def flash_attention(
     (``(batch, kv_len)``) masks the key side independently (defaults to
     ``segment_ids``).  Requires lengths divisible by the block sizes (pad
     upstream; the data layer's bucketing keeps XLA-friendly static shapes
-    anyway).  Differentiable via the flash backward.  ``interpret=None``
-    auto-selects interpret mode off-TPU.
+    anyway).  ``block_q``/``block_k`` default to the largest sweep-winning
+    power-of-2 divisors (≤256 / ≤512 — see ``_default_block``); pass
+    explicit values to override.  Differentiable via the flash backward.
+    ``interpret=None`` auto-selects interpret mode off-TPU.
 
     Thin facade over :func:`flash_attention_lse` (one custom-VJP path to
     maintain); the dropped lse output arrives in the backward as a zero

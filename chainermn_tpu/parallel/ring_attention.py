@@ -163,8 +163,8 @@ def ring_flash_self_attention(
     v: jax.Array,
     axis_name,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q=None,
+    block_k=None,
     segment_ids=None,
 ) -> jax.Array:
     """Ring attention whose LOCAL blocks run the Pallas flash kernel.
@@ -194,7 +194,8 @@ def ring_flash_self_attention(
             qb, kb, vb, causal=causal_blk,
             segment_ids=segment_ids if segmented else None,
             kv_segment_ids=seg_kv,
-            block_q=min(block_q, T), block_k=min(block_k, T),
+            block_q=None if block_q is None else min(block_q, T),
+            block_k=None if block_k is None else min(block_k, T),
         )
         return o.astype(jnp.float32), lse
 
