@@ -80,6 +80,40 @@ def bucket_batches(
     return batches
 
 
+def save_translation_npz(path, pairs) -> None:
+    """Persist ragged (src, tgt) token-id pairs as a flat offsets-format
+    ``.npz`` (``{src,tgt}_tokens`` concatenated int32 + ``{src,tgt}_offsets``
+    int64 prefix bounds) — the zero-copy on-disk corpus format for
+    :func:`load_translation_npz` (the reference streamed WMT text files;
+    token arrays are the XLA-era equivalent)."""
+    src_tok = np.concatenate(
+        [np.asarray(s, np.int32) for s, _ in pairs]
+    ) if pairs else np.zeros(0, np.int32)
+    tgt_tok = np.concatenate(
+        [np.asarray(t, np.int32) for _, t in pairs]
+    ) if pairs else np.zeros(0, np.int32)
+    src_off = np.cumsum([0] + [len(s) for s, _ in pairs]).astype(np.int64)
+    tgt_off = np.cumsum([0] + [len(t) for _, t in pairs]).astype(np.int64)
+    np.savez(path, src_tokens=src_tok, src_offsets=src_off,
+             tgt_tokens=tgt_tok, tgt_offsets=tgt_off)
+
+
+def load_translation_npz(path) -> List[Tuple[List[int], List[int]]]:
+    """Inverse of :func:`save_translation_npz`: returns the list of
+    ``(src, tgt)`` token-id pairs ready for :func:`bucket_batches`."""
+    with np.load(path) as d:
+        st, so = d["src_tokens"], d["src_offsets"]
+        tt, to = d["tgt_tokens"], d["tgt_offsets"]
+    if len(so) != len(to):
+        raise ValueError(
+            f"src/tgt pair counts disagree: {len(so) - 1} vs {len(to) - 1}"
+        )
+    return [
+        (st[so[i]:so[i + 1]].tolist(), tt[to[i]:to[i + 1]].tolist())
+        for i in range(len(so) - 1)
+    ]
+
+
 def make_synthetic_translation(
     n: int = 2048,
     vocab: int = 50,

@@ -26,6 +26,10 @@ def main():
     p.add_argument("--arch", default="lstm", choices=["lstm", "transformer"],
                    help="lstm = reference-parity encoder-decoder; "
                         "transformer = flash cross-attention tier")
+    p.add_argument("--data-npz", default=None,
+                   help="on-disk corpus in save_translation_npz's offsets "
+                        "format (the reference's WMT file role); the last "
+                        "1/8 of pairs becomes the validation split")
     p.add_argument("--force-cpu", action="store_true")
     args = p.parse_args()
 
@@ -61,8 +65,21 @@ def main():
         model = Seq2Seq(vocab_src=args.vocab, vocab_tgt=args.vocab,
                         embed=args.embed, hidden=args.hidden,
                         axis_name=comm.axis_name)
-    pairs = make_synthetic_translation(4096, vocab=args.vocab, min_len=4,
-                                       max_len=16)
+    if args.data_npz:
+        from chainermn_tpu.datasets.seq import load_translation_npz
+
+        all_pairs = load_translation_npz(args.data_npz)
+        n_val = max(len(all_pairs) // 8, 1)
+        pairs, val_pairs = all_pairs[:-n_val], all_pairs[-n_val:]
+        hi = max(max(w for s, t in all_pairs for w in list(s) + list(t)), 0)
+        if hi >= args.vocab:
+            raise SystemExit(
+                f"--data-npz contains token id {hi} >= --vocab {args.vocab}"
+            )
+    else:
+        pairs = make_synthetic_translation(4096, vocab=args.vocab, min_len=4,
+                                           max_len=16)
+        val_pairs = None
     batches = bucket_batches(pairs, args.batchsize,
                              bucket_width=args.bucket_width)
     if jax.process_index() == 0:
@@ -98,8 +115,10 @@ def main():
         create_multi_node_evaluator,
     )
 
-    val_pairs = make_synthetic_translation(512, vocab=args.vocab, min_len=4,
-                                           max_len=16, seed=99)
+    if val_pairs is None:
+        val_pairs = make_synthetic_translation(512, vocab=args.vocab,
+                                               min_len=4, max_len=16,
+                                               seed=99)
     val_batches = bucket_batches(val_pairs, args.batchsize,
                                  bucket_width=args.bucket_width,
                                  keep_tail=True)

