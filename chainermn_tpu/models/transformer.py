@@ -105,11 +105,10 @@ class _DecoderBlock(nn.Module):
             ).astype(q.dtype)
             new_cache = {"k": kc, "v": vc}
         elif self.attention == "flash":
-            # Largest power-of-two block that divides T (flash needs T %
-            # block == 0); natural lengths work without upstream padding.
-            block = 128
-            while block > 1 and T % block:
-                block //= 2
+            # Library-default blocks: largest sweep-winning power-of-2
+            # divisors of T (flash needs T % block == 0); natural lengths
+            # work without upstream padding.
+            block = None
             a = flash_attention(q, k, v, causal=True,
                                 segment_ids=segment_ids, block_q=block,
                                 block_k=block)

@@ -477,20 +477,19 @@ def _flash_lse_bwd(segmented, heads, causal, block_q, block_k, interpret,
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def _default_block(length: int, cap: int, floor: int = 128) -> int:
-    """Largest power-of-2 block in [floor, cap] dividing ``length``; falls
-    back to the legacy ``min(floor, length)`` (validated downstream) when
-    nothing in that range divides.  The on-chip sweep (result/flash_tpu.json,
-    TPU v5 lite, T=2048) showed (block_q=128, block_k=128) — the old
-    defaults — running 0.78× of XLA attention while (256, 512) runs 2.1×
-    faster fwd+bwd: bigger kv blocks amortize the online-softmax rescale
-    over more MXU work."""
+def _default_block(length: int, cap: int) -> int:
+    """Largest power-of-2 ≤ ``cap`` dividing ``length`` (1 for odd lengths —
+    degenerate but valid; pad upstream for speed).  The on-chip sweep
+    (result/flash_tpu.json, TPU v5 lite, T=2048) showed (block_q=128,
+    block_k=128) — the old defaults — running 0.78× of XLA attention while
+    (256, 512) runs 2.1× faster fwd+bwd: bigger kv blocks amortize the
+    online-softmax rescale over more MXU work."""
     b = cap
-    while b >= floor:
+    while b > 1:
         if length % b == 0:
             return b
         b //= 2
-    return min(floor, length)
+    return 1
 
 
 def flash_attention_lse(
