@@ -28,6 +28,10 @@ def main():
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--wire-dtype", default=None)
     p.add_argument("--double-buffering", action="store_true")
+    p.add_argument("--grad-compression", default=None,
+                   choices=["int8_ef"],
+                   help="int8_ef = 4x-compressed gradient wire with error "
+                        "feedback (beyond the bf16 --wire-dtype tier)")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
     p.add_argument("--train-npz", default=None,
@@ -77,6 +81,7 @@ def main():
         optax.sgd(args.lr, momentum=0.9, nesterov=True),
         comm,
         double_buffering=args.double_buffering,
+        grad_compression=args.grad_compression,
     )
     state = opt.init(variables["params"], model_state=variables["batch_stats"])
     loss_fn = resnet_loss(model)
