@@ -56,6 +56,11 @@ class ZeroTrainState:
     opt_state: Any  # optax state over the flat layout (param-shaped leaves
     # sharded, scalars replicated)
     model_state: Any = None
+    # int8 error-feedback compression: each device's full-gradient
+    # quantization error, per leaf (N, padded) sharded over the mesh (a
+    # device quantizes its WHOLE local gradient before the reduce-scatter,
+    # so its error is full-size — the memory cost of EF under ZeRO).
+    ef_residual: Any = None
 
 
 class ZeroMultiNodeOptimizer:
@@ -76,9 +81,20 @@ class ZeroMultiNodeOptimizer:
         self,
         tx: optax.GradientTransformation,
         communicator: XlaCommunicator,
+        grad_compression: str = None,
     ):
         if not isinstance(communicator, XlaCommunicator):
             raise TypeError("ZeRO optimizer requires a mesh-backed communicator")
+        if grad_compression not in (None, "int8_ef"):
+            raise ValueError(
+                f"grad_compression={grad_compression!r}: expected None or "
+                "'int8_ef'"
+            )
+        # Same int8+error-feedback wire as MultiNodeOptimizer's, on the
+        # reduce-scatter path: the codes psum_scatter exactly in int32 and
+        # the owned shard dequantizes once — numerics match the replicated
+        # int8 tier bit-for-bit (tested).
+        self.grad_compression = grad_compression
         self.tx = tx
         self.comm = communicator
         self._leafspecs = None
@@ -147,11 +163,21 @@ class ZeroMultiNodeOptimizer:
             model_state = self.comm.replicate(
                 jax.tree_util.tree_map(jnp.array, model_state)
             )
+        resid = None
+        if self.grad_compression is not None:
+            n = self._n
+            resid = [
+                self.comm.place(
+                    np.zeros((n, spec.padded), spec.dtype), sh
+                )
+                for spec in self._leafspecs
+            ]
         return ZeroTrainState(
             step=jnp.zeros((), jnp.int32),
             flat_params=flat,
             opt_state=opt_state,
             model_state=model_state,
+            ef_residual=resid,
         )
 
     def _map_opt_state(self, opt_state, on_param, on_other):
@@ -217,6 +243,7 @@ class ZeroMultiNodeOptimizer:
         )
 
         wire = getattr(comm, "allreduce_grad_dtype", None)
+        compression = self.grad_compression
 
         def gather_full(flat_local):
             """Local (k,) slices → full param pytree (device-varying)."""
@@ -250,6 +277,30 @@ class ZeroMultiNodeOptimizer:
                 out.append(r)
             return out
 
+        def scatter_grads_int8_ef(grads, residual):
+            """int8+error-feedback reduce-scatter (MultiNodeOptimizer's
+            ``_int8_ef_reduce`` on the scatter path): shared pmax scale,
+            int8 codes psum_scatter'd in int32 (exact), one dequantize on
+            the owned shard; the device keeps its full-size code error.
+            Returns ``(local_slices, new_residual)``."""
+            leaves = jax.tree_util.tree_leaves(grads)
+            out, res_out = [], []
+            for g, spec, r in zip(leaves, specs, residual):
+                v = g.reshape(-1).astype(jnp.float32)
+                if spec.padded != spec.size:
+                    v = jnp.pad(v, (0, spec.padded - spec.size))
+                c = v + r[0].astype(jnp.float32)
+                amax = lax.pmax(jnp.max(jnp.abs(c)), axes)
+                s = jnp.maximum(amax, 1e-30) / 127.0
+                q = jnp.clip(jnp.round(c / s), -127, 127)
+                tot = lax.psum_scatter(
+                    q.astype(jnp.int32).reshape(n, spec.padded // n),
+                    axes, scatter_dimension=0, tiled=False,
+                )
+                out.append((tot.astype(jnp.float32) * s / n).astype(g.dtype))
+                res_out.append((c - q * s).astype(r.dtype)[None])
+            return out, res_out
+
         grad_one = _make_grad_one(loss_fn, has_aux, stateful)
 
         def body(state: ZeroTrainState, batch):
@@ -263,7 +314,13 @@ class ZeroMultiNodeOptimizer:
             loss, aux, new_model_state, grads = _accumulated_grads(
                 grad_one, params, state.model_state, batch, accum_steps
             )
-            g_local = scatter_grads(grads)
+            if compression is not None:
+                g_local, new_resid = scatter_grads_int8_ef(
+                    grads, state.ef_residual
+                )
+            else:
+                g_local = scatter_grads(grads)
+                new_resid = state.ef_residual
             p_local = state.flat_params
             updates, opt_state = tx.update(g_local, state.opt_state, p_local)
             p_local = optax.apply_updates(p_local, updates)
@@ -276,6 +333,7 @@ class ZeroMultiNodeOptimizer:
                     flat_params=p_local,
                     opt_state=opt_state,
                     model_state=new_model_state,
+                    ef_residual=new_resid,
                 ),
                 metrics,
             )
@@ -291,6 +349,9 @@ class ZeroMultiNodeOptimizer:
         state_spec = ZeroTrainState(
             step=P(), flat_params=flat_spec, opt_state=opt_spec,
             model_state=P(),
+            ef_residual=(
+                [P(axes) for _ in specs] if compression is not None else P()
+            ),
         )
         mapped = jax.shard_map(
             body,
@@ -362,7 +423,12 @@ def zero_clip_by_global_norm(max_norm: float, communicator) -> optax.GradientTra
 def create_zero_optimizer(
     actual_optimizer: optax.GradientTransformation,
     communicator: XlaCommunicator,
+    grad_compression: str = None,
 ) -> ZeroMultiNodeOptimizer:
     """Factory mirroring ``create_multi_node_optimizer`` for the sharded-
-    state tier (no reference analog — ChainerMN replicated everything)."""
-    return ZeroMultiNodeOptimizer(actual_optimizer, communicator)
+    state tier (no reference analog — ChainerMN replicated everything).
+    ``grad_compression='int8_ef'`` compresses the reduce-scatter wire 4x
+    with error feedback (costs one grad-sized residual per device)."""
+    return ZeroMultiNodeOptimizer(
+        actual_optimizer, communicator, grad_compression=grad_compression
+    )

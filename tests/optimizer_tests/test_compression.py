@@ -115,6 +115,51 @@ def test_compressed_training_tracks_fp32(devices):
     assert finals["int8_ef"] < finals["fp32"] * 1.10 + 0.02, finals
 
 
+def test_zero_int8_ef_matches_replicated_int8_ef(devices):
+    """ZeRO + int8_ef (quantize → psum_scatter int32 codes → dequantize the
+    owned shard) must produce the SAME numerics as the replicated int8_ef
+    tier — the reduce-scatter is the scatter half of the identical
+    allreduce, and padding zeros neither move the shared scale nor the
+    codes."""
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = MLP(hidden=(16,), n_out=5)
+    params = model.init(
+        jax.random.PRNGKey(1), np.zeros((1, 12), np.float32)
+    )["params"]
+    loss_fn = classification_loss(model)
+    ds = make_synthetic_classification(n=64 * 4, dim=12, classes=5, seed=4)
+    x, y = ds.arrays
+    batches = [(x[i * 64:(i + 1) * 64], y[i * 64:(i + 1) * 64])
+               for i in range(4)]
+
+    ropt = cmn.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm, grad_compression="int8_ef"
+    )
+    rstate = ropt.init(params)
+    zopt = cmn.create_zero_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm, grad_compression="int8_ef"
+    )
+    zstate = zopt.init(params)
+    for s in zstate.ef_residual:
+        # 1/N-sharded: every device holds exactly its own residual row
+        for shard in s.addressable_shards:
+            assert (
+                int(np.prod(shard.data.shape))
+                == int(np.prod(s.shape)) // comm.size
+            ), (shard.data.shape, s.shape)
+
+    for b in batches:
+        rstate, _ = ropt.update(rstate, b, loss_fn, has_aux=True)
+        zstate, _ = zopt.update(zstate, b, loss_fn, has_aux=True)
+    zparams = zopt.materialize_params(zstate)
+    for a, bb in zip(jax.tree_util.tree_leaves(rstate.params),
+                     jax.tree_util.tree_leaves(zparams)):
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(bb)),
+            atol=2e-6, rtol=2e-6,
+        )
+
+
 def test_compression_rejects_bad_mode(devices):
     comm = cmn.create_communicator("xla", devices=devices)
     with pytest.raises(ValueError):
