@@ -107,5 +107,7 @@ def test_two_process_integration(tmp_path):
             "checkpoint",
             "corpus_evaluator",
             "device_prefetch",
+            "int8_ef_compression",
+            "file_backed_data",
         ):
             assert v.get(key) == "ok", (pid, key, v)

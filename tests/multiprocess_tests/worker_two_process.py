@@ -288,6 +288,28 @@ def main() -> dict:
         np.testing.assert_allclose(local, pxs[2 * i : 2 * i + 2], atol=0)
     out["device_prefetch"] = "ok"
 
+    # --- int8 error-feedback compression across processes ----------------
+    # The int32 code psum + scalar pmax ride the cross-process (gloo)
+    # collective path here, not the in-process CPU mesh; both processes
+    # must end bit-identical (the quantized wire is deterministic).
+    copt = cmn.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm, grad_compression="int8_ef"
+    )
+    cstate = copt.init(params0)
+    for _ in range(2):
+        cbatch = comm.shard_batch((xs[mine], ys[mine]))
+        cstate, cmetrics = copt.update(cstate, cbatch, loss_fn,
+                                       has_aux=True)
+    closs = float(cmetrics["loss"])
+    assert np.isfinite(closs), closs
+    digest = [
+        np.asarray(jax.device_get(leaf)).tobytes()
+        for leaf in jax.tree_util.tree_leaves(cstate.params)
+    ]
+    other_digest = comm.allgather_obj(digest)
+    assert other_digest[0] == other_digest[1], "int8_ef params diverged"
+    out["int8_ef_compression"] = "ok"
+
     # --- file-backed data path (VERDICT r2 item 7) -----------------------
     # Real on-disk data through the two-level path: process 0 writes a .npy
     # directory (memory-mapped on load), both processes scatter_dataset it,
