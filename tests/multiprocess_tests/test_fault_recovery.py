@@ -61,9 +61,9 @@ def _launch(tmp_path, fault_iter=None, timeout=240, extra_env=None,
 import pytest
 
 
-@pytest.mark.parametrize("nproc", [2, 4])
+@pytest.mark.parametrize("nproc", [2, 4, 8])
 def test_crash_aborts_job_and_restart_resumes(tmp_path, nproc):
-    """n=2 and n=4 (VERDICT r2 item 5: chaos beyond the 2-process toy) —
+    """n=2/4/8 (VERDICT r2 item 5: chaos beyond the 2-process toy) —
     the batch scales so every config runs 2 iters/epoch, keeping the
     checkpoint/resume arithmetic identical."""
     env = {"CMN_BATCH": str(256 // (2 * nproc))}
