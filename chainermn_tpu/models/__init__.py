@@ -39,6 +39,7 @@ from chainermn_tpu.models.parallel_convnet import (
     init_channel_parallel,
     make_channel_parallel_train_step,
 )
+from chainermn_tpu.models.vit import ViT, vit_loss
 from chainermn_tpu.models.transformer import (
     ParallelLM,
     ParallelLMConfig,
@@ -59,6 +60,8 @@ __all__ = [
     "ResNet18",
     "ResNetTiny",
     "ResNet50",
+    "ViT",
+    "vit_loss",
     "resnet_loss",
     "VGGStage",
     "VGGHead",

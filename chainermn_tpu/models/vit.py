@@ -1,0 +1,110 @@
+"""Vision Transformer — the encoder-side model family on the flash path.
+
+No reference anchor (ChainerMN predates ViT); this rounds out the model zoo
+so the vision tier has both conv (ResNet/VGG) and attention architectures on
+the same data-parallel / flash-kernel stack.  TPU-first choices:
+
+* patch embedding as a single strided conv (one MXU matmul per patch grid);
+* pre-norm encoder blocks over the NON-causal Pallas flash kernel
+  (``flash_attention(causal=False)``) — bf16 compute / fp32 params like the
+  ResNet tier;
+* mean-pooled representation + fp32 head (a CLS token adds a T+1 ragged
+  length for no accuracy at this scale; mean-pool keeps T a clean multiple
+  of the flash block sizes).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+
+class _EncoderBlock(nn.Module):
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any
+    attention: str  # "flash" | "xla"
+
+    @nn.compact
+    def __call__(self, h):
+        from chainermn_tpu.ops import flash_attention, reference_attention
+
+        D, H = self.d_model, self.n_heads
+        x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
+        qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.attention == "flash":
+            a = flash_attention(q, k, v, causal=False)
+        else:
+            a = reference_attention(q, k, v, causal=False).astype(q.dtype)
+        a = nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype,
+                            name="proj")(a)
+        h = h + a
+        x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
+        x = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(x)
+        x = nn.gelu(x)
+        x = nn.Dense(D, dtype=self.dtype, name="ff2")(x)
+        return h + x
+
+
+class ViT(nn.Module):
+    """``(B, H, W, C)`` images → ``(B, num_classes)`` fp32 logits."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    d_model: int = 384
+    n_heads: int = 6
+    d_ff: int = 1536
+    n_layers: int = 12
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, Hx, Wx, C = x.shape
+        if Hx % self.patch or Wx % self.patch:
+            raise ValueError(
+                f"image {Hx}x{Wx} not divisible by patch {self.patch}"
+            )
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.d_model, (self.patch, self.patch),
+                    strides=(self.patch, self.patch),
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(x)
+        h = x.reshape(B, -1, self.d_model)  # (B, T, D), T = (H/p)(W/p)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, h.shape[1], self.d_model), jnp.float32,
+        )
+        h = h + pos.astype(self.dtype)
+        block = nn.remat(_EncoderBlock) if self.remat else _EncoderBlock
+        for i in range(self.n_layers):
+            h = block(self.d_model, self.n_heads, self.d_ff, self.dtype,
+                      self.attention, name=f"block{i}")(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
+        h = jnp.mean(h.astype(jnp.float32), axis=1)  # mean-pool tokens
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(h)
+
+
+def vit_loss(model: ViT):
+    """Same contract as ``resnet_loss`` minus the BN model_state:
+    ``loss_fn(params, batch) -> (loss, aux)``."""
+    import optax
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply({"params": params}, x, train=True)
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        )
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, {"accuracy": acc}
+
+    return loss_fn

@@ -33,7 +33,8 @@ def main():
                    help="int8_ef = 4x-compressed gradient wire with error "
                         "feedback (beyond the bf16 --wire-dtype tier)")
     p.add_argument("--checkpoint", default=None)
-    p.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
+    p.add_argument("--arch", default="resnet50",
+                   choices=["resnet50", "resnet18", "vit"])
     p.add_argument("--train-npz", default=None,
                    help="file-backed training data: a .npz archive or a "
                         "directory of memory-mapped .npy files (members: "
@@ -54,7 +55,9 @@ def main():
 
         _backend.clear_backends()
     if args.smoke:
-        args.image_size, args.num_classes, args.arch = 32, 10, "resnet18"
+        args.image_size, args.num_classes = 32, 10
+        if args.arch == "resnet50":  # explicit --arch survives smoke mode
+            args.arch = "resnet18"
         args.batchsize = min(args.batchsize, 64)
         args.iters_per_epoch = 4
 
@@ -62,7 +65,13 @@ def main():
     import optax
 
     import chainermn_tpu as cmn
-    from chainermn_tpu.models import ResNet18, ResNet50, resnet_loss
+    from chainermn_tpu.models import (
+        ResNet18,
+        ResNet50,
+        ViT,
+        resnet_loss,
+        vit_loss,
+    )
     from chainermn_tpu.training import LogReport, Trainer
 
     comm = cmn.create_communicator(
@@ -72,10 +81,23 @@ def main():
         print(f"devices: {comm.size}  arch: {args.arch}  "
               f"global batch: {args.batchsize}")
 
-    arch = ResNet50 if args.arch == "resnet50" else ResNet18
-    model = arch(num_classes=args.num_classes, axis_name=comm.axis_name)
     x0 = np.zeros((8, args.image_size, args.image_size, 3), np.float32)
-    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+    if args.arch == "vit":
+        # Stateless (no BN): ViT-S/16 geometry at full size, patch 4 in
+        # --smoke so a 32px image still yields an 8x8 token grid.
+        model = ViT(num_classes=args.num_classes,
+                    patch=4 if args.smoke else 16)
+        variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+        model_state = None
+        loss_fn = vit_loss(model)
+        stateful = False
+    else:
+        arch = ResNet50 if args.arch == "resnet50" else ResNet18
+        model = arch(num_classes=args.num_classes, axis_name=comm.axis_name)
+        variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+        model_state = variables["batch_stats"]
+        loss_fn = resnet_loss(model)
+        stateful = True
 
     opt = cmn.create_multi_node_optimizer(
         optax.sgd(args.lr, momentum=0.9, nesterov=True),
@@ -83,8 +105,7 @@ def main():
         double_buffering=args.double_buffering,
         grad_compression=args.grad_compression,
     )
-    state = opt.init(variables["params"], model_state=variables["batch_stats"])
-    loss_fn = resnet_loss(model)
+    state = opt.init(variables["params"], model_state=model_state)
 
     from chainermn_tpu.datasets import ArrayDataset, NpzDataset
     from chainermn_tpu.iterators import PrefetchIterator
@@ -135,7 +156,8 @@ def main():
         # transforms, moved onto the chip (fused into the step's prologue).
         step_kwargs["augment"] = random_crop_flip(padding=4)
     trainer = Trainer(opt, state, loss_fn, it, stop=(args.epoch, "epoch"),
-                      stateful=True, step_kwargs=step_kwargs)
+                      stateful=stateful, has_aux=not stateful,
+                      step_kwargs=step_kwargs)
     trainer.extend(LogReport(trigger=(1, "epoch")))
     if args.checkpoint:
         ckpt = cmn.create_multi_node_checkpointer(

@@ -25,6 +25,8 @@ EXAMPLES = {
         "--epoch", "1", "--batchsize", "512"],
     "imagenet": ["examples/imagenet/train_imagenet.py", "--force-cpu",
                  "--smoke"],
+    "imagenet_vit": ["examples/imagenet/train_imagenet.py", "--force-cpu",
+                     "--smoke", "--arch", "vit"],
     "imagenet_augment": ["examples/imagenet/train_imagenet.py",
                          "--force-cpu", "--smoke", "--augment"],
     "lm": ["examples/lm/train_lm.py", "--steps", "4", "--layers", "1",
