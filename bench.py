@@ -50,15 +50,8 @@ BASELINE_PROVENANCE = {
     "baseline_unverified": True,
 }
 
-#: bf16 peak matmul throughput per chip, by jax device_kind (public specs).
-PEAK_BF16_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# bf16 peak table lives in chainermn_tpu.utils.PEAK_BF16_FLOPS (imported at
+# use time — this module must stay importable before the device probe).
 
 
 def _emit(payload: dict) -> None:
@@ -389,7 +382,9 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     }
     if flops_per_step is not None:
         payload["tflops_per_step"] = round(flops_per_step / 1e12, 3)
-        peak = PEAK_BF16_FLOPS.get(device_kind)
+        from chainermn_tpu.utils import PEAK_BF16_FLOPS as _peaks
+
+        peak = _peaks.get(device_kind)
         if peak is not None:
             achieved = flops_per_step * (iters / dt) / n_dev
             payload["mfu_pct"] = round(100.0 * achieved / peak, 2)

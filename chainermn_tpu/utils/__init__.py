@@ -107,6 +107,49 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
+#: bf16 peak matmul throughput per chip by jax ``device_kind`` (public
+#: specs) — the MFU denominator.  ``bench.py`` and user code share this one
+#: table so a headline MFU and a quick estimate can never disagree.
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def compiled_flops(compiled) -> Optional[float]:
+    """Per-execution FLOP count from XLA's own cost analysis of a lowered-
+    and-compiled function (``jax.jit(f).lower(...).compile()``), or ``None``
+    when the backend does not report it."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
+def mfu(compiled, step_time_s: float, n_devices: int = 1,
+        device_kind: Optional[str] = None) -> Optional[float]:
+    """Model FLOPs utilization (%) of a compiled step: XLA-counted FLOPs per
+    execution ÷ (step time · per-chip bf16 peak · n_devices).  ``None`` when
+    the device kind has no table entry or XLA reports no flops.  The
+    compiler's count is the honest numerator — it includes remat recompute
+    and excludes nothing the chip actually executes."""
+    if device_kind is None:
+        device_kind = jax.devices()[0].device_kind
+    peak = PEAK_BF16_FLOPS.get(device_kind)
+    flops = compiled_flops(compiled)
+    if peak is None or flops is None or step_time_s <= 0:
+        return None
+    return 100.0 * flops / (step_time_s * peak * n_devices)
+
+
 def scaling_efficiency(
     throughputs: Sequence[float], sizes: Sequence[int]
 ) -> List[float]:

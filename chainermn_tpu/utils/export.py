@@ -1,0 +1,52 @@
+"""Model export: serialize a jitted forward to a portable StableHLO artifact.
+
+No reference anchor (ChainerMN had no export/serving story); this is the
+capability a modern framework owes its users: freeze a trained forward
+(params closed over or passed as inputs) into a single self-contained blob
+that any later process — or a serving binary linking XLA — can reload and
+execute without the model code.  Built on ``jax.export`` (StableHLO +
+calling-convention metadata), so the artifact survives library-version skew
+within jax.export's compatibility window.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+import jax
+
+
+def export_forward(fn: Callable, *example_args: Any,
+                   platforms=None) -> bytes:
+    """Trace ``jax.jit(fn)`` at ``example_args``' shapes/dtypes and
+    serialize the result.  ``platforms`` (e.g. ``["tpu", "cpu"]``) bakes in
+    multi-platform lowering; default is the current backend only."""
+    from jax import export as jex
+
+    exp = jex.export(jax.jit(fn), platforms=platforms)(*example_args)
+    return bytes(exp.serialize())  # serialize() hands back a bytearray
+
+def load_forward(blob: bytes) -> Callable:
+    """Inverse of :func:`export_forward`: returns a callable running the
+    serialized computation via ``jax.jit`` on the current backend."""
+    from jax import export as jex
+
+    exp = jex.deserialize(blob)
+    return jax.jit(exp.call)
+
+
+def save_forward(path: str, fn: Callable, *example_args: Any,
+                 platforms=None) -> str:
+    """:func:`export_forward` to a file (atomic rename)."""
+    blob = export_forward(fn, *example_args, platforms=platforms)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def load_forward_file(path: str) -> Callable:
+    with open(path, "rb") as f:
+        return load_forward(f.read())

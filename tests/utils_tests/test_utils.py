@@ -54,3 +54,18 @@ def test_trace_writes_profile(tmp_path):
     for root, dirs, files in os.walk(tmp_path):
         xplanes += [f for f in files if f.endswith(".xplane.pb")]
     assert xplanes, "trace produced no xplane profile artifact"
+
+
+def test_mfu_from_compiled_step():
+    from chainermn_tpu.utils import compiled_flops, mfu
+
+    f = jax.jit(lambda a, b: a @ b)
+    x = jnp.ones((256, 256), jnp.float32)
+    compiled = f.lower(x, x).compile()
+    flops = compiled_flops(compiled)
+    assert flops is not None and flops >= 2 * 256**3 * 0.9  # ~2·n³ matmul
+    # Known device kind + fabricated step time → deterministic percentage.
+    got = mfu(compiled, step_time_s=flops / 197e12, n_devices=1,
+              device_kind="TPU v5 lite")
+    assert got is not None and abs(got - 100.0) < 1e-6
+    assert mfu(compiled, 1.0, device_kind="made-up-chip") is None
