@@ -18,13 +18,32 @@ import jax
 
 
 def export_forward(fn: Callable, *example_args: Any,
-                   platforms=None) -> bytes:
+                   platforms=None, poly_batch: bool = False) -> bytes:
     """Trace ``jax.jit(fn)`` at ``example_args``' shapes/dtypes and
     serialize the result.  ``platforms`` (e.g. ``["tpu", "cpu"]``) bakes in
-    multi-platform lowering; default is the current backend only."""
+    multi-platform lowering; default is the current backend only.
+
+    ``poly_batch=True`` exports with a SYMBOLIC leading dimension on every
+    array argument (shape polymorphism, ``jax.export.symbolic_shape``): the
+    artifact then serves any batch size, the shape a deployment artifact
+    actually needs.  Example args still provide the trailing dims/dtypes."""
     from jax import export as jex
 
-    exp = jex.export(jax.jit(fn), platforms=platforms)(*example_args)
+    if poly_batch:
+        scope = jex.SymbolicScope()
+        (b,) = jex.symbolic_shape("b", scope=scope)
+
+        def _spec(x):
+            shape = jax.numpy.shape(x)
+            if not shape:
+                return jax.ShapeDtypeStruct(shape, jax.numpy.asarray(x).dtype)
+            return jax.ShapeDtypeStruct((b,) + tuple(shape[1:]),
+                                        jax.numpy.asarray(x).dtype)
+
+        args = jax.tree_util.tree_map(_spec, example_args)
+        exp = jex.export(jax.jit(fn), platforms=platforms)(*args)
+    else:
+        exp = jex.export(jax.jit(fn), platforms=platforms)(*example_args)
     return bytes(exp.serialize())  # serialize() hands back a bytearray
 
 def load_forward(blob: bytes) -> Callable:

@@ -62,3 +62,21 @@ def test_vit_round_trip():
     want = np.asarray(forward(x))
     got = np.asarray(load_forward(export_forward(forward, x))(x))
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_poly_batch_export_serves_any_batch():
+    model = MLP(hidden=(8,), n_out=3)
+    x0 = jnp.zeros((4, 5), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x0[:1])["params"]
+
+    def forward(inp):
+        return model.apply({"params": params}, inp)
+
+    blob = export_forward(forward, x0, poly_batch=True)
+    restored = load_forward(blob)
+    rng = np.random.RandomState(7)
+    for b in (1, 4, 13):
+        x = rng.normal(size=(b, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(restored(x)), np.asarray(forward(x)), atol=1e-6
+        )
