@@ -3,9 +3,12 @@
 A deliberately comm-bound config (wide MLP → big gradient pytree, tiny
 per-chip batch → little compute) so the gradient collective dominates the
 step; the int8_ef tier moves 4× fewer bytes than fp32 (2× fewer than bf16)
-at the cost of the quantize/dequantize elementwise work.  On the CPU
-simulation mesh collectives are memcpy-bound, so byte reduction shows up
-directly; on real ICI the effect scales with the bandwidth/compute ratio.
+at the cost of the quantize/dequantize elementwise work.  NOTE the expected
+CPU-mesh outcome (committed in ``result/compression_cpu.json``): int8_ef is
+SLOWER there (~0.45× of fp32) — the in-process "collective" is a memcpy
+with no bandwidth to save, so only the added elementwise work registers.
+The byte reduction pays on bandwidth-bound interconnects (ICI/DCN), which
+this harness measures whenever a multi-chip mesh is present.
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python benchmarks/compression.py --out result/compression_cpu.json
@@ -62,14 +65,17 @@ def measure(dim: int = 2048, batch_per_chip: int = 8, iters: int = 20):
         for _ in range(3):
             state, m = step(state, batch)
         sync(m)
+        # Numerics cross-check EARLY (step 3), before this overfit config
+        # saturates every mode to 0.0: a mis-scaled wire (e.g. a stray
+        # 1/size) visibly diverges here.
+        final_losses[name] = float(m["loss"])
         t0 = time.perf_counter()
         for _ in range(iters):
             state, m = step(state, batch)
         sync(m)
         dt = time.perf_counter() - t0
         out[f"{name}_step_ms"] = round(dt / iters * 1000, 3)
-        final_losses[name] = float(m["loss"])
-    out["final_loss"] = {k: round(v, 4) for k, v in final_losses.items()}
+    out["loss_at_step3"] = {k: round(v, 6) for k, v in final_losses.items()}
     out["int8_vs_fp32_speedup"] = round(
         out["fp32_step_ms"] / out["int8_ef_step_ms"], 3
     )
