@@ -56,9 +56,10 @@ def load_forward(blob: bytes) -> Callable:
 
 
 def save_forward(path: str, fn: Callable, *example_args: Any,
-                 platforms=None) -> str:
+                 platforms=None, poly_batch: bool = False) -> str:
     """:func:`export_forward` to a file (atomic rename)."""
-    blob = export_forward(fn, *example_args, platforms=platforms)
+    blob = export_forward(fn, *example_args, platforms=platforms,
+                          poly_batch=poly_batch)
     tmp = f"{path}.tmp"
     with open(tmp, "wb") as f:
         f.write(blob)

@@ -43,6 +43,8 @@ EXAMPLES = {
     "seq2seq_transformer": ["examples/seq2seq/seq2seq.py", "--force-cpu",
                             "--epoch", "1", "--batchsize", "64",
                             "--embed", "16", "--arch", "transformer"],
+    "export_serving": ["examples/export_serving.py", "--force-cpu",
+                       "--steps", "5", "--out", ""],
     "dcgan": ["examples/dcgan/train_dcgan.py", "--force-cpu", "--epoch", "1",
               "--n-train", "256", "--ch", "8", "--out", ""],
     "parallel_convnet": ["examples/parallel_convnet/train_parallel_convnet.py",
