@@ -160,6 +160,60 @@ def test_zero_int8_ef_matches_replicated_int8_ef(devices):
         )
 
 
+def _fuzz_setup(devices):
+    comm = cmn.create_communicator("xla", devices=devices)
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(1.0), comm, grad_compression="int8_ef"
+    )
+    return comm, opt
+
+
+def test_int8_ef_quantization_properties(devices):
+    """Property fuzz (hypothesis): for arbitrary per-device gradients, one
+    compressed step satisfies the quantization algebra —
+
+      * |applied − mean(g)| ≤ s/2 (shared scale s = max|g|/127: each code
+        rounds by ≤ 1/2, so the device-mean error is ≤ s/2),
+      * every device's residual is exactly its own code error, i.e.
+        g_d − r_d is an integer multiple of s in [−127s, 127s].
+    """
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    from hypothesis.extra import numpy as hnp
+
+    comm, opt = _fuzz_setup(devices)
+    n = comm.size
+    K = 16
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        hnp.arrays(
+            np.float32, (n, K),
+            elements=st.floats(-50.0, 50.0, width=32,
+                               allow_nan=False, allow_infinity=False),
+        )
+    )
+    def check(rows):
+        state = opt.init({"w": np.zeros((K, 1), np.float32)})
+        state, _ = opt.update(state, (rows,), _mean_loss)
+        applied = -np.asarray(state.params["w"])[:, 0]  # lr 1 sgd
+        resid = np.asarray(jax.device_get(state.ef_residual["w"]))[..., 0]
+        amax = np.abs(rows).max()
+        if amax == 0.0:
+            np.testing.assert_array_equal(applied, 0.0)
+            return
+        s = amax / 127.0
+        gbar = rows.mean(axis=0)
+        assert np.all(np.abs(applied - gbar) <= s / 2 + 1e-5 * amax), (
+            np.abs(applied - gbar).max(), s)
+        codes = (rows - resid) / s  # must be integers in [-127, 127]
+        np.testing.assert_allclose(codes, np.round(codes),
+                                   atol=1e-3)
+        assert np.all(np.abs(codes) <= 127.0 + 1e-3)
+
+    check()
+
+
 def test_compression_rejects_bad_mode(devices):
     comm = cmn.create_communicator("xla", devices=devices)
     with pytest.raises(ValueError):
