@@ -294,7 +294,17 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
 
     _mark(f"client up: {platform} x{n_dev}, per_chip_batch={per_chip_batch}")
     comm = cmn.create_communicator("xla", allreduce_grad_dtype=jnp.bfloat16)
-    model = ResNet50(num_classes=1000, axis_name=comm.axis_name)
+    # CMN_BENCH_ARCH=vit benchmarks the attention vision family (ViT-S/16
+    # defaults) instead of the headline ResNet-50; stateless (no sync-BN).
+    arch = os.environ.get("CMN_BENCH_ARCH", "resnet50")
+    if arch not in ("resnet50", "vit"):
+        _fail(f"CMN_BENCH_ARCH={arch!r}: expected 'resnet50' or 'vit'")
+    if arch == "vit":
+        from chainermn_tpu.models import ViT, vit_loss
+
+        model = ViT(num_classes=1000)
+    else:
+        model = ResNet50(num_classes=1000, axis_name=comm.axis_name)
     # CMN_BENCH_OPT=zero benchmarks the sharded-state tier (reduce-scatter
     # grads + 1/N opt state + param all-gather) instead of the replicated
     # optimizer — same numerics, different memory/traffic profile.
@@ -313,7 +323,9 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     # and UNDER JIT: an eager flax init is hundreds of op-by-op dispatches,
     # each a round trip over the axon tunnel (observed to stall the bench for
     # 10+ minutes before any compute started). One jitted program = one trip.
-    init_model = ResNet50(num_classes=1000)
+    init_model = (
+        model if arch == "vit" else ResNet50(num_classes=1000)
+    )
 
     @jax.jit
     def _init(rng):
@@ -322,26 +334,30 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
 
     variables = jax.block_until_ready(_init(rng))
     _mark("model init done")
+    model_state = variables.get("batch_stats") if arch != "vit" else None
     if opt_kind == "zero" or jax.process_count() > 1:
         # ZeRO init shards flat params host-side (numpy pad/ravel), and
         # multi-host placement uses make_array_from_callback — neither can
         # run under a trace.
-        state = opt.init(
-            variables["params"], model_state=variables["batch_stats"]
-        )
+        state = opt.init(variables["params"], model_state=model_state)
     else:
         state = jax.block_until_ready(
             jax.jit(lambda p, s: opt.init(p, model_state=s))(
-                variables["params"], variables["batch_stats"]
+                variables["params"], model_state
             )
         )
     _mark("optimizer state init done")
     # CMN_BENCH_ACCUM=k microbatches each device batch k ways (activation
     # memory lever — lets the headline per-chip batch run on smaller HBM).
     accum = int(os.environ.get("CMN_BENCH_ACCUM", "1"))
-    step = opt.make_train_step(
-        resnet_loss(model), stateful=True, accum_steps=accum
-    )
+    if arch == "vit":
+        step = opt.make_train_step(
+            vit_loss(model), has_aux=True, accum_steps=accum
+        )
+    else:
+        step = opt.make_train_step(
+            resnet_loss(model), stateful=True, accum_steps=accum
+        )
 
     global_batch = per_chip_batch * n_dev
     batch = _device_batch(comm, global_batch, image_size)
@@ -383,10 +399,15 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     step_ms = dt / iters * 1000.0
 
     payload = {
-        "metric": "resnet50_train_images_per_sec_per_chip",
+        "metric": f"{arch}_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3),
+        # The 125 img/s/GPU reference is a ResNet-50 number; a ViT run has
+        # no reference counterpart (the comparison would be meaningless).
+        "vs_baseline": (
+            round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_CHIP, 3)
+            if arch == "resnet50" else None
+        ),
         "platform": platform,
         "device_kind": device_kind,
         "n_devices": n_dev,

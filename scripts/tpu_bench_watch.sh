@@ -49,6 +49,15 @@ print(float((x@x).sum()))
         && mv result/bench_tpu_b512.json.tmp result/bench_tpu_b512.json
       echo "# b512 bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/bench_tpu_vit.json ]; then
+      echo "# running ViT bench at $(date +%H:%M:%S)" >&2
+      CMN_BENCH_PROBE_S=60 CMN_BENCH_ARCH=vit CMN_BENCH_BATCH=256 \
+        timeout 1800 python bench.py \
+        >result/bench_tpu_vit.json.tmp 2>>result/bench_watch_stderr.log \
+        && ! grep -q unreachable result/bench_tpu_vit.json.tmp \
+        && mv result/bench_tpu_vit.json.tmp result/bench_tpu_vit.json
+      echo "# vit bench rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/flash_tpu.json ]; then
       echo "# running flash sweep at $(date +%H:%M:%S)" >&2
       timeout 1800 python benchmarks/flash_tpu.py --out result/flash_tpu.json \
