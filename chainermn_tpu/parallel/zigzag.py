@@ -32,6 +32,31 @@ from jax import lax
 from chainermn_tpu.parallel.ring_attention import _block_attend
 from chainermn_tpu.utils import pvary
 
+#: Finite no-mass sentinel shared with the flash kernel's LSE contract.
+from chainermn_tpu.ops.flash_attention import NEG_INF as _NEG_INF
+
+
+def _merge_flash_block(m, l, o, o_f, lse_f):
+    """Merge a NORMALIZED flash block result ``(o_f, lse_f)`` into the
+    running unnormalized online-softmax state ``(m, l, o)``.
+
+    The block is equivalent to the partial ``(m=lse_f, l=1, acc=o_f)``
+    (``exp(lse_f)·o_f = Σ exp(s)·v``), so the standard two-partial merge
+    applies.  Rows the kernel marked no-mass (``lse = NEG_INF``) contribute
+    nothing — neither output nor normalizer."""
+    alive = lse_f > _NEG_INF * 0.5
+    lse_eff = jnp.where(alive, lse_f, -jnp.inf)
+    m_new = jnp.maximum(m, lse_eff)
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_safe))
+    c2 = jnp.where(alive, jnp.exp(lse_f - m_safe), 0.0)
+    l_new = l * corr + c2
+    o_new = (
+        o * corr.transpose(0, 2, 1)[..., None]
+        + o_f.astype(jnp.float32) * c2.transpose(0, 2, 1)[..., None]
+    )
+    return m_new, l_new, o_new
+
 
 def zigzag_order(S: int) -> np.ndarray:
     """Chunk indices in zigzag order: rank i owns chunks (i, 2S-1-i)."""
@@ -74,6 +99,7 @@ def zigzag_ring_self_attention(
     axis_name,
     remat: bool = True,
     segment_ids=None,
+    impl: str = "einsum",
 ) -> jax.Array:
     """Causal self-attention over a ZIGZAG-sharded sequence.
 
@@ -87,7 +113,15 @@ def zigzag_ring_self_attention(
     ``segment_ids`` is the local ``(B, 2c)`` ZIGZAG-SHARDED slice of the
     packed rows' segments (shard with :func:`zigzag_shard` like q/k/v); the
     k-side slice rotates with its K/V pair so packed documents stay
-    isolated."""
+    isolated.
+
+    ``impl='flash'`` runs each quadrant through the Pallas flash kernel
+    (scores stay in VMEM; the diagonal quadrant uses the kernel's causal
+    mask) and merges the per-quadrant results through their logsumexps —
+    the same composition :func:`ring_flash_self_attention` uses on the
+    plain ring."""
+    if impl not in ("einsum", "flash"):
+        raise ValueError(f"impl={impl!r}: expected 'einsum' or 'flash'")
     B, T2, H, D = q.shape
     if T2 % 2:
         raise ValueError("local zigzag block must hold an even chunk pair")
@@ -120,11 +154,30 @@ def zigzag_ring_self_attention(
                 return seg_mask
             return base[None] & seg_mask
 
-        def full():
-            return _block_attend(qc, kc, vc, m, l, o, combine(None))
+        if impl == "flash":
+            from chainermn_tpu.ops import flash_attention_lse
 
-        def diag():
-            return _block_attend(qc, kc, vc, m, l, o, combine(diag_mask))
+            def _flash(causal):
+                o_f, lse_f = flash_attention_lse(
+                    qc, kc, vc, causal=causal,
+                    segment_ids=sq if segmented else None,
+                    kv_segment_ids=sk if segmented else None,
+                )
+                return _merge_flash_block(m, l, o, o_f, lse_f)
+
+            def full():
+                return _flash(False)
+
+            def diag():
+                return _flash(True)
+        else:
+            def full():
+                return _block_attend(qc, kc, vc, m, l, o, combine(None))
+
+            def diag():
+                return _block_attend(
+                    qc, kc, vc, m, l, o, combine(diag_mask)
+                )
 
         def skip():
             return m, l, o
@@ -198,12 +251,14 @@ def zigzag_ring_self_attention(
     return out.astype(q.dtype)
 
 
-def zigzag_attention(comm, q, k, v, segment_ids=None) -> jax.Array:
+def zigzag_attention(comm, q, k, v, segment_ids=None,
+                     impl: str = "einsum") -> jax.Array:
     """Eager convenience wrapper: CONTIGUOUS global ``(B, T, H, D)`` arrays
     in, causal attention out (contiguous layout restored) — the zigzag
     shuffle, the balanced ring, and the unshuffle in one jitted program,
     sequence-sharded over ``comm``'s axes.  ``segment_ids`` (contiguous
-    global ``(B, T)``) packs documents; it rides the same zigzag shuffle."""
+    global ``(B, T)``) packs documents; it rides the same zigzag shuffle.
+    ``impl='flash'`` runs quadrants through the Pallas kernel."""
     from jax.sharding import PartitionSpec as P
 
     S = comm.size
@@ -215,6 +270,7 @@ def zigzag_attention(comm, q, k, v, segment_ids=None) -> jax.Array:
             return zigzag_ring_self_attention(
                 q, k, v, axis_name=comm.axis_name,
                 segment_ids=seg[0] if seg else None,
+                impl=impl,
             )
 
         inner = comm.spmd(
@@ -236,7 +292,7 @@ def zigzag_attention(comm, q, k, v, segment_ids=None) -> jax.Array:
 
         return jax.jit(run)
 
-    f = comm._jitted(("zigzag_attention", segmented), build)
+    f = comm._jitted(("zigzag_attention", segmented, impl), build)
     if segmented:
         return f(q, k, v, segment_ids)
     return f(q, k, v)

@@ -46,7 +46,8 @@ def test_balanced_schedule():
         assert per_rank[0] == 2 * S + 1
 
 
-def test_matches_full_attention_oracle(devices):
+@pytest.mark.parametrize("impl", ["einsum", "flash"])
+def test_matches_full_attention_oracle(devices, impl):
     comm = cmn.XlaCommunicator(cmn.hybrid_mesh({"seq": 8}, devices=devices))
     B, T, H, D = 2, 64, 2, 16
     rng = np.random.RandomState(0)
@@ -54,14 +55,15 @@ def test_matches_full_attention_oracle(devices):
         jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
         for _ in range(3)
     )
-    got = zigzag_attention(comm, q, k, v)
+    got = zigzag_attention(comm, q, k, v, impl=impl)
     want = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
     )
 
 
-def test_gradients_match_oracle(devices):
+@pytest.mark.parametrize("impl", ["einsum", "flash"])
+def test_gradients_match_oracle(devices, impl):
     comm = cmn.XlaCommunicator(cmn.hybrid_mesh({"seq": 8}, devices=devices))
     B, T, H, D = 1, 32, 2, 8
     rng = np.random.RandomState(1)
@@ -71,7 +73,7 @@ def test_gradients_match_oracle(devices):
     )
 
     def loss_z(q, k, v):
-        return jnp.sum(zigzag_attention(comm, q, k, v) ** 2)
+        return jnp.sum(zigzag_attention(comm, q, k, v, impl=impl) ** 2)
 
     def loss_o(q, k, v):
         return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
@@ -84,7 +86,8 @@ def test_gradients_match_oracle(devices):
         )
 
 
-def test_packed_segments_match_oracle(devices):
+@pytest.mark.parametrize("impl", ["einsum", "flash"])
+def test_packed_segments_match_oracle(devices, impl):
     """Packing through the zigzag schedule: segments ride the same shuffle
     and rotate with K/V — packed documents stay isolated under the
     load-balanced causal layout too."""
@@ -101,7 +104,7 @@ def test_packed_segments_match_oracle(devices):
     seg[1, 11:] += 1
     seg = jnp.asarray(seg)
 
-    got = zigzag_attention(comm, q, k, v, segment_ids=seg)
+    got = zigzag_attention(comm, q, k, v, segment_ids=seg, impl=impl)
     want = reference_attention(q, k, v, causal=True, segment_ids=seg)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
