@@ -141,22 +141,12 @@ def zigzag_ring_self_attention(
         """Attend one (q_chunk, k_chunk) quadrant under the chunk-level
         causal structure; skipped entirely when the quadrant is fully
         masked.  All three cases keep the same static shapes."""
-        rel = jnp.arange(c)[:, None] - jnp.arange(c)[None, :]
-        diag_mask = rel >= 0
-        seg_mask = (
-            sq[:, :, None] == sk[:, None, :] if segmented else None
-        )
-
-        def combine(base):
-            if seg_mask is None:
-                return base
-            if base is None:
-                return seg_mask
-            return base[None] & seg_mask
-
         if impl == "flash":
             from chainermn_tpu.ops import flash_attention_lse
 
+            # Segment masking happens INSIDE the kernel (segment_ids /
+            # kv_segment_ids), causal masking via its causal flag — no
+            # host-built masks in this branch.
             def _flash(causal):
                 o_f, lse_f = flash_attention_lse(
                     qc, kc, vc, causal=causal,
@@ -171,6 +161,19 @@ def zigzag_ring_self_attention(
             def diag():
                 return _flash(True)
         else:
+            rel = jnp.arange(c)[:, None] - jnp.arange(c)[None, :]
+            diag_mask = rel >= 0
+            seg_mask = (
+                sq[:, :, None] == sk[:, None, :] if segmented else None
+            )
+
+            def combine(base):
+                if seg_mask is None:
+                    return base
+                if base is None:
+                    return seg_mask
+                return base[None] & seg_mask
+
             def full():
                 return _block_attend(qc, kc, vc, m, l, o, combine(None))
 
