@@ -47,6 +47,12 @@ class _DecoderBlock(nn.Module):
     d_ff: int
     dtype: Any
     attention: str
+    #: kv heads (grouped-query attention).  Equal to ``n_heads`` (the
+    #: default, and the classic multi-head layout) keeps the fused ``qkv``
+    #: projection and its parameter names; fewer kv heads split the
+    #: projection into ``q`` + ``kv`` and shrink the KV cache by
+    #: ``n_heads // n_kv_heads``.
+    n_kv_heads: int = 0  # 0 → n_heads
 
     @nn.compact
     def __call__(self, h, segment_ids=None, cache=None, decode_pos=None):
@@ -59,9 +65,25 @@ class _DecoderBlock(nn.Module):
 
         T = h.shape[1]
         D, H = self.d_model, self.n_heads
+        KH = self.n_kv_heads or H
+        if not 0 < KH <= H or H % KH:
+            # Fail fast with the real reason — otherwise the decode path
+            # surfaces this as an opaque reshape error inside the scan.
+            raise ValueError(
+                f"n_kv_heads ({KH}) must divide n_heads ({H})"
+            )
         x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
-        qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if KH == H:
+            qkv = nn.DenseGeneral(
+                (3, H, D // H), dtype=self.dtype, name="qkv"
+            )(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            q = nn.DenseGeneral((H, D // H), dtype=self.dtype, name="q")(x)
+            kv = nn.DenseGeneral(
+                (2, KH, D // H), dtype=self.dtype, name="kv"
+            )(x)
+            k, v = kv[:, :, 0], kv[:, :, 1]
         if cache is not None:
             # Incremental: write this chunk's k/v at decode_pos (T=1 per
             # generation step; T=P for the batched prompt prefill), attend
@@ -90,19 +112,25 @@ class _DecoderBlock(nn.Module):
                 kc = cache["k"].at[jnp.arange(B), decode_pos].set(k[:, 0])
                 vc = cache["v"].at[jnp.arange(B), decode_pos].set(v[:, 0])
                 q_pos = decode_pos[:, None]  # (B, 1)
+            # Grouped attention against the (B, L, KH, Dh) cache: query head
+            # h reads kv head h // (H // KH).  KH == H reduces to classic
+            # multi-head (group axis of size 1).
+            G = H // KH
+            qg = q.reshape(q.shape[0], T, KH, G, D // H)
             s = jnp.einsum(
-                "bqhd,bthd->bhqt", q.astype(jnp.float32),
+                "bqkgd,btkd->bkgqt", qg.astype(jnp.float32),
                 kc.astype(jnp.float32),
             ) / math.sqrt(D // H)
             t_idx = jnp.arange(kc.shape[1])
             s = jnp.where(
-                t_idx[None, None, None, :] <= q_pos[:, None, :, None],
+                t_idx[None, None, None, None, :]
+                <= q_pos[:, None, None, :, None],
                 s, -1e30,
             )
             p = jax.nn.softmax(s, axis=-1)
             a = jnp.einsum(
-                "bhqt,bthd->bqhd", p, vc.astype(jnp.float32)
-            ).astype(q.dtype)
+                "bkgqt,btkd->bqkgd", p, vc.astype(jnp.float32)
+            ).reshape(q.shape[0], T, H, D // H).astype(q.dtype)
             new_cache = {"k": kc, "v": vc}
         elif self.attention == "flash":
             # Library-default blocks: largest sweep-winning power-of-2
@@ -142,6 +170,10 @@ class TransformerLM(nn.Module):
     #: "flash" (Pallas kernel) or "xla" (materialized-scores oracle) — the
     #: switch the LM benchmark uses to measure the kernel's end-to-end value.
     attention: str = "flash"
+    #: kv heads for grouped-query attention (0 → ``n_heads``, classic MHA;
+    #: 1 → multi-query).  Must divide ``n_heads``; shrinks the generation
+    #: KV cache (and the k/v projection) by ``n_heads // n_kv_heads``.
+    n_kv_heads: int = 0
     #: Rematerialize each block in the backward pass (``jax.checkpoint``):
     #: activation memory drops from O(n_layers) residuals+intermediates to
     #: O(n_layers) residuals only, for one extra forward of compute — the
@@ -201,7 +233,7 @@ class TransformerLM(nn.Module):
             blk = block_cls(
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
                 dtype=self.dtype, attention=self.attention,
-                name=f"block_{i}",
+                n_kv_heads=self.n_kv_heads, name=f"block_{i}",
             )
             if cache is not None:
                 h, c = blk(h, None, cache[i], decode_pos)
@@ -216,9 +248,13 @@ class TransformerLM(nn.Module):
 
     def init_cache(self, batch: int, max_len: int = None):
         """Zeroed KV cache: per layer ``{"k","v"}`` of shape
-        ``(batch, max_len, heads, head_dim)`` in the compute dtype."""
+        ``(batch, max_len, kv_heads, head_dim)`` in the compute dtype —
+        ``n_heads // n_kv_heads``-fold smaller under grouped-query
+        attention (the main GQA payoff: longer contexts / bigger decode
+        batches fit in HBM)."""
         L = max_len or self.max_len
-        shape = (batch, L, self.n_heads, self.d_model // self.n_heads)
+        kvh = self.n_kv_heads or self.n_heads
+        shape = (batch, L, kvh, self.d_model // self.n_heads)
         return [
             {"k": jnp.zeros(shape, self.dtype),
              "v": jnp.zeros(shape, self.dtype)}

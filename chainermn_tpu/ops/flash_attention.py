@@ -47,7 +47,9 @@ def reference_attention(q, k, v, causal: bool = False,
     implementation, so the CPU interpret tests and the on-chip harness can
     never validate against diverging references).  Computed in fp32, cast
     back to the input dtype.  ``k``/``v`` may have a different length
-    (cross-attention; ``causal`` then requires equal lengths)."""
+    (cross-attention; ``causal`` then requires equal lengths) and fewer
+    heads than ``q`` (grouped-query attention; ``q`` heads must be a
+    multiple of kv heads)."""
     return _reference_attention_lse(
         q, k, v, causal, segment_ids, kv_segment_ids
     )[0]
@@ -71,6 +73,16 @@ def _reference_attention_lse(q, k, v, causal: bool = False,
             "cross-attention with segment_ids needs explicit "
             "kv_segment_ids (kv length differs from q)"
         )
+    kv_heads = k.shape[2]
+    if kv_heads != H:
+        if H % kv_heads:
+            raise ValueError(
+                f"q heads {H} must be a multiple of kv heads {kv_heads}"
+            )
+        # GQA expansion in the oracle only — the kernel streams shared kv
+        # blocks via its index maps instead of materializing the repeat.
+        k = jnp.repeat(k, H // kv_heads, axis=2)
+        v = jnp.repeat(v, H // kv_heads, axis=2)
     qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
     kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
     vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
@@ -183,8 +195,19 @@ def _vma_union(*arrays):
         out |= getattr(jax.typeof(a), "vma", frozenset())
     return out
 
-def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q, block_k,
-         interpret):
+def _kv_row(heads: int, kv_heads: int):
+    """Flattened ``(batch·q_head) → (batch·kv_head)`` row map for GQA: query
+    head ``h`` reads kv head ``h // group`` (consecutive query heads share).
+    Identity when the head counts match (the common path compiles away the
+    arithmetic: ``group == 1``)."""
+    group = heads // kv_heads
+    if group == 1:
+        return lambda b: b
+    return lambda b: (b // heads) * kv_heads + (b % heads) // group
+
+
+def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal, block_q,
+         block_k, interpret):
     BH, T, D = q.shape
     S = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -193,10 +216,11 @@ def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q, block_k,
         _fwd_kernel, block_k=block_k, causal=causal, segmented=segmented,
         scale=scale,
     )
+    kvr = _kv_row(heads, kv_heads)
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, S, D), lambda b, i: (kvr(b), 0, 0)),
+        pl.BlockSpec((1, S, D), lambda b, i: (kvr(b), 0, 0)),
     ]
     args = [q, k, v]
     if segmented:
@@ -365,29 +389,37 @@ def _bwd_dq_kernel(
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
-         g, dlse=None):
+def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
+         residuals, g, dlse=None):
     """Shared backward.  ``dlse`` (cotangent of the logsumexp output, used by
     the LSE-exposing API) folds into the kernels for free: ``∂lse_i/∂s_ij =
     p_ij``, so the lse cotangent just shifts the per-row delta —
-    ``ds = p·(dp − (delta − dlse))`` — and both kernels run unchanged."""
+    ``ds = p·(dp − (delta − dlse))`` — and both kernels run unchanged.
+
+    Under GQA (``kv_heads < heads``) the dK/dV kernel still writes one
+    gradient row per QUERY head (reading the shared kv row through the same
+    index map as the forward); the group sum down to ``kv_heads`` rows is a
+    single fused XLA reduction afterwards — the kernels never need a
+    revisited-output accumulation pattern."""
     q, k, v, seg_q, seg_kv, o, lse = residuals
     do = g
     BH, T, D = q.shape
     S = k.shape[1]
+    group = heads // kv_heads
     scale = 1.0 / math.sqrt(D)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
 
+    kvr = _kv_row(heads, kv_heads)
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, block_q=block_q, causal=causal,
         segmented=segmented, scale=scale,
     )
     in_specs = [
         pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # q
-        pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # k
-        pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # v
+        pl.BlockSpec((1, block_k, D), lambda b, i: (kvr(b), i, 0)),  # k
+        pl.BlockSpec((1, block_k, D), lambda b, i: (kvr(b), i, 0)),  # v
         pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # do
         pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),       # lse
         pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),       # delta
@@ -403,6 +435,12 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
         args += [seg_q[..., None], seg_kv[..., None]]
     vma = _vma_union(q, k, v, do, lse, delta,
                      *([seg_q, seg_kv] if segmented else []))
+    # Under GQA the per-query-head partials leave the kernel in fp32 (the
+    # kernel accumulates fp32 anyway) so the group sum adds unrounded
+    # addends; the transient 2× gradient buffer only exists when group > 1.
+    dkv_dtypes = (
+        (jnp.float32, jnp.float32) if group > 1 else (k.dtype, v.dtype)
+    )
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, S // block_k),
@@ -412,11 +450,22 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, S, D), dkv_dtypes[0], vma=vma),
+            jax.ShapeDtypeStruct((BH, S, D), dkv_dtypes[1], vma=vma),
         ],
         interpret=interpret,
     )(*args)
+    if group > 1:
+        # Per-query-head kv gradients → per-kv-head (sum over each group of
+        # consecutive query heads) in fp32, rounded once at the end.
+        B = BH // heads
+
+        def group_sum(d, dtype):
+            d = d.reshape(B, kv_heads, group, S, D)
+            return d.sum(axis=2).reshape(B * kv_heads, S, D).astype(dtype)
+
+        dk = group_sum(dk, k.dtype)
+        dv = group_sum(dv, v.dtype)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, block_k=block_k, causal=causal,
@@ -424,8 +473,8 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
-        pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),        # k
-        pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),        # v
+        pl.BlockSpec((1, S, D), lambda b, i: (kvr(b), 0, 0)),   # k
+        pl.BlockSpec((1, S, D), lambda b, i: (kvr(b), 0, 0)),   # v
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do
         pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # lse
         pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # delta
@@ -451,25 +500,25 @@ def _bwd(segmented, heads, causal, block_q, block_k, interpret, residuals,
 
 
 # --------------------------------------------------------------------- api
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash_lse(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q,
-               block_k, interpret):
-    return _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q,
-                block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_lse(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
+               block_q, block_k, interpret):
+    return _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
+                block_q, block_k, interpret)
 
 
-def _flash_lse_fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal,
-                   block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, seg_q, seg_kv, segmented, heads, causal, block_q,
-                  block_k, interpret)
+def _flash_lse_fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads,
+                   causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
+                  block_q, block_k, interpret)
     return (o, lse), (q, k, v, seg_q, seg_kv, o, lse)
 
 
-def _flash_lse_bwd(segmented, heads, causal, block_q, block_k, interpret,
-                   residuals, g):
+def _flash_lse_bwd(segmented, heads, kv_heads, causal, block_q, block_k,
+                   interpret, residuals, g):
     do, dlse = g
-    dq, dk, dv = _bwd(segmented, heads, causal, block_q, block_k, interpret,
-                      residuals, do, dlse=dlse)
+    dq, dk, dv = _bwd(segmented, heads, kv_heads, causal, block_q, block_k,
+                      interpret, residuals, do, dlse=dlse)
     # Segments are integer-typed: their cotangent is the symbolic zero.
     return dq, dk, dv, None, None
 
@@ -513,15 +562,27 @@ def flash_attention_lse(
     Differentiable in both outputs.
 
     ``k``/``v`` may be a different length than ``q`` (cross-attention);
-    ``causal`` then requires equal lengths.  ``kv_segment_ids`` (``(B, S)``)
-    masks keys independently of the query segments — give pad keys an id no
-    query uses; defaults to ``segment_ids`` (self-attention packing)."""
+    ``causal`` then requires equal lengths.  They may also carry FEWER heads
+    than ``q`` (grouped-query / multi-query attention, inferred from the
+    shapes): query head ``h`` attends through kv head ``h // group`` where
+    ``group = q_heads // kv_heads``.  The kernels stream each shared kv
+    block once per query head via their index maps — no repeated kv copy is
+    materialized in HBM — and dK/dV group-sum in fp32.  ``kv_segment_ids``
+    (``(B, S)``) masks keys independently of the query segments — give pad
+    keys an id no query uses; defaults to ``segment_ids`` (self-attention
+    packing)."""
     B, T, H, D = q.shape
     S = k.shape[1]
-    if k.shape != (B, S, H, D) or v.shape != (B, S, H, D):
+    KH = k.shape[2] if k.ndim == 4 else H
+    if k.shape != (B, S, KH, D) or v.shape != (B, S, KH, D):
         raise ValueError(
-            f"k/v must be (B, S, H, D) = ({B}, S, {H}, {D}); got "
+            f"k/v must be (B, S, kv_heads, D) = ({B}, S, *, {D}); got "
             f"{k.shape} / {v.shape}"
+        )
+    if KH != H and (KH == 0 or H % KH):
+        raise ValueError(
+            f"q heads {H} must be a multiple of kv heads {KH} "
+            "(grouped-query attention)"
         )
     if causal and S != T:
         raise ValueError(
@@ -574,8 +635,8 @@ def flash_attention_lse(
         )
 
     def to_bh(x):
-        L = x.shape[1]
-        return x.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+        _, L, Hx, _ = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(B * Hx, L, D)
 
     # Segments stay (B, T)/(B, S): the kernels' index maps read row b // H,
     # so every head shares one copy (no H-fold materialization).
@@ -585,8 +646,8 @@ def flash_attention_lse(
     else:
         seg_q = seg_kv = jnp.zeros((1, 1), jnp.int32)  # unused placeholder
     o, lse = _flash_lse(
-        to_bh(q), to_bh(k), to_bh(v), seg_q, seg_kv, segmented, H, causal,
-        block_q, block_k, interpret,
+        to_bh(q), to_bh(k), to_bh(v), seg_q, seg_kv, segmented, H, KH,
+        causal, block_q, block_k, interpret,
     )
     return (
         o.reshape(B, H, T, D).transpose(0, 2, 1, 3),
