@@ -53,6 +53,10 @@ class _DecoderBlock(nn.Module):
     #: projection into ``q`` + ``kv`` and shrink the KV cache by
     #: ``n_heads // n_kv_heads``.
     n_kv_heads: int = 0  # 0 → n_heads
+    #: sliding-window size (0 → full attention): each position attends the
+    #: last ``window`` positions only; the flash kernel skips out-of-window
+    #: blocks (O(T·window) attention compute).
+    window: int = 0
 
     @nn.compact
     def __call__(self, h, segment_ids=None, cache=None, decode_pos=None):
@@ -72,6 +76,11 @@ class _DecoderBlock(nn.Module):
             raise ValueError(
                 f"n_kv_heads ({KH}) must divide n_heads ({H})"
             )
+        if self.window < 0:
+            # A negative window would mask EVERY pair on the xla/decode
+            # paths — softmax over all-NEG_INF rows degenerates to uniform
+            # (causality-violating) weights with no error.
+            raise ValueError(f"window must be >= 0, got {self.window}")
         x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
         if KH == H:
             qkv = nn.DenseGeneral(
@@ -122,11 +131,18 @@ class _DecoderBlock(nn.Module):
                 kc.astype(jnp.float32),
             ) / math.sqrt(D // H)
             t_idx = jnp.arange(kc.shape[1])
-            s = jnp.where(
+            visible = (
                 t_idx[None, None, None, None, :]
-                <= q_pos[:, None, None, :, None],
-                s, -1e30,
+                <= q_pos[:, None, None, :, None]
             )
+            if self.window:
+                # Decode twin of the training-time sliding window: only the
+                # last `window` positions stay attendable.
+                visible &= (
+                    t_idx[None, None, None, None, :]
+                    > q_pos[:, None, None, :, None] - self.window
+                )
+            s = jnp.where(visible, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             a = jnp.einsum(
                 "bkgqt,btkd->bqkgd", p, vc.astype(jnp.float32)
@@ -139,10 +155,12 @@ class _DecoderBlock(nn.Module):
             block = None
             a = flash_attention(q, k, v, causal=True,
                                 segment_ids=segment_ids, block_q=block,
-                                block_k=block)
+                                block_k=block,
+                                window=self.window or None)
         elif self.attention == "xla":
             a = reference_attention(
-                q, k, v, causal=True, segment_ids=segment_ids
+                q, k, v, causal=True, segment_ids=segment_ids,
+                window=self.window or None,
             ).astype(q.dtype)
         else:
             raise ValueError(
@@ -174,6 +192,11 @@ class TransformerLM(nn.Module):
     #: 1 → multi-query).  Must divide ``n_heads``; shrinks the generation
     #: KV cache (and the k/v projection) by ``n_heads // n_kv_heads``.
     n_kv_heads: int = 0
+    #: sliding-window attention size (0 → full): each position attends only
+    #: the previous ``window`` positions, in training (flash kernel skips
+    #: out-of-window blocks — O(T·window)) AND in KV-cache decode (same
+    #: mask, so generation bit-matches training semantics).
+    window: int = 0
     #: Rematerialize each block in the backward pass (``jax.checkpoint``):
     #: activation memory drops from O(n_layers) residuals+intermediates to
     #: O(n_layers) residuals only, for one extra forward of compute — the
@@ -233,7 +256,8 @@ class TransformerLM(nn.Module):
             blk = block_cls(
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
                 dtype=self.dtype, attention=self.attention,
-                n_kv_heads=self.n_kv_heads, name=f"block_{i}",
+                n_kv_heads=self.n_kv_heads, window=self.window,
+                name=f"block_{i}",
             )
             if cache is not None:
                 h, c = blk(h, None, cache[i], decode_pos)

@@ -41,7 +41,8 @@ def _use_interpret() -> bool:
 
 
 def reference_attention(q, k, v, causal: bool = False,
-                        segment_ids=None, kv_segment_ids=None) -> jax.Array:
+                        segment_ids=None, kv_segment_ids=None,
+                        window=None) -> jax.Array:
     """Plain-XLA softmax attention over ``(B, T, H, D)`` — the single
     correctness oracle every flash test/benchmark compares against (one
     implementation, so the CPU interpret tests and the on-chip harness can
@@ -49,14 +50,16 @@ def reference_attention(q, k, v, causal: bool = False,
     back to the input dtype.  ``k``/``v`` may have a different length
     (cross-attention; ``causal`` then requires equal lengths) and fewer
     heads than ``q`` (grouped-query attention; ``q`` heads must be a
-    multiple of kv heads)."""
+    multiple of kv heads).  ``window`` masks to ``|q - k| < window``
+    (sliding-window / local attention)."""
     return _reference_attention_lse(
-        q, k, v, causal, segment_ids, kv_segment_ids
+        q, k, v, causal, segment_ids, kv_segment_ids, window
     )[0]
 
 
 def _reference_attention_lse(q, k, v, causal: bool = False,
-                             segment_ids=None, kv_segment_ids=None):
+                             segment_ids=None, kv_segment_ids=None,
+                             window=None):
     """:func:`reference_attention` + per-row logsumexp ``(B, H, T)`` — the
     XLA twin of :func:`flash_attention_lse` (used as its vma-checked
     interpret-mode fallback)."""
@@ -83,6 +86,14 @@ def _reference_attention_lse(q, k, v, causal: bool = False,
         # blocks via its index maps instead of materializing the repeat.
         k = jnp.repeat(k, H // kv_heads, axis=2)
         v = jnp.repeat(v, H // kv_heads, axis=2)
+    if window is not None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if S != T:
+            raise ValueError(
+                f"sliding-window attention needs equal q/kv lengths, got "
+                f"{T} vs {S}"
+            )
     qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
     kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
     vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
@@ -90,6 +101,13 @@ def _reference_attention_lse(q, k, v, causal: bool = False,
     if causal:
         mask = jnp.tril(jnp.ones((T, S), bool))
         s = jnp.where(mask, s, NEG_INF)
+    if window is not None:
+        # |q - k| < window (non-causal) / q - window < k <= q (causal — the
+        # upper side is the causal mask above).
+        qi = jnp.arange(T)[:, None]
+        ki = jnp.arange(S)[None, :]
+        local = (qi - ki < window) & (ki - qi < window)
+        s = jnp.where(local, s, NEG_INF)
     if segment_ids is not None or kv_segment_ids is not None:
         if segment_ids is None:
             segment_ids = jnp.zeros((B, T), jnp.int32)
@@ -108,9 +126,63 @@ def _reference_attention_lse(q, k, v, causal: bool = False,
     return o.transpose(0, 2, 1, 3).astype(q.dtype), lse
 
 
+# ----------------------------------------------------------- shared masks
+# One definition each for the causal/window position masks and the
+# block-skipping loop bounds: the forward and both backward kernels must
+# agree on these EXACTLY or gradients silently diverge from the forward.
+
+def _mask_scores(s, q0, k0, causal, window):
+    """Apply causal (``q >= k``) and sliding-window (``|q - k| < window``)
+    masks to a score block whose rows start at absolute q position ``q0``
+    and columns at k position ``k0``."""
+    if not causal and window is None:
+        return s
+    bq, bk = s.shape
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if causal:
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if window is not None:
+        local = (q_pos - k_pos < window) & (k_pos - q_pos < window)
+        s = jnp.where(local, s, NEG_INF)
+    return s
+
+
+def _k_block_range(qi, bq, block_k, n_k, causal, window):
+    """``[k_lo, k_hi)`` kv-block bounds visited by the q block starting at
+    ``qi * bq`` (forward and dQ kernels).  Blocks fully outside the causal
+    triangle or the window are skipped, not just masked."""
+    last_q = (qi + 1) * bq - 1
+    if causal:
+        k_hi = jnp.minimum((last_q // block_k) + 1, n_k)
+    elif window is not None:
+        k_hi = jnp.minimum((last_q + window - 1) // block_k + 1, n_k)
+    else:
+        k_hi = n_k
+    if window is not None:
+        k_lo = jnp.maximum((qi * bq - window + 1) // block_k, 0)
+    else:
+        k_lo = 0
+    return k_lo, k_hi
+
+
+def _q_block_range(ki, bk, block_q, n_q, causal, window):
+    """``[q_lo, q_hi)`` q-block bounds visited by the kv block starting at
+    ``ki * bk`` (dK/dV kernel) — the transpose of :func:`_k_block_range`."""
+    first_k = ki * bk
+    q_lo = first_k // block_q if causal else 0
+    q_hi = n_q
+    if window is not None:
+        # q >= k_first - window + 1 and q <= k_last + window - 1.
+        q_lo = jnp.maximum(q_lo, (first_k - window + 1) // block_q)
+        q_lo = jnp.maximum(q_lo, 0)
+        q_hi = jnp.minimum((first_k + bk - 1 + window - 1) // block_q + 1, n_q)
+    return q_lo, q_hi
+
+
 # --------------------------------------------------------------------- fwd
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
-                block_k, causal, segmented, scale):
+                block_k, causal, segmented, scale, window=None):
     # q_ref: (1, BQ, D); k/v_ref: (1, T, D); o_ref: (1, BQ, D).
     # Per-row refs (lse, segments) carry a trailing singleton lane dim —
     # (1, BQ, 1) / (1, T, 1) — because Mosaic requires each block's last two
@@ -128,12 +200,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     seg_q = segq_ref[0, :, 0] if segmented else None  # (BQ,)
 
     n_k = T // block_k
-    if causal:
-        # Only blocks whose first position <= this q block's last position.
-        last_q = (qi + 1) * bq - 1
-        n_k_eff = jnp.minimum((last_q // block_k) + 1, n_k)
-    else:
-        n_k_eff = n_k
+    k_lo, n_k_eff = _k_block_range(qi, bq, block_k, n_k, causal, window)
 
     def body(ki, carry):
         m, l, acc = carry
@@ -143,14 +210,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _mask_scores(s, qi * bq, ki * block_k, causal, window)
         if segmented:
             seg_k = segk_ref[0, pl.ds(ki * block_k, block_k), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
@@ -168,7 +228,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_k_eff, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(k_lo, n_k_eff, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     # A fully-masked row (every key NEG_INF — e.g. a query segment with no
     # matching kv id) leaves m at NEG_INF; the finite-NEG_INF rescue would
@@ -207,14 +267,14 @@ def _kv_row(heads: int, kv_heads: int):
 
 
 def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal, block_q,
-         block_k, interpret):
+         block_k, interpret, window=None):
     BH, T, D = q.shape
     S = k.shape[1]
     scale = 1.0 / math.sqrt(D)
     grid = (BH, T // block_q)
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, causal=causal, segmented=segmented,
-        scale=scale,
+        scale=scale, window=window,
     )
     kvr = _kv_row(heads, kv_heads)
     in_specs = [
@@ -255,7 +315,7 @@ def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal, block_q,
 # --------------------------------------------------------------------- bwd
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, causal, segmented, scale,
+    block_q, causal, segmented, scale, window=None,
 ):
     # k/v_ref, dk/dv_ref: (1, BK, D); q/do_ref: (1, T, D); per-row refs
     # (lse/delta/segments) carry the trailing singleton lane dim (1, T, 1).
@@ -272,11 +332,9 @@ def _bwd_dkv_kernel(
     seg_k = segk_ref[0, :, 0] if segmented else None  # (BK,)
 
     n_q = T // block_q
-    if causal:
-        first_k = ki * bk
-        q_start_blk = first_k // block_q  # first q block that can see us
-    else:
-        q_start_blk = 0
+    q_start_blk, q_end_blk = _q_block_range(
+        ki, bk, block_q, n_q, causal, window
+    )
 
     def body(qi, carry):
         dk, dv = carry
@@ -288,14 +346,7 @@ def _bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0
-            )
-            k_pos = ki * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _mask_scores(s, qi * block_q, ki * bk, causal, window)
         if segmented:
             seg_q = segq_ref[0, pl.ds(qi * block_q, block_q), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
@@ -322,7 +373,7 @@ def _bwd_dkv_kernel(
 
     dk0 = jnp.zeros((bk, D), jnp.float32)
     dv0 = jnp.zeros((bk, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(q_start_blk, n_q, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(q_start_blk, q_end_blk, body, (dk0, dv0))
     # dk = dsᵀ·(q·scale): the softmax scale flows in through the scaled q.
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -330,7 +381,7 @@ def _bwd_dkv_kernel(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_k, causal, segmented, scale,
+    block_k, causal, segmented, scale, window=None,
 ):
     if segmented:
         segq_ref, segk_ref, dq_ref = rest
@@ -347,11 +398,7 @@ def _bwd_dq_kernel(
     seg_q = segq_ref[0, :, 0] if segmented else None  # (BQ,)
 
     n_k = T // block_k
-    if causal:
-        last_q = (qi + 1) * bq - 1
-        n_k_eff = jnp.minimum((last_q // block_k) + 1, n_k)
-    else:
-        n_k_eff = n_k
+    k_lo, n_k_eff = _k_block_range(qi, bq, block_k, n_k, causal, window)
 
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
@@ -360,14 +407,7 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _mask_scores(s, qi * bq, ki * block_k, causal, window)
         if segmented:
             seg_k = segk_ref[0, pl.ds(ki * block_k, block_k), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
@@ -385,12 +425,12 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    dq = jax.lax.fori_loop(0, n_k_eff, body, jnp.zeros((bq, D), jnp.float32))
+    dq = jax.lax.fori_loop(k_lo, n_k_eff, body, jnp.zeros((bq, D), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
-         residuals, g, dlse=None):
+         residuals, g, dlse=None, window=None):
     """Shared backward.  ``dlse`` (cotangent of the logsumexp output, used by
     the LSE-exposing API) folds into the kernels for free: ``∂lse_i/∂s_ij =
     p_ij``, so the lse cotangent just shifts the per-row delta —
@@ -414,7 +454,7 @@ def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
     kvr = _kv_row(heads, kv_heads)
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, block_q=block_q, causal=causal,
-        segmented=segmented, scale=scale,
+        segmented=segmented, scale=scale, window=window,
     )
     in_specs = [
         pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # q
@@ -437,7 +477,9 @@ def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
                      *([seg_q, seg_kv] if segmented else []))
     # Under GQA the per-query-head partials leave the kernel in fp32 (the
     # kernel accumulates fp32 anyway) so the group sum adds unrounded
-    # addends; the transient 2× gradient buffer only exists when group > 1.
+    # addends.  Transient HBM cost: dk/dv are (B·heads, S, D) fp32 before
+    # the reduction — i.e. group × (and × 2 vs a bf16 wire) the size of the
+    # final (B·kv_heads, S, D) gradients.
     dkv_dtypes = (
         (jnp.float32, jnp.float32) if group > 1 else (k.dtype, v.dtype)
     )
@@ -469,7 +511,7 @@ def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, block_k=block_k, causal=causal,
-        segmented=segmented, scale=scale,
+        segmented=segmented, scale=scale, window=window,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
@@ -500,25 +542,27 @@ def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
 
 
 # --------------------------------------------------------------------- api
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12)
+)
 def _flash_lse(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
-               block_q, block_k, interpret):
+               block_q, block_k, interpret, window):
     return _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
-                block_q, block_k, interpret)
+                block_q, block_k, interpret, window=window)
 
 
 def _flash_lse_fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads,
-                   causal, block_q, block_k, interpret):
+                   causal, block_q, block_k, interpret, window):
     o, lse = _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
-                  block_q, block_k, interpret)
+                  block_q, block_k, interpret, window=window)
     return (o, lse), (q, k, v, seg_q, seg_kv, o, lse)
 
 
 def _flash_lse_bwd(segmented, heads, kv_heads, causal, block_q, block_k,
-                   interpret, residuals, g):
+                   interpret, window, residuals, g):
     do, dlse = g
     dq, dk, dv = _bwd(segmented, heads, kv_heads, causal, block_q, block_k,
-                      interpret, residuals, do, dlse=dlse)
+                      interpret, residuals, do, dlse=dlse, window=window)
     # Segments are integer-typed: their cotangent is the symbolic zero.
     return dq, dk, dv, None, None
 
@@ -551,6 +595,7 @@ def flash_attention_lse(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ):
     """Like :func:`flash_attention` but also returns the per-row logsumexp
     ``(B, H, T)`` — the merge state for blockwise/ring composition: two
@@ -588,6 +633,14 @@ def flash_attention_lse(
         raise ValueError(
             f"causal attention needs equal q/kv lengths, got {T} vs {S}"
         )
+    if window is not None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if S != T:
+            raise ValueError(
+                f"sliding-window attention needs equal q/kv lengths, got "
+                f"{T} vs {S}"
+            )
     if interpret is None:
         interpret = _use_interpret()
     # Sweep-informed defaults (see _default_block); explicit args win.
@@ -631,7 +684,7 @@ def flash_attention_lse(
         # mathematically identical XLA form instead; the compiled kernel is
         # unaffected (opaque to the checker).
         return _reference_attention_lse(
-            q, k, v, causal, segment_ids, kv_segment_ids
+            q, k, v, causal, segment_ids, kv_segment_ids, window
         )
 
     def to_bh(x):
@@ -647,7 +700,7 @@ def flash_attention_lse(
         seg_q = seg_kv = jnp.zeros((1, 1), jnp.int32)  # unused placeholder
     o, lse = _flash_lse(
         to_bh(q), to_bh(k), to_bh(v), seg_q, seg_kv, segmented, H, KH,
-        causal, block_q, block_k, interpret,
+        causal, block_q, block_k, interpret, window,
     )
     return (
         o.reshape(B, H, T, D).transpose(0, 2, 1, 3),
@@ -665,6 +718,7 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention over ``(batch, seq, heads, head_dim)`` inputs; ``k``/
     ``v`` may use a different sequence length (cross-attention, non-causal).
@@ -680,11 +734,18 @@ def flash_attention(
     explicit values to override.  Differentiable via the flash backward.
     ``interpret=None`` auto-selects interpret mode off-TPU.
 
+    ``window`` enables sliding-window (local) attention: query ``i``
+    attends only keys with ``|i - k| < window`` (with ``causal`` the usual
+    Mistral-style "last ``window`` keys").  The kernels SKIP key/query
+    blocks entirely outside the window, so compute and HBM reads scale
+    O(T·window) instead of O(T²) — combine with ``segment_ids`` for packed
+    local attention.
+
     Thin facade over :func:`flash_attention_lse` (one custom-VJP path to
     maintain); the dropped lse output arrives in the backward as a zero
     cotangent, which folds away inside the shared kernels."""
     return flash_attention_lse(
         q, k, v, causal=causal, segment_ids=segment_ids,
         kv_segment_ids=kv_segment_ids, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, window=window,
     )[0]

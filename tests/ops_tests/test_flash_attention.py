@@ -375,3 +375,70 @@ def test_flash_gqa_head_count_validated():
     kv = rng.normal(size=(1, 32, 3, 16)).astype(np.float32)
     with pytest.raises(ValueError, match="multiple of kv heads"):
         flash_attention(q, kv, kv, block_q=32, block_k=32)
+
+
+# ------------------------------------------------------------ sliding window
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("window", [1, 16, 100, 1000])
+def test_flash_window_matches_oracle(causal, window):
+    """Sliding-window (local) attention: |q - k| < window, block-skipping
+    loop bounds in all three kernels.  window >= T degenerates to full."""
+    q, k, v = _qkv(np.random.RandomState(11), T=128)
+    out = flash_attention(q, k, v, causal=causal, window=window,
+                          block_q=32, block_k=32)
+    ref = reference_attention(q, k, v, causal=causal, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_window_gradients_match_oracle(causal):
+    q, k, v = _qkv(np.random.RandomState(12), B=1, T=96, H=2, D=16)
+    probe = jnp.asarray(
+        np.random.RandomState(13).normal(size=q.shape).astype(np.float32)
+    )
+
+    def loss(qkv, fn):
+        return jnp.sum(fn(*qkv) * probe)
+
+    g = jax.grad(loss)(
+        (q, k, v),
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, window=24, block_q=32, block_k=32),
+    )
+    og = jax.grad(loss)(
+        (q, k, v),
+        lambda q, k, v: reference_attention(q, k, v, causal=causal,
+                                            window=24),
+    )
+    for name, a, b in zip("qkv", g, og):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-3,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_window_composes_with_gqa_and_segments():
+    rng = np.random.RandomState(14)
+    B, T, H, KH, D = 2, 96, 4, 2, 16
+    q = (rng.normal(size=(B, T, H, D)) * 0.6).astype(np.float32)
+    k = (rng.normal(size=(B, T, KH, D)) * 0.6).astype(np.float32)
+    v = (rng.normal(size=(B, T, KH, D)) * 0.6).astype(np.float32)
+    seg = np.repeat(np.arange(3)[None], B, 0).repeat(T // 3, 1).astype(np.int32)
+    out = flash_attention(q, k, v, causal=True, window=20,
+                          segment_ids=jnp.asarray(seg),
+                          block_q=32, block_k=32)
+    ref = reference_attention(q, k, v, causal=True, window=20,
+                              segment_ids=jnp.asarray(seg))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4
+    )
+
+
+def test_flash_window_validation():
+    q, k, v = _qkv(np.random.RandomState(15), T=64)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        flash_attention(q, k, v, window=0)
+    with pytest.raises(ValueError, match="equal q/kv lengths"):
+        flash_attention(q, k[:, :32], v[:, :32], window=8)
