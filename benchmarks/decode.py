@@ -74,11 +74,16 @@ def main():
     )
 
     gen = jax.jit(lambda p, pr: lm_generate(model, p, pr, args.new))
-    out_tokens = jax.block_until_ready(gen(params, prompt))  # compile+warm
+    out_tokens = gen(params, prompt)
+    np.asarray(out_tokens)  # compile + warm, synced by materialization
+    # Sync each iteration with a real device->host readback: over the axon
+    # tunnel `block_until_ready` can return EARLY on queued steps (observed
+    # here as ms_per_gen_step 0.0 => a 22M tok/s fantasy); a value transfer
+    # cannot lie.  Same policy as bench.py.
     t0 = time.perf_counter()
     for _ in range(args.iters):
         out_tokens = gen(params, prompt)
-    jax.block_until_ready(out_tokens)
+        _ = np.asarray(out_tokens[:1, -1:])
     dt = time.perf_counter() - t0
 
     # Batched prefill = ONE forward; the sequential part is the n_new-1

@@ -181,46 +181,88 @@ class MultiNodeCheckpointer(Extension):
 
         new_state = jax.tree_util.tree_map(_replace, new_state, state)
         loop = restored["loop"]
-        if trainer is not None:
-            trainer.state = new_state
-            trainer.iteration = int(loop["iteration"])
-            it = trainer.train_iter
-            if hasattr(it, "restore_loop_state") and "it_order" in loop:
-                it.restore_loop_state(
-                    int(loop["epoch"]),
-                    {
-                        "pos": int(loop["it_pos"]),
-                        "order": loop["it_order"],
-                        "rng_keys": loop["rng_keys"],
-                        "rng_pos": int(loop["rng_pos"]),
-                        "rng_has_gauss": int(loop["rng_has_gauss"]),
-                        "rng_cached": float(loop["rng_cached"]),
-                    },
-                )
-            else:
-                if hasattr(it, "epoch"):
-                    it.epoch = int(loop["epoch"])
-                if hasattr(it, "_pos"):
-                    it._pos = int(loop["it_pos"])
-                if "it_order" in loop and hasattr(it, "_order"):
-                    it._order = np.asarray(loop["it_order"]).astype(np.int64)
-                    it._rng.set_state((
-                        "MT19937",
-                        np.asarray(loop["rng_keys"]).astype(np.uint32),
-                        int(loop["rng_pos"]),
-                        int(loop["rng_has_gauss"]),
-                        float(loop["rng_cached"]),
-                    ))
-            # Sync trigger state so interval extensions don't all re-fire on
-            # the first post-resume iteration (which would burn a retention
-            # slot on a duplicate checkpoint and log a one-iteration window).
-            for ext in trainer.extensions:
-                ext._last_fired = (
-                    int(loop["epoch"])
-                    if ext.unit == "epoch"
-                    else int(loop["iteration"])
-                )
+        self._apply_loop(trainer, new_state, loop)
         return new_state, int(loop["iteration"])
+
+    def maybe_load_elastic(
+        self, opt, params_template, trainer=None, model_state_template=None
+    ) -> Tuple[Any, int]:
+        """Elastic restore for the ZeRO tier: resume the latest snapshot even
+        when it was saved under a DIFFERENT device count.
+
+        The reference's checkpointer was restart-based with a fixed world
+        size (SURVEY §2.8); ZeRO state is padded per device count, so the
+        template path of :meth:`maybe_load` cannot reshard it.  This restores
+        template-free and re-lays the state onto ``opt``'s mesh via
+        :func:`chainermn_tpu.optimizers.zero.reshard_zero_state`.
+
+        ``opt`` is the target :class:`ZeroMultiNodeOptimizer`;
+        ``params_template`` a logical parameter pytree (e.g. a fresh
+        ``model.init``).  Returns ``(state, iteration)`` — a fresh
+        ``opt.init(params_template)`` state when no checkpoint exists.
+        """
+        from chainermn_tpu.optimizers.zero import reshard_zero_state
+
+        step = self._mngr.latest_step()
+        if step is None:
+            return (
+                opt.init(
+                    params_template, model_state=model_state_template
+                ),
+                0,
+            )
+        raw = self._mngr.restore(step)
+        new_state = reshard_zero_state(
+            raw["train_state"], opt, params_template,
+            model_state_template=model_state_template,
+        )
+        loop = raw["loop"]
+        self._apply_loop(trainer, new_state, loop)
+        return new_state, int(loop["iteration"])
+
+    def _apply_loop(self, trainer, new_state, loop) -> None:
+        """Push restored trainer/iterator/extension state (shared by the
+        template and elastic restore paths)."""
+        if trainer is None:
+            return
+        trainer.state = new_state
+        trainer.iteration = int(loop["iteration"])
+        it = trainer.train_iter
+        if hasattr(it, "restore_loop_state") and "it_order" in loop:
+            it.restore_loop_state(
+                int(loop["epoch"]),
+                {
+                    "pos": int(loop["it_pos"]),
+                    "order": loop["it_order"],
+                    "rng_keys": loop["rng_keys"],
+                    "rng_pos": int(loop["rng_pos"]),
+                    "rng_has_gauss": int(loop["rng_has_gauss"]),
+                    "rng_cached": float(loop["rng_cached"]),
+                },
+            )
+        else:
+            if hasattr(it, "epoch"):
+                it.epoch = int(loop["epoch"])
+            if hasattr(it, "_pos"):
+                it._pos = int(loop["it_pos"])
+            if "it_order" in loop and hasattr(it, "_order"):
+                it._order = np.asarray(loop["it_order"]).astype(np.int64)
+                it._rng.set_state((
+                    "MT19937",
+                    np.asarray(loop["rng_keys"]).astype(np.uint32),
+                    int(loop["rng_pos"]),
+                    int(loop["rng_has_gauss"]),
+                    float(loop["rng_cached"]),
+                ))
+        # Sync trigger state so interval extensions don't all re-fire on
+        # the first post-resume iteration (which would burn a retention
+        # slot on a duplicate checkpoint and log a one-iteration window).
+        for ext in trainer.extensions:
+            ext._last_fired = (
+                int(loop["epoch"])
+                if ext.unit == "epoch"
+                else int(loop["iteration"])
+            )
 
     # ------------------------------------------------------------------ misc
     def all_steps(self):

@@ -571,18 +571,24 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _default_block(length: int, cap: int) -> int:
-    """Largest power-of-2 ≤ ``cap`` dividing ``length`` (1 for odd lengths —
-    degenerate but valid; pad upstream for speed).  The on-chip sweep
-    (result/flash_tpu.json, TPU v5 lite, T=2048) showed (block_q=128,
-    block_k=128) — the old defaults — running 0.78× of XLA attention while
-    (256, 512) runs 2.1× faster fwd+bwd: bigger kv blocks amortize the
-    online-softmax rescale over more MXU work."""
+    """Largest power-of-2 ≤ ``cap`` that divides ``length`` AND satisfies
+    Mosaic's sublane constraint (block multiple of 8, or the full dim).
+
+    Falls back to ``length`` itself — a full-dim block is always legal for
+    the TPU lowering — when no multiple-of-8 power of 2 divides, e.g. the
+    ViT token grid T=196=4·49 (the real chip rejected the old chooser's
+    block 4 here; a (1, 4, 64) block violates the (8, 128) tiling rule).
+
+    The on-chip sweep (result/flash_tpu.json, TPU v5 lite, T=2048) showed
+    (block_q=128, block_k=128) — the old defaults — running 0.78× of XLA
+    attention while (256, 512) runs 2.1× faster fwd+bwd: bigger kv blocks
+    amortize the online-softmax rescale over more MXU work."""
     b = cap
-    while b > 1:
+    while b >= 8:
         if length % b == 0:
             return b
         b //= 2
-    return 1
+    return length
 
 
 def flash_attention_lse(

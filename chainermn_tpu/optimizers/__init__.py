@@ -528,5 +528,16 @@ from chainermn_tpu.optimizers.zero import (  # noqa: E402
     ZeroMultiNodeOptimizer,
     ZeroTrainState,
     create_zero_optimizer,
+    reshard_zero_state,
     zero_clip_by_global_norm,
+)
+
+# Large-batch recipe (LARS/LAMB + linear scaling + warmup) — the reference's
+# headline 32k-batch regime as a first-class tier.
+from chainermn_tpu.optimizers.large_batch import (  # noqa: E402
+    kernel_mask,
+    lamb,
+    lars,
+    linear_scaled_lr,
+    warmup_cosine_schedule,
 )

@@ -1,0 +1,165 @@
+"""Large-batch training: LARS/LAMB with layer-wise trust ratios + warmup.
+
+The reference's headline result is exactly this regime — ResNet-50/ImageNet
+at a 32k global batch across 1024 workers (Akiba et al. 2017, built on
+ChainerMN; reference anchor: the `examples/imagenet` benchmark config and the
+communicator fleet that makes the batch that large in the first place).  The
+upstream library shipped the *communication* layer and left the large-batch
+optimizer recipe to the user; since the whole point of scaling the
+communicator to a pod is a proportionally larger global batch, this module
+ships the standard recipe as a first-class tier:
+
+* **Linear LR scaling** (Goyal et al. 2017): peak LR grows with
+  ``global_batch / base_batch``.
+* **Gradual warmup**: ramp from ``warmup_factor * peak`` to ``peak`` over the
+  first epochs, then (optionally) cosine decay — the schedule that makes
+  linear scaling survive the early unstable phase.
+* **LARS / LAMB** (You et al. 2017 / 2019): per-layer trust ratios so the
+  update magnitude tracks each layer's weight norm instead of one global LR;
+  the standard practice of exempting biases and normalization parameters from
+  both the trust ratio and weight decay is applied via an ndim-based mask
+  (rank ≥ 2 = "kernel": conv/dense weights; rank ≤ 1 = bias/BN scale/shift).
+
+Everything here is a plain ``optax.GradientTransformation`` so it composes
+unchanged with :func:`create_multi_node_optimizer`, gradient compression,
+and accumulation — the update still runs as one jitted SPMD program.
+
+**Use the replicated tier, not ZeRO, for LARS/LAMB.**  The trust ratio is a
+per-LAYER statistic (each weight matrix's ‖w‖/‖g‖); under
+:func:`create_zero_optimizer` the inner transform sees flat 1/N shards, so
+layer norms are uncomputable there (and ``kernel_mask`` sees only rank-1
+leaves, silently disabling both masks).  The ZeRO docstring's "element-wise
+transforms only" contract is exactly the line LARS/LAMB cross.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import optax
+
+ScalarOrSchedule = Union[float, Callable]
+
+__all__ = [
+    "kernel_mask",
+    "linear_scaled_lr",
+    "warmup_cosine_schedule",
+    "lars",
+    "lamb",
+]
+
+
+def kernel_mask(params: Any) -> Any:
+    """True for "kernel" leaves (rank ≥ 2: conv/dense/embedding weights),
+    False for rank ≤ 1 leaves (biases, BN/LN scales and shifts).
+
+    The standard LARS/LAMB exemption set, computed structurally instead of by
+    name-matching so it holds for any model family in ``models/`` (flax
+    names differ between Dense/Conv/BatchNorm; ranks do not)."""
+    return jax.tree.map(lambda p: p.ndim >= 2, params)
+
+
+def linear_scaled_lr(
+    base_lr: float, global_batch: int, base_batch: int = 256
+) -> float:
+    """Goyal et al. linear scaling rule: ``base_lr * global_batch /
+    base_batch``.  ``base_lr`` is the LR known-good at ``base_batch``."""
+    if global_batch <= 0 or base_batch <= 0:
+        raise ValueError(f"batch sizes must be positive, got "
+                         f"{global_batch=} {base_batch=}")
+    return base_lr * (global_batch / float(base_batch))
+
+
+def warmup_cosine_schedule(
+    peak_lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    *,
+    warmup_factor: float = 0.0,
+    end_lr: float = 0.0,
+) -> Callable:
+    """Gradual-warmup + cosine-decay schedule for large-batch training.
+
+    Linear ramp ``warmup_factor * peak_lr → peak_lr`` over ``warmup_steps``,
+    cosine decay to ``end_lr`` over the remainder.  ``warmup_steps == 0``
+    degenerates to plain cosine; ``total_steps == warmup_steps`` to plain
+    warmup (constant after the ramp)."""
+    if total_steps < warmup_steps:
+        raise ValueError(
+            f"total_steps ({total_steps}) < warmup_steps ({warmup_steps})"
+        )
+    if total_steps == warmup_steps:
+        # optax.warmup_cosine_decay_schedule rejects decay_steps == 0; these
+        # degenerate forms (incl. 0/0 → constant) are load-bearing for short
+        # runs whose warmup spans the whole budget.
+        if warmup_steps == 0:
+            return optax.constant_schedule(peak_lr)
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(
+                    init_value=warmup_factor * peak_lr,
+                    end_value=peak_lr,
+                    transition_steps=warmup_steps,
+                ),
+                optax.constant_schedule(peak_lr),
+            ],
+            [warmup_steps],
+        )
+    return optax.warmup_cosine_decay_schedule(
+        init_value=warmup_factor * peak_lr,
+        peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
+        end_value=end_lr,
+    )
+
+
+def lars(
+    learning_rate: ScalarOrSchedule,
+    *,
+    weight_decay: float = 1e-4,
+    momentum: float = 0.9,
+    trust_coefficient: float = 0.001,
+    nesterov: bool = False,
+    eps: float = 0.0,
+) -> optax.GradientTransformation:
+    """LARS with the standard kernel-only trust-ratio/weight-decay masks.
+
+    Thin, opinionated front for :func:`optax.lars`: rank ≥ 2 parameters get
+    the layer-wise trust ratio and weight decay; biases and normalization
+    parameters take the raw (momentum-)SGD update — You et al.'s recipe, and
+    the configuration that holds ResNet-50 accuracy at 32k batch."""
+    return optax.lars(
+        learning_rate,
+        weight_decay=weight_decay,
+        weight_decay_mask=kernel_mask,
+        trust_coefficient=trust_coefficient,
+        eps=eps,
+        trust_ratio_mask=kernel_mask,
+        momentum=momentum,
+        nesterov=nesterov,
+    )
+
+
+def lamb(
+    learning_rate: ScalarOrSchedule,
+    *,
+    weight_decay: float = 1e-2,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+) -> optax.GradientTransformation:
+    """LAMB with weight decay masked to kernels only (rank ≥ 2).
+
+    optax's LAMB applies the trust ratio everywhere (the paper's
+    formulation — safe because Adam normalization already bounds the raw
+    update); only the decoupled weight decay needs the bias/BN exemption."""
+    return optax.lamb(
+        learning_rate,
+        b1=b1,
+        b2=b2,
+        eps=eps,
+        weight_decay=weight_decay,
+        mask=kernel_mask,
+    )

@@ -29,6 +29,9 @@ EXAMPLES = {
                      "--smoke", "--arch", "vit"],
     "imagenet_augment": ["examples/imagenet/train_imagenet.py",
                          "--force-cpu", "--smoke", "--augment"],
+    "imagenet_lars": ["examples/imagenet/train_imagenet.py", "--force-cpu",
+                      "--smoke", "--optimizer", "lars",
+                      "--warmup-epochs", "1"],
     "lm": ["examples/lm/train_lm.py", "--steps", "4", "--layers", "1",
            "--d-model", "64", "--seq-len", "64"],
     "lm_packed_recipe": ["examples/lm/train_lm.py", "--steps", "4",

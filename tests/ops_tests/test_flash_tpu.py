@@ -180,3 +180,45 @@ def test_flash_window_compiles_and_matches_on_tpu():
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         atol=2e-2, rtol=2e-2,
     )
+
+
+def test_flash_vit_geometry_compiles_on_tpu():
+    """T=196 (ViT-S/16 tokens, 196 = 4*49) with D=64: no multiple-of-8
+    power of 2 divides T, so the chooser must fall back to full-dim blocks
+    — the exact config Mosaic rejected under the old chooser (block 4)."""
+    import jax.numpy as jnp
+
+    from chainermn_tpu.ops import flash_attention, reference_attention
+
+    key = jax.random.PRNGKey(31)
+    B, T, H, D = 4, 196, 6, 64
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, T, H, D), jnp.bfloat16)
+
+    def loss(qkv):
+        return jnp.sum(
+            flash_attention(*qkv, causal=False, interpret=False).astype(
+                jnp.float32
+            ) ** 2
+        )
+
+    out = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=False,
+                                        interpret=False)
+    )(q, k, v)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2,
+    )
+    g = jax.jit(jax.grad(loss))((q, k, v))
+    og = jax.grad(lambda qkv: jnp.sum(
+        reference_attention(*qkv, causal=False).astype(jnp.float32) ** 2
+    ))((q, k, v))
+    for a, b in zip(g, og):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=0.25, rtol=0.15,
+        )
