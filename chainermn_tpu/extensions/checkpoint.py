@@ -201,6 +201,8 @@ class MultiNodeCheckpointer(Extension):
         ``model.init``).  Returns ``(state, iteration)`` — a fresh
         ``opt.init(params_template)`` state when no checkpoint exists.
         """
+        import orbax.checkpoint as ocp
+
         from chainermn_tpu.optimizers.zero import reshard_zero_state
 
         step = self._mngr.latest_step()
@@ -211,7 +213,23 @@ class MultiNodeCheckpointer(Extension):
                 ),
                 0,
             )
-        raw = self._mngr.restore(step)
+        # Restore to HOST numpy via a metadata-derived template: a
+        # template-free restore (and the manager's own item_metadata, which
+        # is None on a fresh manager) would rebuild the SAVED device
+        # topology — orbax pins shardings to device ids, which by
+        # definition no longer exist when the world size changed.  The
+        # array metadata tree (shapes/dtypes only) lives under the step's
+        # item directory; numpy leaves in the template force a host-RAM
+        # restore with no device placement at all.
+        item_dir = os.path.join(self._dir, str(step), "default")
+        meta = ocp.StandardCheckpointer().metadata(item_dir)
+        template = jax.tree_util.tree_map(
+            lambda m: np.zeros(m.shape, m.dtype),
+            meta.item_metadata.tree,
+        )
+        raw = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
         new_state = reshard_zero_state(
             raw["train_state"], opt, params_template,
             model_state_template=model_state_template,

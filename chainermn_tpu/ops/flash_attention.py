@@ -571,24 +571,34 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _default_block(length: int, cap: int) -> int:
-    """Largest power-of-2 ≤ ``cap`` that divides ``length`` AND satisfies
-    Mosaic's sublane constraint (block multiple of 8, or the full dim).
+    """Largest multiple of 8 ≤ ``cap`` that divides ``length`` — Mosaic's
+    sublane constraint (block multiple of 8, or the full dim).
 
-    Falls back to ``length`` itself — a full-dim block is always legal for
-    the TPU lowering — when no multiple-of-8 power of 2 divides, e.g. the
-    ViT token grid T=196=4·49 (the real chip rejected the old chooser's
-    block 4 here; a (1, 4, 64) block violates the (8, 128) tiling rule).
+    When NO multiple of 8 divides (e.g. the ViT token grid T=196=4·49 —
+    the real chip rejected the old chooser's block 4 there: a (1, 4, 64)
+    block violates the (8, 128) tiling rule), fall back to the full dim,
+    which the tiling rule always accepts — but only up to 1024, past which
+    a full-dim scores tile blows the ~16 MB VMEM budget; longer awkward
+    lengths must be padded upstream (error, with the padded size named).
 
     The on-chip sweep (result/flash_tpu.json, TPU v5 lite, T=2048) showed
     (block_q=128, block_k=128) — the old defaults — running 0.78× of XLA
     attention while (256, 512) runs 2.1× faster fwd+bwd: bigger kv blocks
     amortize the online-softmax rescale over more MXU work."""
-    b = cap
+    b = min(cap, length)
+    b -= b % 8
     while b >= 8:
         if length % b == 0:
             return b
-        b //= 2
-    return length
+        b -= 8
+    if length <= 1024:
+        return length
+    raise ValueError(
+        f"no multiple-of-8 block size divides sequence length {length} and "
+        f"a full-dim block would exceed VMEM: pad the sequence to a "
+        f"multiple of 8 (e.g. {-(-length // 8) * 8}) with segment-id "
+        f"masking, or pass block_q/block_k explicitly"
+    )
 
 
 def flash_attention_lse(

@@ -1,0 +1,109 @@
+"""Worker for the cross-process elastic-restart integration test.
+
+Phase 1 (``CMN_PHASE=1``, run under ``launch -n 2``): ZeRO-adam DP training
+across 2 OS processes (2 devices), synchronous checkpoint at step 3;
+process 0 also writes the materialized logical params for phase 2's
+bit-exactness check.
+
+Phase 2 (``CMN_PHASE=2``, run under ``launch -n 1``): a SINGLE process —
+half the world gone — resumes the same checkpoint directory through
+``maybe_load_elastic``, asserts the restore is bit-exact, and trains on.
+The reference's checkpointer required the SAME world size on restart
+(SURVEY §2.8); this is the capability it lacked.
+"""
+
+import json
+import os
+import sys
+import traceback
+
+import numpy as np
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid, "n_devices": len(jax.devices())}
+
+    import optax
+
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.models import MLP, classification_loss
+
+    tmp = os.environ["CMN_TEST_TMP"]
+    phase = int(os.environ["CMN_PHASE"])
+    comm = cmn.create_communicator("xla")
+    model = MLP(hidden=(16,), n_out=4)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))[
+        "params"
+    ]
+    loss_fn = classification_loss(model)
+    opt = cmn.create_zero_optimizer(optax.adam(1e-2), comm)
+    ckpt = create_multi_node_checkpointer(
+        "elastic", comm, path=tmp, async_save=False
+    )
+
+    # The same deterministic GLOBAL batch stream regardless of process
+    # count; shard_batch splits it over however many devices exist.
+    rng = np.random.RandomState(7)
+    batches = [
+        (
+            rng.normal(size=(64, 8)).astype(np.float32),
+            rng.randint(0, 4, size=(64,)).astype(np.int32),
+        )
+        for _ in range(5)
+    ]
+
+    def run(state, bs):
+        metrics = None
+        for b in bs:
+            state, metrics = opt.update(state, b, loss_fn, has_aux=True)
+        return state, metrics
+
+    if phase == 1:
+        state = opt.init(params)
+        state, metrics = run(state, batches[:3])
+        ckpt.save(state)
+        ckpt.finalize()
+        out["step"] = int(state.step)
+        out["loss"] = float(metrics["loss"])
+        # materialize_params is a COLLECTIVE (cross-host all-gather): every
+        # process must call it, even though only process 0 writes the file.
+        flat = {
+            f"p{i}": np.asarray(l)
+            for i, l in enumerate(
+                jax.tree_util.tree_leaves(opt.materialize_params(state))
+            )
+        }
+        if pid == 0:
+            np.savez(os.path.join(tmp, "params_phase1.npz"), **flat)
+    else:
+        state, resumed = ckpt.maybe_load_elastic(opt, params)
+        out["resumed_step"] = int(state.step)
+        saved = np.load(os.path.join(tmp, "params_phase1.npz"))
+        leaves = jax.tree_util.tree_leaves(opt.materialize_params(state))
+        for i, l in enumerate(leaves):
+            if not np.array_equal(np.asarray(l), saved[f"p{i}"]):
+                raise AssertionError(
+                    f"leaf {i} not bit-exact after elastic restore"
+                )
+        out["bit_exact"] = True
+        state, metrics = run(state, batches[3:])
+        out["step"] = int(state.step)
+        out["loss"] = float(metrics["loss"])
+        if not np.isfinite(out["loss"]):
+            raise AssertionError(f"non-finite loss {out['loss']}")
+    return out
+
+
+if __name__ == "__main__":
+    try:
+        result = main()
+        print("WORKER_RESULT " + json.dumps(result), flush=True)
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)

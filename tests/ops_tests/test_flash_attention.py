@@ -452,7 +452,8 @@ def test_default_block_respects_mosaic_sublane_rule():
 
     assert _default_block(2048, 256) == 256
     assert _default_block(2048, 512) == 512
-    assert _default_block(1000, 512) == 8      # 8 | 1000, no larger pow2
+    assert _default_block(1000, 512) == 200    # largest 8k | 1000, not pow2
+    assert _default_block(4104, 512) == 456    # 8*513: non-pow2 divisor
     assert _default_block(196, 256) == 196     # 196 = 4*49: full-dim block
     assert _default_block(196, 512) == 196
     assert _default_block(7, 256) == 7         # tiny odd: full-dim
@@ -460,6 +461,10 @@ def test_default_block_respects_mosaic_sublane_rule():
         b = _default_block(length, 256)
         assert length % b == 0
         assert b % 8 == 0 or b == length
+    # Long lengths with no multiple-of-8 divisor must error (a full-dim
+    # block would blow VMEM), pointing at upstream padding.
+    with pytest.raises(ValueError, match="pad the sequence"):
+        _default_block(4100, 512)
 
 
 def test_flash_vit_geometry_matches_oracle():
