@@ -660,7 +660,15 @@ def flash_attention_lse(
     if interpret is None:
         interpret = _use_interpret()
     # Sweep-informed defaults (see _default_block); explicit args win.
-    block_q = _default_block(T, 256) if block_q is None else block_q
+    # Head-dim-aware q cap: the on-chip sweeps found fwd+bwd optima at
+    # (256, 512) for D=128 (result/flash_tpu.json) but (512, 512) for D=64
+    # (result/flash_tpu_d64.json, 10% faster than (256, 512) there) — a
+    # narrower head halves each tile's VMEM, so a taller q block pays.
+    block_q = (
+        _default_block(T, 512 if D <= 64 else 256)
+        if block_q is None
+        else block_q
+    )
     block_k = _default_block(S, 512) if block_k is None else block_k
     block_q = min(block_q, T)
     block_k = min(block_k, S)
@@ -746,8 +754,10 @@ def flash_attention(
     ``segment_ids``).  Requires lengths divisible by the block sizes (pad
     upstream; the data layer's bucketing keeps XLA-friendly static shapes
     anyway).  ``block_q``/``block_k`` default to the largest sweep-winning
-    power-of-2 divisors (≤256 / ≤512 — see ``_default_block``); pass
-    explicit values to override.  Differentiable via the flash backward.
+    multiple-of-8 divisors — ``block_q`` capped at 512 for head dim ≤64
+    and 256 above (on-chip optima, ``result/flash_tpu{_d64,}.json``),
+    ``block_k`` at 512; see ``_default_block``.  Pass explicit values to
+    override.  Differentiable via the flash backward.
     ``interpret=None`` auto-selects interpret mode off-TPU.
 
     ``window`` enables sliding-window (local) attention: query ``i``

@@ -187,3 +187,62 @@ def test_zero_elastic_int8_ef_resets_residual_with_warning(
     _assert_tree_close(
         opt4.materialize_params(state4), opt8.materialize_params(state)
     )
+
+
+@pytest.mark.parametrize(
+    "tx_name",
+    ["sgd", "momentum_nesterov", "adam", "adamw", "rmsprop"],
+)
+def test_zero_elastic_across_transform_families(devices, tmp_path, tx_name):
+    """The structural reshard walk must handle every state shape the
+    element-wise optax family produces: stateless (sgd), single trace
+    (momentum), dual moments + count (adam/adamw), EMA (rmsprop).
+    Odd leaf sizes (hidden=18 -> sizes not divisible by 8 or 4) exercise
+    different paddings at N=8 vs N=4."""
+    tx = {
+        "sgd": lambda: optax.sgd(0.1),
+        "momentum_nesterov": lambda: optax.sgd(0.1, momentum=0.9,
+                                               nesterov=True),
+        "adam": lambda: optax.adam(1e-2),
+        "adamw": lambda: optax.adamw(1e-2, weight_decay=1e-3),
+        "rmsprop": lambda: optax.rmsprop(1e-2),
+    }[tx_name]()
+    model = MLP(hidden=(18,), n_out=5)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 7), np.float32)
+    )["params"]
+    loss_fn = classification_loss(model)
+    rng = np.random.RandomState(3)
+    batches = [
+        (
+            rng.normal(size=(64, 7)).astype(np.float32),
+            rng.randint(0, 5, size=(64,)).astype(np.int32),
+        )
+        for _ in range(4)
+    ]
+
+    comm8 = cmn.create_communicator("xla", devices=devices)
+    opt8 = cmn.create_zero_optimizer(tx, comm8)
+    state = opt8.init(params)
+    for b in batches[:2]:
+        state, _ = opt8.update(state, b, loss_fn, has_aux=True)
+    ckpt = create_multi_node_checkpointer(
+        f"fam_{tx_name}", comm8, path=str(tmp_path), async_save=False
+    )
+    ckpt.save(state)
+    ckpt.finalize()
+
+    comm4 = cmn.create_communicator("xla", devices=devices[:4])
+    opt4 = cmn.create_zero_optimizer(tx, comm4)
+    ckpt4 = create_multi_node_checkpointer(
+        f"fam_{tx_name}", comm4, path=str(tmp_path), async_save=False
+    )
+    state4, _ = ckpt4.maybe_load_elastic(opt4, params)
+    for b in batches[2:]:
+        state4, _ = opt4.update(state4, b, loss_fn, has_aux=True)
+
+    _assert_tree_close(
+        opt4.materialize_params(state4),
+        _oracle_params(params, loss_fn, tx, batches),
+        atol=5e-5, rtol=5e-5,
+    )
