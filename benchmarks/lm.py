@@ -64,7 +64,9 @@ def main():
         }))
         return
     if args.smoke:
-        args.batch, args.seq, args.layers = 2, 256, 2
+        # batch 8 divides any of the test meshes (1 device or the forced
+        # 8-device CPU pool).
+        args.batch, args.seq, args.layers = 8, 256, 2
         args.d_model, args.heads, args.d_ff, args.vocab = 128, 4, 256, 1024
         args.iters = 2
     if platform == "cpu":
@@ -116,16 +118,18 @@ def main():
         step = opt.make_train_step(loss_fn, has_aux=True,
                                    accum_steps=args.accum)
 
-        flops = None
+        # One shared flops/MFU implementation (utils.compiled_flops / mfu):
+        # a local copy once drifted (stale `from bench import` silently
+        # dropped mfu_pct from the artifact) — never again.
+        from chainermn_tpu.utils import compiled_flops, mfu
+
+        compiled = None
         try:
             compiled = step.lower(state, batch).compile()
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0]
-            flops = float(cost.get("flops", 0.0)) or None
             step = compiled
         except Exception as e:
             out[f"{impl}_compile_note"] = f"{type(e).__name__}: {str(e)[:150]}"
+        flops = compiled_flops(compiled) if compiled is not None else None
 
         for _ in range(2):  # warmup
             state, metrics = step(state, batch)
@@ -142,16 +146,9 @@ def main():
                "tokens_per_sec_per_chip": round(tps, 1)}
         if flops:
             rec["tflops_per_step"] = round(flops / 1e12, 3)
-            try:
-                from bench import PEAK_BF16_FLOPS
-
-                peak = PEAK_BF16_FLOPS.get(out["device_kind"])
-                if peak:
-                    rec["mfu_pct"] = round(
-                        100.0 * flops * (args.iters / dt) / n_dev / peak, 2
-                    )
-            except Exception:
-                pass
+            m = mfu(compiled, dt / args.iters, n_dev, out["device_kind"])
+            if m is not None:
+                rec["mfu_pct"] = round(m, 2)
         out[impl] = rec
         print(json.dumps({impl: rec}), flush=True)
 

@@ -1,0 +1,163 @@
+"""Seq2seq (NMT family) training throughput: target tokens/sec/chip.
+
+The third reference workload family (``examples/seq2seq`` — SURVEY §2.9)
+measured at modern scale: full DP training step of the flash-kernel
+:class:`TransformerSeq2Seq` on bucketed/padded variable-length batches
+(the reference's ragged-batch story under XLA's static shapes), reported
+in NON-PAD target tokens/sec/chip with the padding overhead stated, plus
+the same model on materialized-scores XLA attention.
+
+    python benchmarks/seq2seq.py --out result/seq2seq_tpu.json   # real chip
+    JAX_PLATFORMS=cpu python benchmarks/seq2seq.py --smoke       # plumbing
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--src-len", type=int, default=512)
+    ap.add_argument("--tgt-len", type=int, default=512)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--enc", type=int, default=6)
+    ap.add_argument("--dec", type=int, default=6)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--nonpad", type=float, default=0.87,
+                    help="simulated non-pad fraction (the bucketing tier's "
+                         "measured 0.87 at bucket_width=4)")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from chainermn_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models import TransformerSeq2Seq, seq2seq_loss
+    from chainermn_tpu.models.seq2seq import PAD
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    if platform != "tpu" and not args.smoke:
+        print(json.dumps({
+            "error": f"seq2seq bench needs a TPU (got {platform}); "
+                     "pass --smoke for a CPU plumbing check"
+        }))
+        return
+    if args.smoke:
+        args.batch, args.src_len, args.tgt_len = 8, 64, 64
+        args.d_model, args.heads, args.d_ff = 64, 4, 128
+        args.enc, args.dec, args.vocab, args.iters = 1, 1, 512, 2
+    if platform == "cpu":
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+    out = {
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+        "config": {k: getattr(args, k.replace("-", "_")) for k in
+                   ("batch", "src_len", "tgt_len", "d_model", "heads",
+                    "d_ff", "enc", "dec", "vocab")},
+        "nonpad_fraction": args.nonpad,
+    }
+
+    comm = cmn.create_communicator("xla", allreduce_grad_dtype=jnp.bfloat16)
+
+    # Bucketed/padded batch shape with the measured non-pad fraction: the
+    # tail of each row is PAD (id 0), exactly what bucket_batches emits.
+    rng = np.random.RandomState(0)
+    def make(lenq):
+        toks = rng.randint(3, args.vocab,
+                           size=(args.batch, lenq)).astype(np.int32)
+        n_real = max(1, int(round(lenq * args.nonpad)))
+        toks[:, n_real:] = PAD
+        return toks
+    batch = comm.shard_batch((make(args.src_len), make(args.tgt_len)))
+    real_tgt_tokens = int(
+        (np.asarray(jax.device_get(batch[1])) != PAD).sum()
+    )
+
+    for impl in ("flash", "xla"):
+        model = TransformerSeq2Seq(
+            vocab_src=args.vocab, vocab_tgt=args.vocab,
+            d_model=args.d_model, n_heads=args.heads, d_ff=args.d_ff,
+            n_enc=args.enc, n_dec=args.dec,
+            max_len=max(args.src_len, args.tgt_len),
+            dtype=jnp.bfloat16, attention=impl,
+        )
+        opt = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
+        params = jax.jit(
+            lambda r: model.init(
+                r,
+                jnp.zeros((1, args.src_len), jnp.int32),
+                jnp.zeros((1, args.tgt_len), jnp.int32),
+            )
+        )(jax.random.PRNGKey(0))["params"]
+        if jax.process_count() > 1:
+            # Multi-host placement goes through make_array_from_callback,
+            # which cannot run under a trace (same guard as lm.py).
+            state = opt.init(params)
+        else:
+            state = jax.block_until_ready(jax.jit(opt.init)(params))
+        step = opt.make_train_step(seq2seq_loss(model), has_aux=True)
+
+        # Shared flops/MFU implementation (see lm.py's note on drift).
+        from chainermn_tpu.utils import compiled_flops, mfu
+
+        compiled = None
+        try:
+            compiled = step.lower(state, batch).compile()
+            step = compiled
+        except Exception as e:
+            out[f"{impl}_compile_note"] = f"{type(e).__name__}: {str(e)[:150]}"
+        flops = compiled_flops(compiled) if compiled is not None else None
+
+        for _ in range(2):
+            state, metrics = step(state, batch)
+            _ = float(metrics["loss"])  # device->host sync (tunnel-safe)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            state, metrics = step(state, batch)
+            _ = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+        rec = {
+            "step_ms": round(dt / args.iters * 1000.0, 2),
+            "nonpad_tgt_tokens_per_sec_per_chip": round(
+                real_tgt_tokens * args.iters / dt / n_dev, 1
+            ),
+        }
+        if flops:
+            rec["tflops_per_step"] = round(flops / 1e12, 3)
+            m = mfu(compiled, dt / args.iters, n_dev, out["device_kind"])
+            if m is not None:
+                rec["mfu_pct"] = round(m, 2)
+        out[impl] = rec
+        print(json.dumps({impl: rec}), flush=True)
+
+    if "flash" in out and "xla" in out:
+        out["flash_speedup"] = round(
+            out["xla"]["step_ms"] / out["flash"]["step_ms"], 3
+        )
+    print(json.dumps(out))
+    if args.out and platform == "tpu":
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Benchmark harnesses must keep running (CPU smoke modes).
+
+The headline numbers (BASELINE.md) are produced by `benchmarks/*.py` on the
+real chip; nothing else guards those scripts from bit-rot between hardware
+windows.  Each runs as a real subprocess in its documented CPU smoke mode
+and must emit parseable JSON."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+BENCHES = {
+    "lm": ["benchmarks/lm.py", "--smoke"],
+    "decode": ["benchmarks/decode.py", "--smoke"],
+    "flash_interpret": ["benchmarks/flash_tpu.py", "--interpret-smoke"],
+    "seq2seq": ["benchmarks/seq2seq.py", "--smoke"],
+}
+
+
+@pytest.mark.parametrize("name", sorted(BENCHES))
+def test_benchmark_smoke(name, tmp_path):
+    out_path = tmp_path / f"{name}.json"
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    })
+    res = subprocess.run(
+        [sys.executable] + BENCHES[name] + ["--out", str(out_path)],
+        cwd=REPO, env=env, capture_output=True, timeout=600,
+    )
+    log = res.stdout.decode(errors="replace") + res.stderr.decode(
+        errors="replace"
+    )
+    assert res.returncode == 0, f"{name} failed:\n{log[-2000:]}"
+    # Smoke modes print a JSON payload even when --out is gated to TPU runs.
+    payloads = [
+        json.loads(line)
+        for line in res.stdout.decode(errors="replace").splitlines()
+        if line.strip().startswith("{")
+    ]
+    assert payloads, log[-1000:]
+    assert not any("error" in p for p in payloads), payloads
