@@ -74,11 +74,18 @@ def _pow2_block(n: int, cap: int = 128) -> int:
     return b
 
 
-def _use_flash(*lengths) -> bool:
-    """Flash is only a win with real block sizes; odd lengths whose largest
+def _use_flash(impl, *lengths) -> bool:
+    """Whether this block should run the Pallas kernel.  ``'auto'`` defers
+    to the measured on-chip crossover (:func:`ops.resolve_attention` —
+    XLA won at T=512/D=64, ``result/seq2seq_tpu.json``); an explicit
+    ``'flash'`` still requires real block sizes — odd lengths whose largest
     power-of-two factor is tiny would run 1-row blocks (each still padded
-    to a full TPU tile) — fall back to the XLA path instead."""
-    return all(_pow2_block(n) >= 8 for n in lengths)
+    to a full TPU tile) — else the XLA path."""
+    from chainermn_tpu.ops import resolve_attention
+
+    if impl == "auto":
+        return resolve_attention(impl, *lengths) == "flash"
+    return impl == "flash" and all(_pow2_block(n) >= 8 for n in lengths)
 
 
 class _EncBlock(nn.Module):
@@ -96,7 +103,7 @@ class _EncBlock(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
         qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.attention == "flash" and _use_flash(h.shape[1]):
+        if _use_flash(self.attention, h.shape[1]):
             b = _pow2_block(h.shape[1])
             a = flash_attention(q, k, v, segment_ids=seg, block_q=b,
                                 block_k=b)
@@ -128,7 +135,7 @@ class _DecBlock(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
         qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.attention == "flash" and _use_flash(Tt):
+        if _use_flash(self.attention, Tt):
             b = _pow2_block(Tt)
             a = flash_attention(q, k, v, causal=True, block_q=b, block_k=b)
         else:
@@ -144,7 +151,7 @@ class _DecBlock(nn.Module):
                               name="cross_kv")(enc)
         ck, cv = ckv[:, :, 0], ckv[:, :, 1]
         q_seg = jnp.ones((B, Tt), jnp.int32)
-        if self.attention == "flash" and _use_flash(Tt, enc.shape[1]):
+        if _use_flash(self.attention, Tt, enc.shape[1]):
             a = flash_attention(
                 cq, ck, cv, segment_ids=q_seg, kv_segment_ids=src_seg,
                 block_q=_pow2_block(Tt), block_k=_pow2_block(enc.shape[1]),
@@ -179,14 +186,15 @@ class TransformerSeq2Seq(nn.Module):
     n_dec: int = 2
     max_len: int = 128
     dtype: Any = jnp.float32
-    attention: str = "flash"
+    attention: str = "auto"
 
     @nn.compact
     def __call__(self, src, tgt_in):
         D = self.d_model
-        if self.attention not in ("flash", "xla"):
+        if self.attention not in ("flash", "xla", "auto"):
             raise ValueError(
-                f"attention={self.attention!r}: expected 'flash' or 'xla'"
+                f"attention={self.attention!r}: expected 'flash', 'xla' "
+                "or 'auto'"
             )
         if D % self.n_heads:
             raise ValueError(

@@ -65,7 +65,11 @@ class _DecoderBlock(nn.Module):
         the KV cache, returns ``(h, new_cache)``.  Both paths create the
         identical parameters (Dense/LayerNorm shapes are length-free), so
         one set of weights serves training and generation."""
-        from chainermn_tpu.ops import flash_attention, reference_attention
+        from chainermn_tpu.ops import (
+            flash_attention,
+            reference_attention,
+            resolve_attention,
+        )
 
         T = h.shape[1]
         D, H = self.d_model, self.n_heads
@@ -148,24 +152,26 @@ class _DecoderBlock(nn.Module):
                 "bkgqt,btkd->bqkgd", p, vc.astype(jnp.float32)
             ).reshape(q.shape[0], T, H, D // H).astype(q.dtype)
             new_cache = {"k": kc, "v": vc}
-        elif self.attention == "flash":
+        elif self.attention not in ("flash", "xla", "auto"):
+            raise ValueError(
+                f"attention={self.attention!r}: expected 'flash', 'xla' "
+                "or 'auto'"
+            )
+        elif resolve_attention(self.attention, T) == "flash":
             # Library-default blocks: largest sweep-winning power-of-2
             # divisors of T (flash needs T % block == 0); natural lengths
-            # work without upstream padding.
+            # work without upstream padding.  'auto' picks flash/xla by the
+            # measured on-chip crossover (ops.FLASH_MIN_SEQ).
             block = None
             a = flash_attention(q, k, v, causal=True,
                                 segment_ids=segment_ids, block_q=block,
                                 block_k=block,
                                 window=self.window or None)
-        elif self.attention == "xla":
+        else:
             a = reference_attention(
                 q, k, v, causal=True, segment_ids=segment_ids,
                 window=self.window or None,
             ).astype(q.dtype)
-        else:
-            raise ValueError(
-                f"attention={self.attention!r}: expected 'flash' or 'xla'"
-            )
         o = nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype, name="proj")(a)
         h = h + o
         x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
@@ -185,9 +191,13 @@ class TransformerLM(nn.Module):
     d_ff: int = 1024
     max_len: int = 1024
     dtype: Any = jnp.bfloat16
-    #: "flash" (Pallas kernel) or "xla" (materialized-scores oracle) — the
-    #: switch the LM benchmark uses to measure the kernel's end-to-end value.
-    attention: str = "flash"
+    #: "flash" (Pallas kernel), "xla" (materialized-scores oracle — the
+    #: switch the LM benchmark uses to measure the kernel's end-to-end
+    #: value), or "auto" (default): flash from the measured on-chip
+    #: crossover length up (``ops.FLASH_MIN_SEQ``), xla below it, where
+    #: short sequences don't amortize the block machinery
+    #: (result/seq2seq_tpu.json vs result/lm_tpu.json).
+    attention: str = "auto"
     #: kv heads for grouped-query attention (0 → ``n_heads``, classic MHA;
     #: 1 → multi-query).  Must divide ``n_heads``; shrinks the generation
     #: KV cache (and the k/v projection) by ``n_heads // n_kv_heads``.

@@ -28,17 +28,21 @@ class _EncoderBlock(nn.Module):
     n_heads: int
     d_ff: int
     dtype: Any
-    attention: str  # "flash" | "xla"
+    attention: str  # "flash" | "xla" | "auto"
 
     @nn.compact
     def __call__(self, h):
-        from chainermn_tpu.ops import flash_attention, reference_attention
+        from chainermn_tpu.ops import (
+            flash_attention,
+            reference_attention,
+            resolve_attention,
+        )
 
         D, H = self.d_model, self.n_heads
         x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
         qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.attention == "flash":
+        if resolve_attention(self.attention, h.shape[1]) == "flash":
             a = flash_attention(q, k, v, causal=False)
         else:
             a = reference_attention(q, k, v, causal=False).astype(q.dtype)
@@ -62,7 +66,11 @@ class ViT(nn.Module):
     d_ff: int = 1536
     n_layers: int = 12
     dtype: Any = jnp.bfloat16
-    attention: str = "flash"
+    #: "flash", "xla", or "auto" (default): the ViT token count (e.g. 196
+    #: at 224²/p16) sits BELOW the measured flash crossover
+    #: (``ops.FLASH_MIN_SEQ``), so auto runs XLA attention there —
+    #: short rows don't amortize the Pallas block machinery.
+    attention: str = "auto"
     remat: bool = False
 
     @nn.compact

@@ -17,12 +17,14 @@ from chainermn_tpu.ops.flash_attention import (
     flash_attention,
     flash_attention_lse,
     reference_attention,
+    resolve_attention,
 )
 
 __all__ = [
     "flash_attention",
     "flash_attention_lse",
     "reference_attention",
+    "resolve_attention",
     "chunked_softmax_cross_entropy",
     "random_crop",
     "random_crop_flip",

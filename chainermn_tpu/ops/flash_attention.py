@@ -148,41 +148,48 @@ def _mask_scores(s, q0, k0, causal, window):
     return s
 
 
-def _k_block_range(qi, bq, block_k, n_k, causal, window):
+def _k_block_range(qi, bq, block_k, n_k, causal, window, kv_off=0):
     """``[k_lo, k_hi)`` kv-block bounds visited by the q block starting at
     ``qi * bq`` (forward and dQ kernels).  Blocks fully outside the causal
-    triangle or the window are skipped, not just masked."""
+    triangle or the window are skipped, not just masked.  ``kv_off`` is the
+    static absolute position of the kv array's first row (nonzero when the
+    sequence is VMEM-chunked, :func:`_stage_chunk`); block indices stay
+    LOCAL to the chunk.  Bounds may cross (empty range → zero loop trips)."""
     last_q = (qi + 1) * bq - 1
     if causal:
-        k_hi = jnp.minimum((last_q // block_k) + 1, n_k)
+        k_hi = jnp.clip((last_q - kv_off) // block_k + 1, 0, n_k)
     elif window is not None:
-        k_hi = jnp.minimum((last_q + window - 1) // block_k + 1, n_k)
+        k_hi = jnp.clip((last_q + window - 1 - kv_off) // block_k + 1, 0, n_k)
     else:
         k_hi = n_k
     if window is not None:
-        k_lo = jnp.maximum((qi * bq - window + 1) // block_k, 0)
+        k_lo = jnp.maximum((qi * bq - window + 1 - kv_off) // block_k, 0)
     else:
         k_lo = 0
     return k_lo, k_hi
 
 
-def _q_block_range(ki, bk, block_q, n_q, causal, window):
+def _q_block_range(ki, bk, block_q, n_q, causal, window, q_off=0):
     """``[q_lo, q_hi)`` q-block bounds visited by the kv block starting at
-    ``ki * bk`` (dK/dV kernel) — the transpose of :func:`_k_block_range`."""
+    ``ki * bk`` (dK/dV kernel) — the transpose of :func:`_k_block_range`.
+    ``q_off`` is the static absolute position of the q array's first row
+    (nonzero when the q rows are VMEM-chunked); indices stay chunk-local."""
     first_k = ki * bk
-    q_lo = first_k // block_q if causal else 0
+    q_lo = jnp.clip((first_k - q_off) // block_q, 0, n_q) if causal else 0
     q_hi = n_q
     if window is not None:
         # q >= k_first - window + 1 and q <= k_last + window - 1.
-        q_lo = jnp.maximum(q_lo, (first_k - window + 1) // block_q)
+        q_lo = jnp.maximum(q_lo, (first_k - window + 1 - q_off) // block_q)
         q_lo = jnp.maximum(q_lo, 0)
-        q_hi = jnp.minimum((first_k + bk - 1 + window - 1) // block_q + 1, n_q)
+        q_hi = jnp.clip(
+            (first_k + bk - 1 + window - 1 - q_off) // block_q + 1, 0, n_q
+        )
     return q_lo, q_hi
 
 
 # --------------------------------------------------------------------- fwd
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
-                block_k, causal, segmented, scale, window=None):
+                block_k, causal, segmented, scale, window=None, kv_off=0):
     # q_ref: (1, BQ, D); k/v_ref: (1, T, D); o_ref: (1, BQ, D).
     # Per-row refs (lse, segments) carry a trailing singleton lane dim —
     # (1, BQ, 1) / (1, T, 1) — because Mosaic requires each block's last two
@@ -200,7 +207,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
     seg_q = segq_ref[0, :, 0] if segmented else None  # (BQ,)
 
     n_k = T // block_k
-    k_lo, n_k_eff = _k_block_range(qi, bq, block_k, n_k, causal, window)
+    k_lo, n_k_eff = _k_block_range(qi, bq, block_k, n_k, causal, window,
+                                   kv_off=kv_off)
 
     def body(ki, carry):
         m, l, acc = carry
@@ -210,7 +218,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
-        s = _mask_scores(s, qi * bq, ki * block_k, causal, window)
+        s = _mask_scores(s, qi * bq, ki * block_k + kv_off, causal, window)
         if segmented:
             seg_k = segk_ref[0, pl.ds(ki * block_k, block_k), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
@@ -266,15 +274,118 @@ def _kv_row(heads: int, kv_heads: int):
     return lambda b: (b // heads) * kv_heads + (b % heads) // group
 
 
+#: VMEM budget (bytes) for a kernel's two double-buffered full-sequence
+#: refs — k+v in the fwd/dQ kernels, q+do in the dK/dV kernel.  Half the
+#: ~16 MB per-core VMEM; the rest covers block tiles, the score matrix, and
+#: accumulators.  Sequences whose staged refs exceed this are transparently
+#: chunked (:func:`_stage_chunk`) and the partials merged through their
+#: logsumexps — same math, unbounded T (the real chip rejected the
+#: unchunked kernel at T=16384, D=128: 16.25 MB scoped > 16 MB).
+_STAGE_BUDGET_BYTES = 8 * 1024 * 1024
+
+#: Mosaic lane-pads the trailing singleton dim of the per-row refs
+#: ((1, T, 1) lse/delta/segment arrays) to a full 128-lane tile — a staged
+#: f32 row costs 512 bytes, not 4.  The on-chip OOM that motivated this
+#: accounting: the dK/dV kernel at T=16384, D=128 with q+do staged under a
+#: naive 2·2·D·itemsize budget still allocated 17 MB, the extra ~8 MB
+#: being exactly the double-buffered lane-padded lse+delta rows.
+_LANE = 128
+
+
+def _row_bytes(depth, itemsize, n_padded_f32=0, segmented=False):
+    """Double-buffered VMEM bytes per staged sequence row: two (row, depth)
+    arrays (k+v or q+do) plus ``n_padded_f32`` lane-padded f32 per-row refs
+    (lse/delta) plus the int32 segment row when segmented."""
+    b = 2 * 2 * depth * itemsize
+    b += 2 * n_padded_f32 * _LANE * 4
+    if segmented:
+        b += 2 * _LANE * 4
+    return b
+
+
+def _stage_chunk(length, row_bytes, block, max_rows):
+    """Chunk length for the full-row staged refs: the largest divisor of
+    ``length`` that is a multiple of ``block`` and fits the stage budget
+    at ``row_bytes`` per row (:func:`_row_bytes`).  ``length`` itself when
+    it already fits — the chunk-free fast path, byte-identical to the
+    unchunked kernel."""
+    rows = _STAGE_BUDGET_BYTES // row_bytes
+    if max_rows is not None:
+        rows = min(rows, max_rows)
+    if length <= rows:
+        return length
+    c = rows - rows % block
+    while c >= block and length % c:
+        c -= block
+    if c < block:
+        raise ValueError(
+            f"sequence length {length} has no multiple-of-{block} divisor "
+            f"within the {rows}-row VMEM stage budget: pad the sequence or "
+            f"pass smaller block_q/block_k"
+        )
+    return c
+
+
+def _merge_partials(o1, lse1, o2, lse2):
+    """Exact two-partial softmax merge over disjoint key sets (the lse
+    composition rule documented on :func:`flash_attention_lse`), honoring
+    the fully-masked-row contract (zero rows, lse = NEG_INF).  Returns the
+    merged output in fp32 so chained merges accumulate at full precision
+    and round once at the end (the backward paths' policy).
+
+    Siblings implementing the same rule in their own layouts/sentinels:
+    ``parallel.ring_attention._merge_blocks`` ((B,T,H,D)/-inf) and
+    ``parallel.zigzag._merge_flash_block`` (running unnormalized state) —
+    a fix to the alive-row guard here likely applies there too."""
+    m = jnp.maximum(lse1, lse2)
+    alive = m > NEG_INF * 0.5
+    m_safe = jnp.where(alive, m, 0.0)
+    w1 = jnp.where(alive, jnp.exp(lse1 - m_safe), 0.0)
+    w2 = jnp.where(alive, jnp.exp(lse2 - m_safe), 0.0)
+    tot = jnp.maximum(w1 + w2, 1e-30)
+    o = (o1.astype(jnp.float32) * (w1 / tot)[..., None]
+         + o2.astype(jnp.float32) * (w2 / tot)[..., None])
+    lse = jnp.where(alive, m_safe + jnp.log(tot), NEG_INF)
+    return o, lse
+
+
 def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal, block_q,
-         block_k, interpret, window=None):
+         block_k, interpret, window=None, max_stage_rows=None):
+    """Forward dispatch: single kernel call when k/v fit the VMEM stage
+    budget, else kv-chunked calls (static position offsets into the masks
+    and block-skip ranges) merged through their logsumexps."""
+    S = k.shape[1]
+    C = _stage_chunk(
+        S, _row_bytes(k.shape[2], k.dtype.itemsize, segmented=segmented),
+        block_k, max_stage_rows,
+    )
+    if C >= S:
+        return _fwd_chunk(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads,
+                          causal, block_q, block_k, interpret, window, 0)
+    o = lse = None
+    for off in range(0, S, C):
+        kc = jax.lax.slice_in_dim(k, off, off + C, axis=1)
+        vc = jax.lax.slice_in_dim(v, off, off + C, axis=1)
+        sc = (jax.lax.slice_in_dim(seg_kv, off, off + C, axis=1)
+              if segmented else seg_kv)
+        oc, lsec = _fwd_chunk(q, kc, vc, seg_q, sc, segmented, heads,
+                              kv_heads, causal, block_q, block_k, interpret,
+                              window, off)
+        o, lse = (oc, lsec) if o is None else _merge_partials(o, lse, oc,
+                                                              lsec)
+    # The running merge stays fp32 across chunks; round once at the end.
+    return o.astype(q.dtype), lse
+
+
+def _fwd_chunk(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
+               block_q, block_k, interpret, window, kv_off):
     BH, T, D = q.shape
     S = k.shape[1]
     scale = 1.0 / math.sqrt(D)
     grid = (BH, T // block_q)
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, causal=causal, segmented=segmented,
-        scale=scale, window=window,
+        scale=scale, window=window, kv_off=kv_off,
     )
     kvr = _kv_row(heads, kv_heads)
     in_specs = [
@@ -315,7 +426,7 @@ def _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal, block_q,
 # --------------------------------------------------------------------- bwd
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, causal, segmented, scale, window=None,
+    block_q, causal, segmented, scale, window=None, q_off=0,
 ):
     # k/v_ref, dk/dv_ref: (1, BK, D); q/do_ref: (1, T, D); per-row refs
     # (lse/delta/segments) carry the trailing singleton lane dim (1, T, 1).
@@ -333,7 +444,7 @@ def _bwd_dkv_kernel(
 
     n_q = T // block_q
     q_start_blk, q_end_blk = _q_block_range(
-        ki, bk, block_q, n_q, causal, window
+        ki, bk, block_q, n_q, causal, window, q_off=q_off
     )
 
     def body(qi, carry):
@@ -346,7 +457,7 @@ def _bwd_dkv_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
-        s = _mask_scores(s, qi * block_q, ki * bk, causal, window)
+        s = _mask_scores(s, qi * block_q + q_off, ki * bk, causal, window)
         if segmented:
             seg_q = segq_ref[0, pl.ds(qi * block_q, block_q), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
@@ -381,7 +492,7 @@ def _bwd_dkv_kernel(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_k, causal, segmented, scale, window=None,
+    block_k, causal, segmented, scale, window=None, kv_off=0,
 ):
     if segmented:
         segq_ref, segk_ref, dq_ref = rest
@@ -398,7 +509,8 @@ def _bwd_dq_kernel(
     seg_q = segq_ref[0, :, 0] if segmented else None  # (BQ,)
 
     n_k = T // block_k
-    k_lo, n_k_eff = _k_block_range(qi, bq, block_k, n_k, causal, window)
+    k_lo, n_k_eff = _k_block_range(qi, bq, block_k, n_k, causal, window,
+                                   kv_off=kv_off)
 
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
@@ -407,7 +519,7 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        s = _mask_scores(s, qi * bq, ki * block_k, causal, window)
+        s = _mask_scores(s, qi * bq, ki * block_k + kv_off, causal, window)
         if segmented:
             seg_k = segk_ref[0, pl.ds(ki * block_k, block_k), 0]
             s = jnp.where(seg_q[:, None] == seg_k[None, :], s, NEG_INF)
@@ -430,7 +542,7 @@ def _bwd_dq_kernel(
 
 
 def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
-         residuals, g, dlse=None, window=None):
+         residuals, g, dlse=None, window=None, max_stage_rows=None):
     """Shared backward.  ``dlse`` (cotangent of the logsumexp output, used by
     the LSE-exposing API) folds into the kernels for free: ``∂lse_i/∂s_ij =
     p_ij``, so the lse cotangent just shifts the per-row delta —
@@ -452,51 +564,77 @@ def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
         delta = delta - dlse.astype(jnp.float32)
 
     kvr = _kv_row(heads, kv_heads)
-    dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, block_q=block_q, causal=causal,
-        segmented=segmented, scale=scale, window=window,
-    )
-    in_specs = [
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # q
-        pl.BlockSpec((1, block_k, D), lambda b, i: (kvr(b), i, 0)),  # k
-        pl.BlockSpec((1, block_k, D), lambda b, i: (kvr(b), i, 0)),  # v
-        pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),       # do
-        pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),       # lse
-        pl.BlockSpec((1, T, 1), lambda b, i: (b, 0, 0)),       # delta
-    ]
-    args = [q, k, v, do, lse[..., None], delta[..., None]]
-    if segmented:
-        in_specs += [
-            pl.BlockSpec((1, T, 1),
-                         lambda b, i: (b // heads, 0, 0)),       # seg (q rows)
-            pl.BlockSpec((1, block_k, 1),
-                         lambda b, i: (b // heads, i, 0)),       # seg (k blk)
-        ]
-        args += [seg_q[..., None], seg_kv[..., None]]
     vma = _vma_union(q, k, v, do, lse, delta,
                      *([seg_q, seg_kv] if segmented else []))
+
+    def dkv_call(q_c, do_c, lse_c, delta_c, seg_q_c, q_off, out_dtypes):
+        """dK/dV over ALL kv rows from one q-chunk (``(1, Tc, D)`` staged
+        q/do refs; kv blocked through the grid)."""
+        Tc = q_c.shape[1]
+        dkv_kernel = functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, causal=causal,
+            segmented=segmented, scale=scale, window=window, q_off=q_off,
+        )
+        in_specs = [
+            pl.BlockSpec((1, Tc, D), lambda b, i: (b, 0, 0)),       # q
+            pl.BlockSpec((1, block_k, D), lambda b, i: (kvr(b), i, 0)),  # k
+            pl.BlockSpec((1, block_k, D), lambda b, i: (kvr(b), i, 0)),  # v
+            pl.BlockSpec((1, Tc, D), lambda b, i: (b, 0, 0)),       # do
+            pl.BlockSpec((1, Tc, 1), lambda b, i: (b, 0, 0)),       # lse
+            pl.BlockSpec((1, Tc, 1), lambda b, i: (b, 0, 0)),       # delta
+        ]
+        args = [q_c, k, v, do_c, lse_c[..., None], delta_c[..., None]]
+        if segmented:
+            in_specs += [
+                pl.BlockSpec((1, Tc, 1),
+                             lambda b, i: (b // heads, 0, 0)),   # seg (q rows)
+                pl.BlockSpec((1, block_k, 1),
+                             lambda b, i: (b // heads, i, 0)),   # seg (k blk)
+            ]
+            args += [seg_q_c[..., None], seg_kv[..., None]]
+        return pl.pallas_call(
+            dkv_kernel,
+            grid=(BH, S // block_k),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, S, D), out_dtypes[0], vma=vma),
+                jax.ShapeDtypeStruct((BH, S, D), out_dtypes[1], vma=vma),
+            ],
+            interpret=interpret,
+        )(*args)
+
     # Under GQA the per-query-head partials leave the kernel in fp32 (the
     # kernel accumulates fp32 anyway) so the group sum adds unrounded
     # addends.  Transient HBM cost: dk/dv are (B·heads, S, D) fp32 before
     # the reduction — i.e. group × (and × 2 vs a bf16 wire) the size of the
-    # final (B·kv_heads, S, D) gradients.
-    dkv_dtypes = (
-        (jnp.float32, jnp.float32) if group > 1 else (k.dtype, v.dtype)
+    # final (B·kv_heads, S, D) gradients.  q-chunked accumulation (long T,
+    # :func:`_stage_chunk`) also sums in fp32 and rounds once at the end.
+    Cq = _stage_chunk(
+        T,
+        _row_bytes(D, q.dtype.itemsize, n_padded_f32=2, segmented=segmented),
+        block_q, max_stage_rows,
     )
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(BH, S // block_k),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), dkv_dtypes[0], vma=vma),
-            jax.ShapeDtypeStruct((BH, S, D), dkv_dtypes[1], vma=vma),
-        ],
-        interpret=interpret,
-    )(*args)
+    if Cq >= T:
+        dkv_dtypes = (
+            (jnp.float32, jnp.float32) if group > 1 else (k.dtype, v.dtype)
+        )
+        dk, dv = dkv_call(q, do, lse, delta, seg_q, 0, dkv_dtypes)
+    else:
+        dk = dv = None
+        for off in range(0, T, Cq):
+            sl = functools.partial(jax.lax.slice_in_dim, start_index=off,
+                                   limit_index=off + Cq, axis=1)
+            dkc, dvc = dkv_call(
+                sl(q), sl(do), sl(lse), sl(delta),
+                sl(seg_q) if segmented else seg_q, off,
+                (jnp.float32, jnp.float32),
+            )
+            dk = dkc if dk is None else dk + dkc
+            dv = dvc if dv is None else dv + dvc
     if group > 1:
         # Per-query-head kv gradients → per-kv-head (sum over each group of
         # consecutive query heads) in fp32, rounded once at the end.
@@ -508,61 +646,89 @@ def _bwd(segmented, heads, kv_heads, causal, block_q, block_k, interpret,
 
         dk = group_sum(dk, k.dtype)
         dv = group_sum(dv, v.dtype)
+    elif dk.dtype != k.dtype:
+        dk = dk.astype(k.dtype)
+        dv = dv.astype(v.dtype)
 
-    dq_kernel = functools.partial(
-        _bwd_dq_kernel, block_k=block_k, causal=causal,
-        segmented=segmented, scale=scale, window=window,
-    )
-    in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
-        pl.BlockSpec((1, S, D), lambda b, i: (kvr(b), 0, 0)),   # k
-        pl.BlockSpec((1, S, D), lambda b, i: (kvr(b), 0, 0)),   # v
-        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do
-        pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # lse
-        pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # delta
-    ]
-    args = [q, k, v, do, lse[..., None], delta[..., None]]
-    if segmented:
-        in_specs += [
-            pl.BlockSpec((1, block_q, 1),
-                         lambda b, i: (b // heads, i, 0)),       # seg (q blk)
-            pl.BlockSpec((1, S, 1),
-                         lambda b, i: (b // heads, 0, 0)),       # seg (k rows)
+    def dq_call(k_c, v_c, seg_kv_c, kv_off, out_dtype):
+        """dQ over all q rows from one kv-chunk (``(1, Sc, D)`` staged k/v
+        refs; q blocked through the grid)."""
+        Sc = k_c.shape[1]
+        dq_kernel = functools.partial(
+            _bwd_dq_kernel, block_k=block_k, causal=causal,
+            segmented=segmented, scale=scale, window=window, kv_off=kv_off,
+        )
+        in_specs = [
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
+            pl.BlockSpec((1, Sc, D), lambda b, i: (kvr(b), 0, 0)),  # k
+            pl.BlockSpec((1, Sc, D), lambda b, i: (kvr(b), 0, 0)),  # v
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),  # delta
         ]
-        args += [seg_q[..., None], seg_kv[..., None]]
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(BH, T // block_q),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype, vma=vma),
-        interpret=interpret,
-    )(*args)
+        args = [q, k_c, v_c, do, lse[..., None], delta[..., None]]
+        if segmented:
+            in_specs += [
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, i: (b // heads, i, 0)),   # seg (q blk)
+                pl.BlockSpec((1, Sc, 1),
+                             lambda b, i: (b // heads, 0, 0)),   # seg (k rows)
+            ]
+            args += [seg_q[..., None], seg_kv_c[..., None]]
+        return pl.pallas_call(
+            dq_kernel,
+            grid=(BH, T // block_q),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((BH, T, D), out_dtype, vma=vma),
+            interpret=interpret,
+        )(*args)
+
+    Ck = _stage_chunk(
+        S, _row_bytes(D, k.dtype.itemsize, segmented=segmented),
+        block_k, max_stage_rows,
+    )
+    if Ck >= S:
+        dq = dq_call(k, v, seg_kv, 0, q.dtype)
+    else:
+        dq = None
+        for off in range(0, S, Ck):
+            sl = functools.partial(jax.lax.slice_in_dim, start_index=off,
+                                   limit_index=off + Ck, axis=1)
+            dqc = dq_call(sl(k), sl(v),
+                          sl(seg_kv) if segmented else seg_kv, off,
+                          jnp.float32)
+            dq = dqc if dq is None else dq + dqc
+        dq = dq.astype(q.dtype)
     return dq, dk, dv
 
 
 # --------------------------------------------------------------------- api
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13)
 )
 def _flash_lse(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
-               block_q, block_k, interpret, window):
+               block_q, block_k, interpret, window, max_stage_rows):
     return _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
-                block_q, block_k, interpret, window=window)
+                block_q, block_k, interpret, window=window,
+                max_stage_rows=max_stage_rows)
 
 
 def _flash_lse_fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads,
-                   causal, block_q, block_k, interpret, window):
+                   causal, block_q, block_k, interpret, window,
+                   max_stage_rows):
     o, lse = _fwd(q, k, v, seg_q, seg_kv, segmented, heads, kv_heads, causal,
-                  block_q, block_k, interpret, window=window)
+                  block_q, block_k, interpret, window=window,
+                  max_stage_rows=max_stage_rows)
     return (o, lse), (q, k, v, seg_q, seg_kv, o, lse)
 
 
 def _flash_lse_bwd(segmented, heads, kv_heads, causal, block_q, block_k,
-                   interpret, window, residuals, g):
+                   interpret, window, max_stage_rows, residuals, g):
     do, dlse = g
     dq, dk, dv = _bwd(segmented, heads, kv_heads, causal, block_q, block_k,
-                      interpret, residuals, do, dlse=dlse, window=window)
+                      interpret, residuals, do, dlse=dlse, window=window,
+                      max_stage_rows=max_stage_rows)
     # Segments are integer-typed: their cotangent is the symbolic zero.
     return dq, dk, dv, None, None
 
@@ -601,6 +767,38 @@ def _default_block(length: int, cap: int) -> int:
     )
 
 
+#: Measured flash-vs-XLA crossover sequence length on the real chip
+#: (TPU v5 lite, bf16): XLA's materialized-scores attention WINS below it —
+#: at T=512/D=64 flash ran 0.86× of XLA end-to-end
+#: (result/seq2seq_tpu.json) because the block machinery doesn't amortize —
+#: while flash wins 2.1–2.5× at T=2048 (result/flash_tpu{_d64,}.json) and
+#: its advantage grows with T (result/longcontext_tpu.json).
+FLASH_MIN_SEQ = 1024
+
+
+def resolve_attention(impl: str, *lengths: int) -> str:
+    """Resolve an ``attention`` impl choice for the given sequence
+    length(s): ``'auto'`` returns ``'flash'`` when every length clears the
+    measured crossover (:data:`FLASH_MIN_SEQ`) AND tiles legally
+    (a multiple-of-8 block divides it — Mosaic's sublane rule), else
+    ``'xla'``.  Explicit ``'flash'``/``'xla'`` pass through unchanged."""
+    if impl not in ("flash", "xla", "auto"):
+        raise ValueError(
+            f"attention={impl!r}: expected 'flash', 'xla' or 'auto'"
+        )
+    if impl != "auto":
+        return impl
+    for n in lengths:
+        if n < FLASH_MIN_SEQ:
+            return "xla"
+        try:
+            if _default_block(n, 512) < 8:
+                return "xla"
+        except ValueError:
+            return "xla"
+    return "flash"
+
+
 def flash_attention_lse(
     q: jax.Array,
     k: jax.Array,
@@ -612,6 +810,7 @@ def flash_attention_lse(
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    max_stage_rows: Optional[int] = None,
 ):
     """Like :func:`flash_attention` but also returns the per-row logsumexp
     ``(B, H, T)`` — the merge state for blockwise/ring composition: two
@@ -724,7 +923,7 @@ def flash_attention_lse(
         seg_q = seg_kv = jnp.zeros((1, 1), jnp.int32)  # unused placeholder
     o, lse = _flash_lse(
         to_bh(q), to_bh(k), to_bh(v), seg_q, seg_kv, segmented, H, KH,
-        causal, block_q, block_k, interpret, window,
+        causal, block_q, block_k, interpret, window, max_stage_rows,
     )
     return (
         o.reshape(B, H, T, D).transpose(0, 2, 1, 3),
@@ -743,6 +942,7 @@ def flash_attention(
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    max_stage_rows: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention over ``(batch, seq, heads, head_dim)`` inputs; ``k``/
     ``v`` may use a different sequence length (cross-attention, non-causal).
@@ -767,11 +967,17 @@ def flash_attention(
     O(T·window) instead of O(T²) — combine with ``segment_ids`` for packed
     local attention.
 
+    Sequences too long for the kernels' full-row VMEM staging are
+    transparently chunked and the partials merged through their logsumexps
+    (``_stage_chunk``) — same math, unbounded T; ``max_stage_rows``
+    tightens the per-chunk row budget below the VMEM-derived default
+    (mainly a test hook).
+
     Thin facade over :func:`flash_attention_lse` (one custom-VJP path to
     maintain); the dropped lse output arrives in the backward as a zero
     cotangent, which folds away inside the shared kernels."""
     return flash_attention_lse(
         q, k, v, causal=causal, segment_ids=segment_ids,
         kv_segment_ids=kv_segment_ids, block_q=block_q, block_k=block_k,
-        interpret=interpret, window=window,
+        interpret=interpret, window=window, max_stage_rows=max_stage_rows,
     )[0]

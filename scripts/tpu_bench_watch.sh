@@ -115,6 +115,13 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# seq2seq bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/longcontext_tpu.json ]; then
+      echo "# running longcontext sweep at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/longcontext.py \
+        --out result/longcontext_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# longcontext rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/lm_tpu_355m.json ]; then
       echo "# running lm 355M bench at $(date +%H:%M:%S)" >&2
       timeout 1800 python benchmarks/lm.py --layers 24 --d-model 1024 \
@@ -129,7 +136,8 @@ print(float((x@x).sum()))
        && [ -s result/collectives_tpu.json ] && [ -s result/lm_tpu.json ] \
        && [ -s result/memory_tpu.json ] && [ -s result/overlap_tpu.json ] \
        && [ -s result/decode_tpu.json ] && [ -s result/seq2seq_tpu.json ] \
-       && [ -s result/lm_tpu_355m.json ]; then
+       && [ -s result/lm_tpu_355m.json ] \
+       && [ -s result/longcontext_tpu.json ]; then
       exit 0
     fi
   else

@@ -21,6 +21,7 @@ BENCHES = {
     "decode": ["benchmarks/decode.py", "--smoke"],
     "flash_interpret": ["benchmarks/flash_tpu.py", "--interpret-smoke"],
     "seq2seq": ["benchmarks/seq2seq.py", "--smoke"],
+    "longcontext": ["benchmarks/longcontext.py", "--smoke"],
 }
 
 

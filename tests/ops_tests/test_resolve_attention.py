@@ -1,0 +1,53 @@
+"""attention='auto': the measured flash-vs-XLA crossover policy.
+
+On-chip, XLA's materialized-scores attention beat the Pallas kernel at
+T=512/D=64 (result/seq2seq_tpu.json: flash 0.86×) while flash wins 2.1–2.5×
+at T=2048 (result/flash_tpu{_d64,}.json) — 'auto' encodes that crossover so
+models pick the measured-best path per shape."""
+
+import numpy as np
+
+from chainermn_tpu.ops import resolve_attention
+from chainermn_tpu.ops.flash_attention import FLASH_MIN_SEQ
+
+
+def test_explicit_impls_pass_through():
+    assert resolve_attention("flash", 64) == "flash"
+    assert resolve_attention("xla", 65536) == "xla"
+
+
+def test_auto_crossover():
+    assert resolve_attention("auto", FLASH_MIN_SEQ - 1) == "xla"
+    assert resolve_attention("auto", FLASH_MIN_SEQ) == "flash"
+    assert resolve_attention("auto", 2048) == "flash"
+    # Cross-attention: BOTH lengths must clear the crossover.
+    assert resolve_attention("auto", 2048, 512) == "xla"
+    assert resolve_attention("auto", 2048, 4096) == "flash"
+
+
+def test_auto_rejects_untileable_lengths():
+    # 1031 is prime: no multiple-of-8 block divides it and a full-dim
+    # block would be tile-legal only up to 1024 — auto falls back to XLA
+    # instead of letting the kernel raise.
+    assert resolve_attention("auto", 1031) == "xla"
+
+
+def test_models_resolve_auto(monkeypatch):
+    # A tiny ViT (T << crossover) built with the default 'auto' must take
+    # the XLA branch: flash_attention should never be called.
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu.ops as ops
+    from chainermn_tpu.models.vit import ViT
+
+    def boom(*a, **k):
+        raise AssertionError("flash path taken below the crossover")
+
+    monkeypatch.setattr(ops, "flash_attention", boom)
+    model = ViT(num_classes=4, patch=8, d_model=32, n_heads=2, d_ff=64,
+                n_layers=1, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(params, x)
+    assert logits.shape == (2, 4)
