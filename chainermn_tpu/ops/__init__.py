@@ -14,6 +14,7 @@ from chainermn_tpu.ops.augment import (
     random_flip,
 )
 from chainermn_tpu.ops.flash_attention import (
+    FLASH_MIN_SEQ,
     flash_attention,
     flash_attention_lse,
     reference_attention,
@@ -25,6 +26,7 @@ __all__ = [
     "flash_attention_lse",
     "reference_attention",
     "resolve_attention",
+    "FLASH_MIN_SEQ",
     "chunked_softmax_cross_entropy",
     "random_crop",
     "random_crop_flip",

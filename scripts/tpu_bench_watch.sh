@@ -115,6 +115,16 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# seq2seq bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/decode_tpu_b64.json ]; then
+      # Decode batch-scaling: the B=8 capture showed per-step latency
+      # dominating (bf16 params bought nothing) — tokens/sec should scale
+      # near-linearly with B until the MXU saturates.  B=64 probes that.
+      echo "# running decode B=64 bench at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/decode.py --batch 64 \
+        --out result/decode_tpu_b64.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# decode B=64 rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/bench_tpu_vit_auto.json ]; then
       # ViT re-capture under attention="auto": T=196 sits below the
       # measured flash crossover, so auto runs XLA attention — testing the
@@ -162,7 +172,8 @@ print(float((x@x).sum()))
        && [ -s result/lm_tpu_355m.json ] \
        && [ -s result/longcontext_tpu.json ] \
        && [ -s result/bench_tpu_vit_auto.json ] \
-       && [ -s result/lm_tpu_774m.json ]; then
+       && [ -s result/lm_tpu_774m.json ] \
+       && [ -s result/decode_tpu_b64.json ]; then
       exit 0
     fi
   else
