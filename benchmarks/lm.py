@@ -36,6 +36,9 @@ def main():
                     help="stream the LM head in vocab chunks of this size "
                          "(chunked_softmax_cross_entropy) instead of "
                          "materializing (B,T,vocab) logits")
+    ap.add_argument("--pos-enc", default="learned",
+                    choices=("learned", "rope"),
+                    help="positional scheme (rope = rotary q/k, no table)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for CPU plumbing checks")
     ap.add_argument("--out", default=None)
@@ -95,7 +98,7 @@ def main():
         model = TransformerLM(
             vocab=args.vocab, n_layers=args.layers, d_model=args.d_model,
             n_heads=args.heads, d_ff=args.d_ff, max_len=args.seq,
-            attention=impl, remat=args.remat,
+            attention=impl, remat=args.remat, pos_enc=args.pos_enc,
         )
         opt = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
         # Jit both inits: an eager flax/optax init is hundreds of op-by-op

@@ -57,9 +57,14 @@ class _DecoderBlock(nn.Module):
     #: last ``window`` positions only; the flash kernel skips out-of-window
     #: blocks (O(T·window) attention compute).
     window: int = 0
+    #: "learned" (parent adds a position table to the embeddings) or
+    #: "rope" (this block rotates q/k — the parent adds nothing to ``h``
+    #: and passes shared per-step cos/sin ``rope`` tables instead).
+    pos_enc: str = "learned"
 
     @nn.compact
-    def __call__(self, h, segment_ids=None, cache=None, decode_pos=None):
+    def __call__(self, h, segment_ids=None, cache=None, decode_pos=None,
+                 rope=None):
         """Full path: ``h`` (B, T, D) → (B, T, D).  Decode path (``cache``
         given): ``h`` (B, 1, D) for position ``decode_pos``, attends against
         the KV cache, returns ``(h, new_cache)``.  Both paths create the
@@ -70,6 +75,7 @@ class _DecoderBlock(nn.Module):
             reference_attention,
             resolve_attention,
         )
+        from chainermn_tpu.ops.rope import apply_rope
 
         T = h.shape[1]
         D, H = self.d_model, self.n_heads
@@ -107,12 +113,6 @@ class _DecoderBlock(nn.Module):
             # of shorter rows unattended.
             B = k.shape[0]
             if jnp.ndim(decode_pos) == 0:
-                kc = lax.dynamic_update_slice(
-                    cache["k"], k, (0, decode_pos, 0, 0)
-                )
-                vc = lax.dynamic_update_slice(
-                    cache["v"], v, (0, decode_pos, 0, 0)
-                )
                 q_pos = jnp.broadcast_to(
                     (decode_pos + jnp.arange(T))[None], (B, T)
                 )
@@ -122,9 +122,23 @@ class _DecoderBlock(nn.Module):
                         "per-row decode_pos requires single-token chunks "
                         f"(T == 1), got T = {T}"
                     )
+                q_pos = decode_pos[:, None]  # (B, 1)
+            if self.pos_enc == "rope":
+                # Rotate BEFORE the cache write: the cache stores
+                # position-rotated keys, so cached entries never need
+                # re-rotation (RoPE's relative property does the rest).
+                q = apply_rope(q, tables=rope)
+                k = apply_rope(k, tables=rope)
+            if jnp.ndim(decode_pos) == 0:
+                kc = lax.dynamic_update_slice(
+                    cache["k"], k, (0, decode_pos, 0, 0)
+                )
+                vc = lax.dynamic_update_slice(
+                    cache["v"], v, (0, decode_pos, 0, 0)
+                )
+            else:
                 kc = cache["k"].at[jnp.arange(B), decode_pos].set(k[:, 0])
                 vc = cache["v"].at[jnp.arange(B), decode_pos].set(v[:, 0])
-                q_pos = decode_pos[:, None]  # (B, 1)
             # Grouped attention against the (B, L, KH, Dh) cache: query head
             # h reads kv head h // (H // KH).  KH == H reduces to classic
             # multi-head (group axis of size 1).
@@ -152,26 +166,34 @@ class _DecoderBlock(nn.Module):
                 "bkgqt,btkd->bqkgd", p, vc.astype(jnp.float32)
             ).reshape(q.shape[0], T, H, D // H).astype(q.dtype)
             new_cache = {"k": kc, "v": vc}
-        elif self.attention not in ("flash", "xla", "auto"):
-            raise ValueError(
-                f"attention={self.attention!r}: expected 'flash', 'xla' "
-                "or 'auto'"
-            )
-        elif resolve_attention(self.attention, T) == "flash":
-            # Library-default blocks: largest sweep-winning power-of-2
-            # divisors of T (flash needs T % block == 0); natural lengths
-            # work without upstream padding.  'auto' picks flash/xla by the
-            # measured on-chip crossover (ops.FLASH_MIN_SEQ).
-            block = None
-            a = flash_attention(q, k, v, causal=True,
-                                segment_ids=segment_ids, block_q=block,
-                                block_k=block,
-                                window=self.window or None)
         else:
-            a = reference_attention(
-                q, k, v, causal=True, segment_ids=segment_ids,
-                window=self.window or None,
-            ).astype(q.dtype)
+            if self.attention not in ("flash", "xla", "auto"):
+                raise ValueError(
+                    f"attention={self.attention!r}: expected 'flash', "
+                    "'xla' or 'auto'"
+                )
+            if self.pos_enc == "rope":
+                # Shared per-step tables from the parent (packed rows bake
+                # per-document restart positions into them).  Rotation is
+                # elementwise — XLA fuses it into the projection epilogue.
+                q = apply_rope(q, tables=rope)
+                k = apply_rope(k, tables=rope)
+            if resolve_attention(self.attention, T) == "flash":
+                # Library-default blocks: largest sweep-winning
+                # power-of-2 divisors of T (flash needs T % block == 0);
+                # natural lengths work without upstream padding.  'auto'
+                # picks flash/xla by the measured on-chip crossover
+                # (ops.FLASH_MIN_SEQ).
+                block = None
+                a = flash_attention(q, k, v, causal=True,
+                                    segment_ids=segment_ids, block_q=block,
+                                    block_k=block,
+                                    window=self.window or None)
+            else:
+                a = reference_attention(
+                    q, k, v, causal=True, segment_ids=segment_ids,
+                    window=self.window or None,
+                ).astype(q.dtype)
         o = nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype, name="proj")(a)
         h = h + o
         x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
@@ -213,6 +235,11 @@ class TransformerLM(nn.Module):
     #: standard HBM lever for deep/long-context configs (pairs with the
     #: optimizers' ``accum_steps``).
     remat: bool = False
+    #: "learned" (GPT-2-style position table added to the embeddings,
+    #: length-capped at ``max_len``) or "rope" (rotary q/k rotation in
+    #: every block — no table, no length cap beyond memory; packed rows
+    #: restart rotation per document exactly like the learned restart).
+    pos_enc: str = "learned"
 
     @nn.compact
     def __call__(self, tokens, segment_ids=None, return_hidden: bool = False,
@@ -232,24 +259,17 @@ class TransformerLM(nn.Module):
         ``(logits, new_cache)``.  See :func:`lm_generate`."""
         B, T = tokens.shape
         D = self.d_model
+        if self.pos_enc not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_enc={self.pos_enc!r}: expected 'learned' or 'rope'"
+            )
         h = nn.Embed(self.vocab, D, dtype=self.dtype, name="embed")(tokens)
-        pos = self.param(
-            "pos", nn.initializers.normal(0.02), (self.max_len, D), jnp.float32
-        )
-        if cache is not None:
-            if jnp.ndim(decode_pos) == 0:
-                h = h + lax.dynamic_slice(
-                    pos, (decode_pos, 0), (T, D)
-                )[None].astype(self.dtype)
-            else:
-                # Per-row positions (ragged-prompt decode, T == 1).
-                h = h + pos[decode_pos][:, None].astype(self.dtype)
-        elif segment_ids is None:
-            h = h + pos[None, :T].astype(self.dtype)
-        else:
+        positions = None
+        if segment_ids is not None and cache is None:
             # Per-document position restart: contiguous segments, so each
             # token's offset is its index minus its segment's start (cummax
-            # of boundary indices).
+            # of boundary indices).  Shared by both schemes: the learned
+            # table gathers at these positions, RoPE rotates by them.
             idx = jnp.arange(T, dtype=jnp.int32)[None, :]
             is_new = jnp.concatenate(
                 [
@@ -259,7 +279,41 @@ class TransformerLM(nn.Module):
                 axis=1,
             )
             starts = lax.cummax(jnp.where(is_new, idx, 0), axis=1)
-            h = h + pos[idx - starts].astype(self.dtype)
+            positions = idx - starts  # (B, T)
+        if self.pos_enc == "learned":
+            pos = self.param(
+                "pos", nn.initializers.normal(0.02), (self.max_len, D),
+                jnp.float32,
+            )
+            if cache is not None:
+                if jnp.ndim(decode_pos) == 0:
+                    h = h + lax.dynamic_slice(
+                        pos, (decode_pos, 0), (T, D)
+                    )[None].astype(self.dtype)
+                else:
+                    # Per-row positions (ragged-prompt decode, T == 1).
+                    h = h + pos[decode_pos][:, None].astype(self.dtype)
+            elif positions is None:
+                h = h + pos[None, :T].astype(self.dtype)
+            else:
+                h = h + pos[positions].astype(self.dtype)
+        # RoPE adds nothing to h; compute the cos/sin tables ONCE here and
+        # share them across every block (n_layers × 2 rotations reuse one
+        # set of transcendentals — also under remat, where blocks would
+        # otherwise redo them in the backward).
+        rope = None
+        if self.pos_enc == "rope":
+            from chainermn_tpu.ops.rope import rope_tables
+
+            if cache is None:
+                pos_arr = (
+                    jnp.arange(T) if positions is None else positions
+                )
+            elif jnp.ndim(decode_pos) == 0:
+                pos_arr = decode_pos + jnp.arange(T)
+            else:
+                pos_arr = decode_pos[:, None]  # (B, 1) per-row decode
+            rope = rope_tables(pos_arr, D // self.n_heads)
         block_cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
         new_cache = []
         for i in range(self.n_layers):
@@ -267,13 +321,13 @@ class TransformerLM(nn.Module):
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
                 dtype=self.dtype, attention=self.attention,
                 n_kv_heads=self.n_kv_heads, window=self.window,
-                name=f"block_{i}",
+                pos_enc=self.pos_enc, name=f"block_{i}",
             )
             if cache is not None:
-                h, c = blk(h, None, cache[i], decode_pos)
+                h, c = blk(h, None, cache[i], decode_pos, rope=rope)
                 new_cache.append(c)
             else:
-                h = blk(h, segment_ids)
+                h = blk(h, segment_ids, rope=rope)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
         if return_hidden:
             return h

@@ -8,6 +8,7 @@ code in interpret mode).
 """
 
 from chainermn_tpu.ops.chunked_ce import chunked_softmax_cross_entropy
+from chainermn_tpu.ops.rope import apply_rope
 from chainermn_tpu.ops.augment import (
     random_crop,
     random_crop_flip,
@@ -28,6 +29,7 @@ __all__ = [
     "resolve_attention",
     "FLASH_MIN_SEQ",
     "chunked_softmax_cross_entropy",
+    "apply_rope",
     "random_crop",
     "random_crop_flip",
     "random_flip",

@@ -1,0 +1,121 @@
+"""Rotary position embeddings (pos_enc="rope"): the rotation math, and the
+three LM paths that must agree on it — full training forward, packed rows
+with per-document restart, and KV-cache decode (which stores rotated keys
+and never re-rotates)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models import TransformerLM, lm_loss
+from chainermn_tpu.ops.rope import apply_rope
+
+
+def test_rope_relative_property_and_norm():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 8, 2, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 8, 2, 16), jnp.float32)
+    pos = jnp.arange(8)
+    # Norm-preserving (a rotation).
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(apply_rope(q, pos)), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1), rtol=1e-5,
+    )
+    # <rope(q, m), rope(k, n)> depends only on m - n: shifting both
+    # positions by a constant leaves every score unchanged.
+    s0 = jnp.einsum("bthd,bshd->bhts", apply_rope(q, pos),
+                    apply_rope(k, pos))
+    s7 = jnp.einsum("bthd,bshd->bhts", apply_rope(q, pos + 7),
+                    apply_rope(k, pos + 7))
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s7), atol=1e-4)
+
+
+def test_rope_odd_head_dim_rejected():
+    with pytest.raises(ValueError, match="even head dim"):
+        apply_rope(jnp.zeros((1, 4, 1, 7)), jnp.arange(4))
+
+
+def _model(T=16, **kw):
+    cfg = dict(vocab=40, n_layers=2, d_model=32, n_heads=2, d_ff=64,
+               max_len=T, dtype=jnp.float32, attention="xla",
+               pos_enc="rope")
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def test_rope_has_no_position_table():
+    model = _model()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 16), jnp.int32)
+    )["params"]
+    assert "pos" not in params  # no learned table, no max_len cap
+
+
+def test_rope_decode_prefill_matches_full_forward():
+    T = 16
+    model = _model(T)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, T), jnp.int32)
+    )["params"]
+    rng = np.random.RandomState(1)
+    toks = jnp.asarray(rng.randint(0, 40, size=(2, T)).astype(np.int32))
+    full = model.apply({"params": params}, toks)
+    cache = model.init_cache(2)
+    got = []
+    for i in range(T):
+        logits, cache = model.apply(
+            {"params": params}, toks[:, i : i + 1], cache=cache,
+            decode_pos=i,
+        )
+        got.append(logits[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(jnp.stack(got, axis=1)), np.asarray(full),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+def test_rope_packed_document_matches_alone():
+    # Doc B packed behind doc A (own segment, restart positions) must
+    # compute exactly what doc B computes alone at the row start.
+    model = _model(T=24)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 24), jnp.int32)
+    )["params"]
+    rng = np.random.RandomState(2)
+    doc_a = rng.randint(0, 40, size=12).astype(np.int32)
+    doc_b = rng.randint(0, 40, size=12).astype(np.int32)
+    packed = jnp.asarray(np.concatenate([doc_a, doc_b])[None])
+    seg = jnp.asarray(
+        np.concatenate([np.zeros(12), np.ones(12)]).astype(np.int32)[None]
+    )
+    packed_logits = model.apply({"params": params}, packed,
+                                segment_ids=seg)[0, 12:]
+    alone_logits = model.apply(
+        {"params": params}, jnp.asarray(doc_b[None]),
+        segment_ids=jnp.zeros((1, 12), jnp.int32),
+    )[0]
+    np.testing.assert_allclose(np.asarray(packed_logits),
+                               np.asarray(alone_logits),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_rope_composes_with_gqa_window_flash():
+    # The full feature matrix in one training step: rope + grouped-query +
+    # sliding window on the flash kernel (interpret off-TPU), loss finite
+    # and differentiable.
+    model = _model(T=64, attention="flash", n_kv_heads=1, window=16)
+    toks = jnp.asarray(
+        np.random.RandomState(3).randint(0, 40, size=(2, 64)).astype(np.int32)
+    )
+    tgts = jnp.concatenate(
+        [toks[:, 1:], jnp.full((2, 1), -1, jnp.int32)], axis=1
+    )
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    loss, grads = jax.value_and_grad(
+        lambda p: lm_loss(model)(p, (toks, tgts))[0]
+    )(params)
+    assert bool(jnp.isfinite(loss))
+    assert all(bool(jnp.isfinite(g).all())
+               for g in jax.tree_util.tree_leaves(grads))
