@@ -391,7 +391,9 @@ def lm_generate(
     if n_new < 1:
         return jnp.zeros((B, 0), jnp.int32)
     total = P + n_new
-    if total > model.max_len:
+    if total > model.max_len and model.pos_enc == "learned":
+        # Only the learned position table caps generation length; RoPE has
+        # no table — the cache (sized to `total` below) is the only limit.
         raise ValueError(
             f"prompt ({P}) + n_new ({n_new}) exceeds max_len "
             f"{model.max_len}"

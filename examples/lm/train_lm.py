@@ -53,6 +53,14 @@ def main():
     ap.add_argument("--pack", action="store_true",
                     help="train on packed variable-length documents "
                          "(segment-masked attention, per-doc positions)")
+    ap.add_argument("--rope", action="store_true",
+                    help="rotary position embeddings instead of the "
+                         "learned table (no max_len cap)")
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention: kv heads (0 = classic "
+                         "multi-head; must divide the 4 query heads)")
+    ap.add_argument("--window", type=int, default=0,
+                    help="sliding-window attention size (0 = full)")
     args = ap.parse_args()
     if args.generate and 16 + args.generate > args.seq_len:
         # Fail fast, not after the whole training run: the 16-token prompt
@@ -130,6 +138,8 @@ def main():
         n_heads=4, d_ff=4 * args.d_model, max_len=T,
         dtype=jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16,
         remat=args.remat,
+        pos_enc="rope" if args.rope else "learned",
+        n_kv_heads=args.kv_heads, window=args.window,
     )
     params = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)

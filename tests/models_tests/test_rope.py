@@ -101,6 +101,28 @@ def test_rope_packed_document_matches_alone():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_rope_generates_past_max_len():
+    # No position table → no max_len cap: generation may run past it (the
+    # cache is sized to prompt + n_new).  The learned scheme still rejects.
+    from chainermn_tpu.models import lm_generate
+
+    model = _model(T=16, n_layers=1)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 40, (2, 8)).astype(np.int32)
+    )
+    out = lm_generate(model, params, prompt, n_new=24)  # 32 > max_len 16
+    assert out.shape == (2, 24)
+    learned = _model(T=16, n_layers=1, pos_enc="learned")
+    lp = learned.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        lm_generate(learned, lp, prompt, n_new=24)
+
+
 def test_rope_composes_with_gqa_window_flash():
     # The full feature matrix in one training step: rope + grouped-query +
     # sliding window on the flash kernel (interpret off-TPU), loss finite
