@@ -561,10 +561,26 @@ class ParallelLMConfig(NamedTuple):
     n_experts: int  # == size of the `model` axis
     moe_k: int = 2
     capacity_factor: float = 0.0  # 0 → ample (no drops; exact vs dense oracle)
+    #: "learned" (seq-sharded slice of a position table) or "rope" (rotary
+    #: q/k rotation at GLOBAL positions — each seq shard rotates by
+    #: ``seq_rank·T_local + arange``, so the ring-circulated keys carry
+    #: their true positions and relative attention is exact across shards).
+    pos_enc: str = "learned"
+
+
+def _check_pos_enc(cfg: ParallelLMConfig) -> None:
+    """Fail fast on a bad ``pos_enc`` (the TransformerLM contract): any
+    string other than 'rope' would otherwise silently run the learned
+    branch."""
+    if cfg.pos_enc not in ("learned", "rope"):
+        raise ValueError(
+            f"pos_enc={cfg.pos_enc!r}: expected 'learned' or 'rope'"
+        )
 
 
 def init_parallel_lm(rng: np.random.RandomState, cfg: ParallelLMConfig) -> Dict:
     """Host-side init of the stage-stacked parameter pytree."""
+    _check_pos_enc(cfg)
     S, D, H, F, E = (
         cfg.n_stages, cfg.d_model, cfg.n_heads, cfg.d_ff, cfg.n_experts
     )
@@ -574,7 +590,7 @@ def init_parallel_lm(rng: np.random.RandomState, cfg: ParallelLMConfig) -> Dict:
         scale = scale if scale is not None else 1.0 / math.sqrt(shape[0])
         return (rng.normal(size=shape) * scale).astype(np.float32)
 
-    return {
+    tree = {
         "embed": g(cfg.vocab, D, scale=0.02),
         "pos": g(cfg.max_len, D, scale=0.02),
         "stages": {
@@ -592,13 +608,17 @@ def init_parallel_lm(rng: np.random.RandomState, cfg: ParallelLMConfig) -> Dict:
         "ln_f_bias": np.zeros((D,), np.float32),
         "lm_head": g(D, cfg.vocab, scale=1.0 / math.sqrt(D)),
     }
+    if cfg.pos_enc == "rope":
+        del tree["pos"]  # rotary: no table, no max_len cap
+    return tree
 
 
 def parallel_lm_specs(cfg: ParallelLMConfig):
     """PartitionSpecs matching :func:`init_parallel_lm`'s pytree."""
     from jax.sharding import PartitionSpec as P
 
-    return {
+    _check_pos_enc(cfg)
+    specs = {
         "embed": P(),
         "pos": P(),
         "stages": {
@@ -616,6 +636,9 @@ def parallel_lm_specs(cfg: ParallelLMConfig):
         "ln_f_bias": P(),
         "lm_head": P(),
     }
+    if cfg.pos_enc == "rope":
+        del specs["pos"]
+    return specs
 
 
 def _layer_norm(x, scale, bias, eps=1e-5):
@@ -632,12 +655,13 @@ class ParallelLM:
     """
 
     def __init__(self, cfg: ParallelLMConfig, stage_comm, n_microbatches: int):
+        _check_pos_enc(cfg)
         self.cfg = cfg
         self.scomm = stage_comm
         self.n_micro = n_microbatches
 
     # --------------------------------------------------- stage (one block)
-    def _stage_apply(self, p, h):
+    def _stage_apply(self, p, h, rope=None):
         # p: this device's (stage, model) shard of the stacked stage params
         # (leading stage axis 1; expert/head axes local).  h: (B, Tl, D).
         cfg = self.cfg
@@ -645,6 +669,14 @@ class ParallelLM:
         x = _layer_norm(h, p["ln1_scale"][0], p["ln1_bias"][0])
         qkv = jnp.einsum("btd,dche->btche", x, p["wqkv"][0])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if rope is not None:
+            # Rotation at GLOBAL positions happens BEFORE the ring: the
+            # keys each shard circulates already carry their true
+            # positions, so cross-shard relative attention is exact.
+            from chainermn_tpu.ops.rope import apply_rope
+
+            q = apply_rope(q, tables=rope)
+            k = apply_rope(k, tables=rope)
         a = ring_self_attention(q, k, v, "seq", causal=True)  # SP ring
         o = jnp.einsum("bthe,hed->btd", a, p["wo"][0])
         o = lax.psum(o, "model")  # TP contraction over head shards
@@ -684,11 +716,24 @@ class ParallelLM:
         B, Tl = tokens.shape
         seq_rank = lax.axis_index("seq")
         h = params["embed"][tokens]
-        pos = lax.dynamic_slice_in_dim(
-            params["pos"], seq_rank * Tl, Tl, axis=0
+        rope = None
+        if cfg.pos_enc == "rope":
+            from chainermn_tpu.ops.rope import rope_tables
+
+            # Global positions for THIS seq shard; one set of tables
+            # shared by every pipeline stage.
+            rope = rope_tables(
+                seq_rank * Tl + jnp.arange(Tl), cfg.d_model // cfg.n_heads
+            )
+        else:
+            pos = lax.dynamic_slice_in_dim(
+                params["pos"], seq_rank * Tl, Tl, axis=0
+            )
+            h = h + pos[None]
+        pipe = PipelineChain(
+            lambda p, x: self._stage_apply(p, x, rope=rope),
+            self.scomm, self.n_micro,
         )
-        h = h + pos[None]
-        pipe = PipelineChain(self._stage_apply, self.scomm, self.n_micro)
         h = pipe(params["stages"], h)
         h = _layer_norm(h, params["ln_f_scale"], params["ln_f_bias"])
         return h @ params["lm_head"]
@@ -760,12 +805,24 @@ def dense_lm_reference(params_host: Dict, cfg: ParallelLMConfig, tokens):
     p = jax.tree_util.tree_map(jnp.asarray, params_host)
     B, T = tokens.shape
     D = cfg.d_model
-    h = p["embed"][tokens] + p["pos"][None, :T]
+    h = p["embed"][tokens]
+    rope = None
+    if cfg.pos_enc == "rope":
+        from chainermn_tpu.ops.rope import rope_tables
+
+        rope = rope_tables(jnp.arange(T), D // cfg.n_heads)
+    else:
+        h = h + p["pos"][None, :T]
     for s in range(cfg.n_stages):
         st = {k: v[s] for k, v in p["stages"].items()}
         x = _layer_norm(h, st["ln1_scale"], st["ln1_bias"])
         qkv = jnp.einsum("btd,dche->btche", x, st["wqkv"])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if rope is not None:
+            from chainermn_tpu.ops.rope import apply_rope
+
+            q = apply_rope(q, tables=rope)
+            k = apply_rope(k, tables=rope)
         scale = 1.0 / math.sqrt(q.shape[-1])
         s_ = jnp.einsum("bqhe,bkhe->bhqk", q, k) * scale
         s_ = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s_, -jnp.inf)

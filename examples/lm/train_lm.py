@@ -62,9 +62,10 @@ def main():
     ap.add_argument("--window", type=int, default=0,
                     help="sliding-window attention size (0 = full)")
     args = ap.parse_args()
-    if args.generate and 16 + args.generate > args.seq_len:
+    if args.generate and 16 + args.generate > args.seq_len and not args.rope:
         # Fail fast, not after the whole training run: the 16-token prompt
-        # plus the generated tokens must fit the model's max_len.
+        # plus the generated tokens must fit the learned table's max_len
+        # (rope has no cap — lm_generate sizes the cache to the request).
         ap.error(f"--generate {args.generate} + 16-token prompt exceeds "
                  f"--seq-len {args.seq_len}")
 

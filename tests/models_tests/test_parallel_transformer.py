@@ -25,26 +25,31 @@ CFG = ParallelLMConfig(
 )
 
 
-@pytest.fixture()
-def setup(devices):
+@pytest.fixture(params=["learned", "rope"])
+def setup(request, devices):
+    # Both positional schemes run the SAME oracle-parity suite: under
+    # "rope" each seq shard rotates q/k at its GLOBAL positions before the
+    # ring, and the param tree carries no "pos" table.
+    cfg = CFG._replace(pos_enc=request.param)
     mesh = cmn.hybrid_mesh(
         {"data": 1, "stage": 2, "model": 2, "seq": 2}, devices=devices
     )
     comm = cmn.XlaCommunicator(mesh)
-    lm = ParallelLM(CFG, comm.sub("stage"), n_microbatches=2)
+    lm = ParallelLM(cfg, comm.sub("stage"), n_microbatches=2)
     rng = np.random.RandomState(0)
-    params = init_parallel_lm(rng, CFG)
+    params = init_parallel_lm(rng, cfg)
+    assert ("pos" in params) == (cfg.pos_enc == "learned")
     B, T = 4, 16
-    tokens = rng.randint(0, CFG.vocab, size=(B, T)).astype(np.int32)
+    tokens = rng.randint(0, cfg.vocab, size=(B, T)).astype(np.int32)
     targets = np.concatenate(
         [tokens[:, 1:], np.full((B, 1), -1, np.int32)], axis=1
     )
-    return mesh, lm, params, tokens, targets
+    return cfg, mesh, lm, params, tokens, targets
 
 
 def test_parallel_forward_matches_dense(setup):
-    mesh, lm, params, tokens, _ = setup
-    specs = parallel_lm_specs(CFG)
+    cfg, mesh, lm, params, tokens, _ = setup
+    specs = parallel_lm_specs(cfg)
     f = jax.jit(
         jax.shard_map(
             lm.apply,
@@ -55,13 +60,13 @@ def test_parallel_forward_matches_dense(setup):
         )
     )
     out = np.asarray(f(params, tokens))
-    ref = np.asarray(dense_lm_reference(params, CFG, tokens))
+    ref = np.asarray(dense_lm_reference(params, cfg, tokens))
     np.testing.assert_allclose(out, ref, atol=5e-5, rtol=1e-3)
 
 
 def test_parallel_loss_and_grads_match_dense(setup):
-    mesh, lm, params, tokens, targets = setup
-    specs = parallel_lm_specs(CFG)
+    cfg, mesh, lm, params, tokens, targets = setup
+    specs = parallel_lm_specs(cfg)
 
     def step(params, batch):
         loss, grads = jax.value_and_grad(lm.loss)(params, batch)
@@ -81,7 +86,7 @@ def test_parallel_loss_and_grads_match_dense(setup):
 
     def dense_loss(params, batch):
         tokens, targets = batch
-        logits = dense_lm_reference(params, CFG, tokens)
+        logits = dense_lm_reference(params, cfg, tokens)
         mask = (targets >= 0).astype(jnp.float32)
         safe = jnp.maximum(targets, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)
@@ -118,8 +123,8 @@ def test_parallel_train_steps_decrease_loss(setup):
 
     from chainermn_tpu.optimizers import optimizer_state_specs
 
-    mesh, lm, params, tokens, targets = setup
-    specs = parallel_lm_specs(CFG)
+    cfg, mesh, lm, params, tokens, targets = setup
+    specs = parallel_lm_specs(cfg)
     tx = optax.sgd(0.5)
     opt_state = tx.init(params)
     opt_specs = optimizer_state_specs(opt_state, params, specs)
