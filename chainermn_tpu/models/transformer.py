@@ -64,7 +64,7 @@ class _DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, h, segment_ids=None, cache=None, decode_pos=None,
-                 rope=None):
+                 rope=None, rolling=False):
         """Full path: ``h`` (B, T, D) → (B, T, D).  Decode path (``cache``
         given): ``h`` (B, 1, D) for position ``decode_pos``, attends against
         the KV cache, returns ``(h, new_cache)``.  Both paths create the
@@ -112,6 +112,23 @@ class _DecoderBlock(nn.Module):
             # causal masking then keeps the not-yet-overwritten pad slots
             # of shorter rows unattended.
             B = k.shape[0]
+            if rolling:
+                # Ring-buffer cache of size `window`: slot = pos mod W.
+                # O(window) memory for unbounded streaming decode — slot s
+                # holds the LATEST position ≡ s (mod W), which is exactly
+                # the sliding window (pos − W, pos].
+                if not self.window or cache["k"].shape[1] != self.window:
+                    raise ValueError(
+                        "rolling decode needs a window model and a "
+                        f"window-sized cache (window={self.window}, cache "
+                        f"len {cache['k'].shape[1]})"
+                    )
+                if T != 1:
+                    raise ValueError(
+                        "rolling decode is single-token (T == 1); prefill "
+                        "through a full cache and convert (lm_generate "
+                        f"does) — got T = {T}"
+                    )
             if jnp.ndim(decode_pos) == 0:
                 q_pos = jnp.broadcast_to(
                     (decode_pos + jnp.arange(T))[None], (B, T)
@@ -129,16 +146,19 @@ class _DecoderBlock(nn.Module):
                 # re-rotation (RoPE's relative property does the rest).
                 q = apply_rope(q, tables=rope)
                 k = apply_rope(k, tables=rope)
+            write_pos = (
+                decode_pos % self.window if rolling else decode_pos
+            )
             if jnp.ndim(decode_pos) == 0:
                 kc = lax.dynamic_update_slice(
-                    cache["k"], k, (0, decode_pos, 0, 0)
+                    cache["k"], k, (0, write_pos, 0, 0)
                 )
                 vc = lax.dynamic_update_slice(
-                    cache["v"], v, (0, decode_pos, 0, 0)
+                    cache["v"], v, (0, write_pos, 0, 0)
                 )
             else:
-                kc = cache["k"].at[jnp.arange(B), decode_pos].set(k[:, 0])
-                vc = cache["v"].at[jnp.arange(B), decode_pos].set(v[:, 0])
+                kc = cache["k"].at[jnp.arange(B), write_pos].set(k[:, 0])
+                vc = cache["v"].at[jnp.arange(B), write_pos].set(v[:, 0])
             # Grouped attention against the (B, L, KH, Dh) cache: query head
             # h reads kv head h // (H // KH).  KH == H reduces to classic
             # multi-head (group axis of size 1).
@@ -149,17 +169,29 @@ class _DecoderBlock(nn.Module):
                 kc.astype(jnp.float32),
             ) / math.sqrt(D // H)
             t_idx = jnp.arange(kc.shape[1])
-            visible = (
-                t_idx[None, None, None, None, :]
-                <= q_pos[:, None, None, :, None]
-            )
-            if self.window:
-                # Decode twin of the training-time sliding window: only the
-                # last `window` positions stay attendable.
-                visible &= (
-                    t_idx[None, None, None, None, :]
-                    > q_pos[:, None, None, :, None] - self.window
+            if rolling:
+                # Slot s holds absolute position pos − ((pos − s) mod W):
+                # the latest position ≡ s that is ≤ pos.  Negative ⇒ the
+                # slot was never written (early steps) — mask it.  Window
+                # and causality are automatic: every held position lies in
+                # (pos − W, pos].
+                pos_b = q_pos[:, 0]  # (B,), T == 1
+                p_s = pos_b[:, None] - (
+                    (pos_b[:, None] - t_idx[None, :]) % self.window
                 )
+                visible = (p_s >= 0)[:, None, None, None, :]
+            else:
+                visible = (
+                    t_idx[None, None, None, None, :]
+                    <= q_pos[:, None, None, :, None]
+                )
+                if self.window:
+                    # Decode twin of the training-time sliding window: only
+                    # the last `window` positions stay attendable.
+                    visible &= (
+                        t_idx[None, None, None, None, :]
+                        > q_pos[:, None, None, :, None] - self.window
+                    )
             s = jnp.where(visible, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             a = jnp.einsum(
@@ -243,7 +275,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, segment_ids=None, return_hidden: bool = False,
-                 cache=None, decode_pos=None):
+                 cache=None, decode_pos=None, rolling: bool = False):
         """(B, T) int32 → (B, T, vocab) fp32 logits; with
         ``return_hidden=True``, the pre-head (B, T, d_model) hidden states
         instead (for :func:`lm_loss_chunked`, which streams the head).
@@ -324,7 +356,8 @@ class TransformerLM(nn.Module):
                 pos_enc=self.pos_enc, name=f"block_{i}",
             )
             if cache is not None:
-                h, c = blk(h, None, cache[i], decode_pos, rope=rope)
+                h, c = blk(h, None, cache[i], decode_pos, rope=rope,
+                           rolling=rolling)
                 new_cache.append(c)
             else:
                 h = blk(h, segment_ids, rope=rope)
@@ -360,6 +393,7 @@ def lm_generate(
     top_k: int = 0,
     top_p: float = 1.0,
     prompt_lengths=None,
+    rolling: bool = False,
 ):
     """Autoregressive generation with the KV cache, one ``lax.scan`` over
     positions (prefill + generation in a single compiled program — the
@@ -382,6 +416,15 @@ def lm_generate(
         conditions on its own last real token and generates at positions
         ``length, length+1, …``; the generated KVs overwrite the pad slots
         progressively, so per-row causal masking keeps pads unattended.
+      rolling: sliding-window models only (``model.window > 0``) — use a
+        RING-BUFFER cache of ``window`` slots instead of ``P + n_new``:
+        O(window) memory however long the generation runs (streaming
+        decode).  Prefill still runs batched through a prompt-sized cache,
+        then collapses to the ring.  Token-identical to the full cache up
+        to fp32 summation order (the ring permutes slot order, so a
+        near-tie in greedy argmax could in principle flip); the window
+        mask hides everything a ring evicts.  Not compatible with
+        ``prompt_lengths``.
 
     Returns ``(B, n_new)`` int32 generated tokens (row ``i``'s tokens at
     positions ``length_i … length_i + n_new - 1`` when ragged).
@@ -400,12 +443,24 @@ def lm_generate(
         )
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
+    if rolling:
+        if not model.window:
+            raise ValueError(
+                "rolling=True needs a sliding-window model (window > 0)"
+            )
+        if prompt_lengths is not None:
+            raise ValueError(
+                "rolling=True does not support ragged prompts: pad slots "
+                "written during prefill would alias real ring positions"
+            )
     # Host (numpy) params are fine to pass in — the scan indexes the
     # positional table with a traced position, which needs device arrays.
     params = jax.tree_util.tree_map(jnp.asarray, params)
     # Cache sized to the live positions, not max_len: attention cost and
     # cache memory are O(P + n_new) per step (masking is shape-agnostic).
-    cache = model.init_cache(B, total)
+    # Under `rolling` the steady-state cache is the W-slot ring; prefill
+    # uses a prompt-sized cache and collapses below.
+    cache = model.init_cache(B, P if rolling else total)
 
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
@@ -478,18 +533,33 @@ def lm_generate(
         # prefill logits are simply never read.
         tok0, key = pick(logits[jnp.arange(B), lengths - 1], key)
 
+    if n_new == 1:
+        return tok0[:, None]
+
+    if rolling:
+        # Collapse the prompt-sized cache into the W-slot ring: slot s
+        # takes the LAST prompt position ≡ s (mod W) — a deterministic
+        # gather (never a duplicate-index scatter).  Slots no prompt
+        # position reached (P < W) hold clamped junk that the decode-time
+        # ``p_s >= 0`` mask hides until a real write lands there.
+        W = model.window
+        sl = jnp.arange(W)
+        pos_s = (P - 1) - ((P - 1 - sl) % W)
+        safe = jnp.clip(pos_s, 0, P - 1)
+        cache = [
+            {"k": c["k"][:, safe], "v": c["v"][:, safe]} for c in cache
+        ]
+
     def body(carry, i):
         tok, cache, key = carry
         step_pos = (P + i) if prompt_lengths is None else (lengths + i)
         logits, cache = model.apply(
             {"params": params}, tok[:, None], cache=cache,
-            decode_pos=step_pos,
+            decode_pos=step_pos, rolling=rolling,
         )
         nxt, key = pick(logits[:, 0], key)
         return (nxt, cache, key), tok
 
-    if n_new == 1:
-        return tok0[:, None]
     (last, _, _), fed = lax.scan(
         body, (tok0, cache, key), jnp.arange(n_new - 1)
     )
