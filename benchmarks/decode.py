@@ -28,9 +28,22 @@ def main():
     ap.add_argument("--d-ff", type=int, default=3072)
     ap.add_argument("--vocab", type=int, default=32768)
     ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--window", type=int, default=0,
+                    help="sliding-window model; with --rolling, decode "
+                         "through the O(window) ring cache")
+    ap.add_argument("--rolling", action="store_true",
+                    help="ring-buffer KV cache (needs --window); also "
+                         "times the full-cache baseline for comparison")
+    ap.add_argument("--rope", action="store_true",
+                    help="rotary positions (required to stream past "
+                         "max_len; pairs naturally with --rolling)")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    if args.rolling and not args.window:
+        # Fail at argparse time, not after the full-cache baseline has
+        # burned minutes of chip time.
+        ap.error("--rolling needs --window (sliding-window model)")
 
     from chainermn_tpu.utils import respect_jax_platforms_env
 
@@ -53,6 +66,11 @@ def main():
         args.batch, args.prompt, args.new = 2, 16, 32
         args.layers, args.d_model, args.heads = 2, 128, 4
         args.d_ff, args.vocab, args.iters = 256, 1024, 2
+        if args.window:
+            # Shrink the ring below prompt+new so the smoke run actually
+            # exercises wraparound/eviction (a 1024-slot ring over 48
+            # positions would never wrap).
+            args.window = min(args.window, 16)
     if platform == "cpu":
         jax.config.update("jax_cpu_enable_async_dispatch", False)
 
@@ -60,6 +78,8 @@ def main():
         vocab=args.vocab, n_layers=args.layers, d_model=args.d_model,
         n_heads=args.heads, d_ff=args.d_ff,
         max_len=args.prompt + args.new,
+        window=args.window,
+        pos_enc="rope" if args.rope else "learned",
     )
     params = jax.jit(
         lambda r: model.init(
@@ -73,18 +93,24 @@ def main():
         )
     )
 
-    gen = jax.jit(lambda p, pr: lm_generate(model, p, pr, args.new))
-    out_tokens = gen(params, prompt)
-    np.asarray(out_tokens)  # compile + warm, synced by materialization
-    # Sync each iteration with a real device->host readback: over the axon
-    # tunnel `block_until_ready` can return EARLY on queued steps (observed
-    # here as ms_per_gen_step 0.0 => a 22M tok/s fantasy); a value transfer
-    # cannot lie.  Same policy as bench.py.
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        out_tokens = gen(params, prompt)
-        _ = np.asarray(out_tokens[:1, -1:])
-    dt = time.perf_counter() - t0
+    def timed(rolling):
+        gen = jax.jit(
+            lambda p, pr: lm_generate(model, p, pr, args.new,
+                                      rolling=rolling)
+        )
+        np.asarray(gen(params, prompt))  # compile + warm (value-synced)
+        # Sync each iteration with a real device->host readback: over the
+        # axon tunnel `block_until_ready` can return EARLY on queued steps
+        # (observed here as ms_per_gen_step 0.0 => a 22M tok/s fantasy); a
+        # value transfer cannot lie.  Same policy as bench.py.
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out_tokens = gen(params, prompt)
+            _ = np.asarray(out_tokens[:1, -1:])
+        return time.perf_counter() - t0
+
+    dt = timed(False)
+    rolling_dt = timed(True) if args.rolling else None
 
     # Batched prefill = ONE forward; the sequential part is the n_new-1
     # generation steps (plus that prefill program).
@@ -104,6 +130,22 @@ def main():
                    "vocab": args.vocab},
         "ms_per_gen_step": round(dt / args.iters / steps * 1000.0, 3),
     }
+    if args.window:
+        payload["window"] = args.window
+    if args.rope:
+        payload["pos_enc"] = "rope"
+    if rolling_dt is not None:
+        payload["rolling"] = {
+            "tokens_per_sec": round(
+                args.batch * args.new * args.iters / rolling_dt, 1
+            ),
+            "ms_per_gen_step": round(
+                rolling_dt / args.iters / steps * 1000.0, 3
+            ),
+            "speedup_vs_full_cache": round(dt / rolling_dt, 3),
+            "cache_slots": args.window,
+            "full_cache_slots": args.prompt + args.new,
+        }
     print(json.dumps(payload))
     if args.out:
         with open(args.out, "w") as f:

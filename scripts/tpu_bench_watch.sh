@@ -125,6 +125,17 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# decode B=64 rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/decode_streaming_tpu.json ]; then
+      # Streaming decode: rope + window-1024 ring cache generating 4096
+      # tokens — the ring holds 1024 slots vs the full cache's 4224, so
+      # each step attends 4x less KV (O(window) memory AND bandwidth).
+      echo "# running streaming decode bench at $(date +%H:%M:%S)" >&2
+      timeout 2400 python benchmarks/decode.py --batch 8 --prompt 128 \
+        --new 4096 --window 1024 --rolling --rope \
+        --out result/decode_streaming_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# streaming decode rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/bench_tpu_vit_auto.json ]; then
       # ViT re-capture under attention="auto": T=196 sits below the
       # measured flash crossover, so auto runs XLA attention — testing the
@@ -173,7 +184,8 @@ print(float((x@x).sum()))
        && [ -s result/longcontext_tpu.json ] \
        && [ -s result/bench_tpu_vit_auto.json ] \
        && [ -s result/lm_tpu_774m.json ] \
-       && [ -s result/decode_tpu_b64.json ]; then
+       && [ -s result/decode_tpu_b64.json ] \
+       && [ -s result/decode_streaming_tpu.json ]; then
       exit 0
     fi
   else
