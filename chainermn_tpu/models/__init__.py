@@ -51,6 +51,7 @@ from chainermn_tpu.models.transformer import (
     lm_loss_chunked,
     parallel_lm_specs,
 )
+from chainermn_tpu.models.decoding import lm_beam_search
 
 __all__ = [
     "MLP",
@@ -75,6 +76,7 @@ __all__ = [
     "greedy_decode",
     "TransformerLM",
     "lm_generate",
+    "lm_beam_search",
     "lm_loss",
     "lm_loss_chunked",
     "ParallelLM",

@@ -1,0 +1,176 @@
+"""Beam-search decoding for :class:`~chainermn_tpu.models.TransformerLM`.
+
+Reference anchor: the seq2seq NMT example era (``examples/seq2seq``) decoded
+with beam search for BLEU; here it is rebuilt TPU-first — STATIC beam width,
+the whole search one ``lax.scan`` over positions (no Python per-token
+dispatch, no dynamic shapes):
+
+* the prompt prefills ONCE at batch ``B``, then the per-layer KV caches are
+  replicated to ``B·beam`` rows,
+* each step scores ``(B, beam·V)`` continuations, keeps the global top
+  ``beam``, and gathers the caches by parent-beam index (one ``take`` per
+  layer — the standard beam-reorder traffic),
+* finished beams (``eos_id``) freeze: they emit ``pad_id`` at logprob 0 so
+  their score stops changing and length-normalized comparison stays exact.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG = -1e30
+
+
+def lm_beam_search(
+    model,
+    params,
+    prompt: jax.Array,
+    n_new: int,
+    beam: int = 4,
+    eos_id: Optional[int] = None,
+    length_penalty: float = 0.0,
+    pad_id: int = 0,
+):
+    """Beam-search ``n_new`` tokens after ``prompt`` (``(B, P)`` int32,
+    full-length rows).
+
+    Scoring: sum of token logprobs, divided by ``length**length_penalty``
+    (0 = pure sum; 0.6–1.0 favors longer hypotheses, the NMT convention).
+    Without ``eos_id`` every hypothesis has length ``n_new`` and the
+    penalty cancels.  With ``eos_id``, a beam that emits it freezes —
+    subsequent slots hold ``pad_id`` and contribute zero logprob; its
+    length is the token count through (and including) the EOS.
+
+    Returns ``(tokens, scores)``: ``(B, n_new)`` int32 best-beam tokens and
+    ``(B,)`` fp32 penalized scores.  ``beam=1`` reduces exactly to greedy
+    :func:`~chainermn_tpu.models.lm_generate`.
+    """
+    from chainermn_tpu.models.transformer import _check_generation_length
+
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, P = prompt.shape
+    if beam < 1:
+        raise ValueError(f"beam must be >= 1, got {beam}")
+    if n_new < 1:
+        return jnp.zeros((B, 0), jnp.int32), jnp.zeros((B,), jnp.float32)
+    total = _check_generation_length(model, P, n_new)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    K = beam
+
+    # One batched prefill at B rows, then replicate cache rows K× so beam
+    # b·K+k continues row b.  (B, L, KH, Dh) -> (B·K, L, KH, Dh).
+    cache = model.init_cache(B, total)
+    logits, cache = model.apply(
+        {"params": params}, prompt, cache=cache, decode_pos=0
+    )
+    cache = [
+        {n: jnp.repeat(c[n], K, axis=0) for n in ("k", "v")} for c in cache
+    ]
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # (B, V)
+    V = logp0.shape[-1]
+
+    # Step 0: top-K distinct first tokens per row seed the beams (starting
+    # all beams from the SAME argmax would waste K-1 of them).  A beam
+    # wider than the vocab seeds the surplus at NEG — their candidates
+    # always lose the next top-k, so no path is double-counted.
+    k_seed = min(K, V)
+    s0, tok0 = lax.top_k(logp0, k_seed)  # (B, k_seed)
+    if k_seed < K:
+        s0 = jnp.concatenate(
+            [s0, jnp.full((B, K - k_seed), NEG, s0.dtype)], axis=1
+        )
+        tok0 = jnp.concatenate(
+            [tok0, jnp.zeros((B, K - k_seed), tok0.dtype)], axis=1
+        )
+    scores = s0
+    alive = jnp.ones((B, K), bool)
+    if eos_id is not None:
+        alive = tok0 != eos_id
+    # Length of each hypothesis so far (counts the EOS token itself).
+    lengths = jnp.ones((B, K), jnp.int32)
+
+    def penalized(scores, lengths):
+        if length_penalty == 0.0:
+            return scores
+        return scores / (lengths.astype(jnp.float32) ** length_penalty)
+
+    def body(carry, i):
+        tok, scores, alive, lengths, cache = carry
+        step_pos = P + i
+        logits, cache = model.apply(
+            {"params": params}, tok.reshape(B * K, 1), cache=cache,
+            decode_pos=step_pos,
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32)
+        ).reshape(B, K, V)
+        if eos_id is not None:
+            # Frozen beams emit pad at logprob 0 and nothing else.
+            frozen = jnp.full((V,), NEG).at[pad_id].set(0.0)
+            logp = jnp.where(alive[..., None], logp, frozen[None, None])
+        cand = scores[..., None] + logp  # (B, K, V)
+        # Rank candidates by the PENALIZED score they would have.
+        cand_len = lengths[..., None] + (
+            alive[..., None].astype(jnp.int32)
+        )  # frozen beams stop growing
+        flat_rank = penalized(cand, cand_len).reshape(B, K * V)
+        _, idx = lax.top_k(flat_rank, K)  # (B, K) indices into K·V
+        parent = idx // V
+        nxt = (idx % V).astype(jnp.int32)
+        batch_idx = jnp.arange(B)[:, None]
+        scores = cand[batch_idx, parent, nxt]
+        lengths = cand_len[batch_idx, parent, nxt]
+        was_alive = alive[batch_idx, parent]
+        if eos_id is not None:
+            alive = was_alive & (nxt != eos_id)
+        else:
+            alive = was_alive
+        # Reorder caches to follow the surviving parents.
+        flat_parent = (batch_idx * K + parent).reshape(B * K)
+        cache = [
+            {n: c[n][flat_parent] for n in ("k", "v")} for c in cache
+        ]
+        return (nxt, scores, alive, lengths, cache), (nxt, parent)
+
+    if n_new == 1:
+        final = penalized(scores, lengths)
+        best = jnp.argmax(final, axis=-1)
+        out = tok0[jnp.arange(B), best][:, None]
+        return out, final[jnp.arange(B), best]
+
+    (_, scores, alive, lengths, _), (steps_toks, steps_parents) = lax.scan(
+        body, (tok0, scores, alive, lengths, cache), jnp.arange(n_new - 1)
+    )
+    toks_hist = jnp.concatenate([tok0[None], steps_toks], axis=0)
+    parents_hist = steps_parents  # (n_new-1, B, K)
+
+    # Backtrack the best beam per row through the parent pointers.
+    final = penalized(scores, lengths)
+    best = jnp.argmax(final, axis=-1)  # (B,)
+
+    def backtrack(beam_idx, t):
+        # beam_idx indexes step-(t+1) beams; emit that step's token and
+        # move to its step-t parent (parents_hist[t] maps t+1 -> t).
+        tok_t = toks_hist[t + 1, jnp.arange(B), beam_idx]
+        parent = parents_hist[t, jnp.arange(B), beam_idx]
+        return parent, tok_t
+
+    # Walk t = n_new-2 .. 0 emitting the token CHOSEN AT step t+1, then
+    # prepend step 0's token for the root beam we land on.
+    beam_idx, rev = lax.scan(
+        backtrack, best, jnp.arange(n_new - 2, -1, -1)
+    )
+    tail = rev[::-1].T  # (B, n_new-1) tokens at steps 1..n_new-1
+    head = toks_hist[0, jnp.arange(B), beam_idx][:, None]
+    out = jnp.concatenate([head, tail], axis=1)
+    if eos_id is not None:
+        # Pad everything after the first EOS (frozen steps already emit
+        # pad, but the backtracked path includes the EOS itself).
+        hit = jnp.cumsum((out == eos_id).astype(jnp.int32), axis=1)
+        after = (hit - (out == eos_id).astype(jnp.int32)) > 0
+        out = jnp.where(after, pad_id, out)
+    return out, final[jnp.arange(B), best]

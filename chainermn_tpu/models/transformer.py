@@ -383,6 +383,21 @@ class TransformerLM(nn.Module):
         ]
 
 
+def _check_generation_length(model: "TransformerLM", P: int,
+                             n_new: int) -> int:
+    """Shared decode-entry contract (``lm_generate`` and
+    ``decoding.lm_beam_search``): only the learned position table caps
+    generation length — RoPE has no table, so the cache (sized to the
+    request) is the only limit.  Returns ``P + n_new``."""
+    total = P + n_new
+    if total > model.max_len and model.pos_enc == "learned":
+        raise ValueError(
+            f"prompt ({P}) + n_new ({n_new}) exceeds max_len "
+            f"{model.max_len}"
+        )
+    return total
+
+
 def lm_generate(
     model: "TransformerLM",
     params,
@@ -433,14 +448,7 @@ def lm_generate(
     B, P = prompt.shape
     if n_new < 1:
         return jnp.zeros((B, 0), jnp.int32)
-    total = P + n_new
-    if total > model.max_len and model.pos_enc == "learned":
-        # Only the learned position table caps generation length; RoPE has
-        # no table — the cache (sized to `total` below) is the only limit.
-        raise ValueError(
-            f"prompt ({P}) + n_new ({n_new}) exceeds max_len "
-            f"{model.max_len}"
-        )
+    total = _check_generation_length(model, P, n_new)
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
     if rolling:

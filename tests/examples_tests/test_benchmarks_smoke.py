@@ -19,6 +19,8 @@ REPO = os.path.dirname(
 BENCHES = {
     "lm": ["benchmarks/lm.py", "--smoke"],
     "decode": ["benchmarks/decode.py", "--smoke"],
+    "decode_streaming": ["benchmarks/decode.py", "--smoke", "--window",
+                         "16", "--rolling", "--rope"],
     "flash_interpret": ["benchmarks/flash_tpu.py", "--interpret-smoke"],
     "seq2seq": ["benchmarks/seq2seq.py", "--smoke"],
     "longcontext": ["benchmarks/longcontext.py", "--smoke"],
