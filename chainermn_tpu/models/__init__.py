@@ -13,6 +13,7 @@ from chainermn_tpu.models.resnet import (
 from chainermn_tpu.models.seq2seq import (
     Seq2Seq,
     TransformerSeq2Seq,
+    beam_decode,
     greedy_decode,
     seq2seq_loss,
 )
@@ -73,6 +74,7 @@ __all__ = [
     "Seq2Seq",
     "TransformerSeq2Seq",
     "seq2seq_loss",
+    "beam_decode",
     "greedy_decode",
     "TransformerLM",
     "lm_generate",

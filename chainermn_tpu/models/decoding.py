@@ -25,6 +25,41 @@ from jax import lax
 NEG = -1e30
 
 
+def beam_step(scores, alive, lengths, logp, length_penalty, eos_id, pad_id):
+    """One beam-search ranking step, shared by :func:`lm_beam_search` and
+    the seq2seq :func:`~chainermn_tpu.models.seq2seq.beam_decode`.
+
+    ``scores``/``alive``/``lengths``: ``(B, K)`` running state.  ``logp``:
+    ``(B, K, V)`` next-token logprobs.  With ``eos_id`` set, frozen beams
+    are forced to ``pad_id`` at logprob 0 (score and length stop growing);
+    ranking uses the length-penalized candidate score.  Returns
+    ``(parent, nxt, scores, alive, lengths)`` with ``parent``/``nxt``
+    ``(B, K)`` — the caller reorders its hypothesis state by ``parent``.
+    """
+    B, K, V = logp.shape
+    if eos_id is not None:
+        frozen = jnp.full((V,), NEG).at[pad_id].set(0.0)
+        logp = jnp.where(alive[..., None], logp, frozen[None, None])
+    cand = scores[..., None] + logp  # (B, K, V)
+    cand_len = lengths[..., None] + alive[..., None].astype(jnp.int32)
+    if length_penalty == 0.0:
+        rank = cand
+    else:
+        rank = cand / jnp.maximum(cand_len, 1).astype(
+            jnp.float32
+        ) ** length_penalty
+    _, idx = lax.top_k(rank.reshape(B, K * V), K)
+    parent = idx // V
+    nxt = (idx % V).astype(jnp.int32)
+    batch_idx = jnp.arange(B)[:, None]
+    scores = cand[batch_idx, parent, nxt]
+    lengths = cand_len[batch_idx, parent, nxt]
+    alive = alive[batch_idx, parent]
+    if eos_id is not None:
+        alive = alive & (nxt != eos_id)
+    return parent, nxt, scores, alive, lengths
+
+
 def lm_beam_search(
     model,
     params,
@@ -108,29 +143,13 @@ def lm_beam_search(
         logp = jax.nn.log_softmax(
             logits[:, 0].astype(jnp.float32)
         ).reshape(B, K, V)
-        if eos_id is not None:
-            # Frozen beams emit pad at logprob 0 and nothing else.
-            frozen = jnp.full((V,), NEG).at[pad_id].set(0.0)
-            logp = jnp.where(alive[..., None], logp, frozen[None, None])
-        cand = scores[..., None] + logp  # (B, K, V)
-        # Rank candidates by the PENALIZED score they would have.
-        cand_len = lengths[..., None] + (
-            alive[..., None].astype(jnp.int32)
-        )  # frozen beams stop growing
-        flat_rank = penalized(cand, cand_len).reshape(B, K * V)
-        _, idx = lax.top_k(flat_rank, K)  # (B, K) indices into K·V
-        parent = idx // V
-        nxt = (idx % V).astype(jnp.int32)
-        batch_idx = jnp.arange(B)[:, None]
-        scores = cand[batch_idx, parent, nxt]
-        lengths = cand_len[batch_idx, parent, nxt]
-        was_alive = alive[batch_idx, parent]
-        if eos_id is not None:
-            alive = was_alive & (nxt != eos_id)
-        else:
-            alive = was_alive
+        parent, nxt, scores, alive, lengths = beam_step(
+            scores, alive, lengths, logp, length_penalty, eos_id, pad_id
+        )
         # Reorder caches to follow the surviving parents.
-        flat_parent = (batch_idx * K + parent).reshape(B * K)
+        flat_parent = (
+            jnp.arange(B)[:, None] * K + parent
+        ).reshape(B * K)
         cache = [
             {n: c[n][flat_parent] for n in ("k", "v")} for c in cache
         ]

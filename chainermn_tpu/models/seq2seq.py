@@ -272,3 +272,68 @@ def greedy_decode(model: nn.Module, params, src, max_len: int = 32):
     tgt_in = jax.lax.fori_loop(0, max_len - 1, body, tgt_in)
     logits = model.apply({"params": params}, src, tgt_in)
     return jnp.argmax(logits, -1)
+
+
+def beam_decode(model: nn.Module, params, src, max_len: int = 32,
+                beam: int = 4, length_penalty: float = 0.0,
+                eos_id=None):
+    """Beam-search decoding with static shapes — the NMT eval decoder the
+    reference era used for BLEU (same full-re-apply-per-step fidelity tier
+    as :func:`greedy_decode`; an eval utility, not a serving path).
+
+    Beams fold into the batch (``B·beam`` rows).  Scores start at
+    ``[0, -inf, …]`` per row so step 0's top-k over ``beam·vocab``
+    candidates seeds ``beam`` distinct first tokens with no special case.
+    With ``eos_id`` (pass the corpus ``EOS``) a beam that emits it
+    freezes: PAD at logprob 0, length stops growing, and ranking uses the
+    length-penalized score (``sum_logprob / length**length_penalty``) —
+    left ``None`` (the default, matching :func:`greedy_decode`'s no-EOS
+    semantics) every hypothesis runs the full ``max_len``.
+
+    Returns ``(B, max_len)`` predicted tokens (same contract as
+    :func:`greedy_decode`: position ``i`` holds the prediction after
+    consuming ``i`` decoded tokens); ``beam=1`` with ``eos_id=None``
+    reduces exactly to greedy."""
+    from chainermn_tpu.models.decoding import NEG, beam_step
+
+    if beam < 1:
+        raise ValueError(f"beam must be >= 1, got {beam}")
+    B = src.shape[0]
+    K = beam
+    srcK = jnp.repeat(src, K, axis=0)  # row order b*K + k
+    tgt = jnp.full((B * K, max_len), PAD, jnp.int32).at[:, 0].set(BOS)
+    tgt = tgt + srcK[:, :1].astype(jnp.int32) * 0  # vma inheritance
+    scores = jnp.full((B, K), NEG).at[:, 0].set(0.0)
+    alive = jnp.ones((B, K), bool)
+    lengths = jnp.zeros((B, K), jnp.int32)
+    batch_idx = jnp.arange(B)[:, None]
+
+    def penalized(s, ln):
+        if length_penalty == 0.0:
+            return s
+        return s / jnp.maximum(ln, 1).astype(jnp.float32) ** length_penalty
+
+    def body(i, carry):
+        tgt, scores, alive, lengths = carry
+        logits = model.apply({"params": params}, srcK, tgt)
+        logp = jax.nn.log_softmax(
+            logits[:, i].astype(jnp.float32)
+        ).reshape(B, K, -1)
+        parent, nxt, scores, alive, lengths = beam_step(
+            scores, alive, lengths, logp, length_penalty, eos_id, PAD
+        )
+        flat_parent = (batch_idx * K + parent).reshape(B * K)
+        tgt = tgt[flat_parent].at[:, i + 1].set(nxt.reshape(B * K))
+        return tgt, scores, alive, lengths
+
+    tgt, scores, alive, lengths = jax.lax.fori_loop(
+        0, max_len - 1, body, (tgt, scores, alive, lengths)
+    )
+    best = jnp.argmax(penalized(scores, lengths), axis=-1)  # (B,)
+    rows = (jnp.arange(B) * K + best)
+    best_tgt = tgt[rows]  # (B, max_len): BOS + decoded tokens
+    # Same contract as greedy_decode: predictions per position — decoded
+    # tokens shifted left, plus one final prediction from the last logits.
+    logits = model.apply({"params": params}, src, best_tgt)
+    final = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+    return jnp.concatenate([best_tgt[:, 1:], final[:, None]], axis=1)
