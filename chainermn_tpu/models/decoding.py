@@ -25,6 +25,18 @@ from jax import lax
 NEG = -1e30
 
 
+def penalized_scores(scores, lengths, length_penalty):
+    """Length-penalized hypothesis score (the GNMT convention):
+    ``sum_logprob / length**length_penalty``; ``0`` = pure sum.  The one
+    definition ranking uses everywhere — candidate selection
+    (:func:`beam_step`) and final best-beam picks in both decoders."""
+    if length_penalty == 0.0:
+        return scores
+    return scores / jnp.maximum(lengths, 1).astype(
+        jnp.float32
+    ) ** length_penalty
+
+
 def beam_step(scores, alive, lengths, logp, length_penalty, eos_id, pad_id):
     """One beam-search ranking step, shared by :func:`lm_beam_search` and
     the seq2seq :func:`~chainermn_tpu.models.seq2seq.beam_decode`.
@@ -42,12 +54,7 @@ def beam_step(scores, alive, lengths, logp, length_penalty, eos_id, pad_id):
         logp = jnp.where(alive[..., None], logp, frozen[None, None])
     cand = scores[..., None] + logp  # (B, K, V)
     cand_len = lengths[..., None] + alive[..., None].astype(jnp.int32)
-    if length_penalty == 0.0:
-        rank = cand
-    else:
-        rank = cand / jnp.maximum(cand_len, 1).astype(
-            jnp.float32
-        ) ** length_penalty
+    rank = penalized_scores(cand, cand_len, length_penalty)
     _, idx = lax.top_k(rank.reshape(B, K * V), K)
     parent = idx // V
     nxt = (idx % V).astype(jnp.int32)
@@ -128,11 +135,6 @@ def lm_beam_search(
     # Length of each hypothesis so far (counts the EOS token itself).
     lengths = jnp.ones((B, K), jnp.int32)
 
-    def penalized(scores, lengths):
-        if length_penalty == 0.0:
-            return scores
-        return scores / (lengths.astype(jnp.float32) ** length_penalty)
-
     def body(carry, i):
         tok, scores, alive, lengths, cache = carry
         step_pos = P + i
@@ -156,7 +158,7 @@ def lm_beam_search(
         return (nxt, scores, alive, lengths, cache), (nxt, parent)
 
     if n_new == 1:
-        final = penalized(scores, lengths)
+        final = penalized_scores(scores, lengths, length_penalty)
         best = jnp.argmax(final, axis=-1)
         out = tok0[jnp.arange(B), best][:, None]
         return out, final[jnp.arange(B), best]
@@ -168,7 +170,7 @@ def lm_beam_search(
     parents_hist = steps_parents  # (n_new-1, B, K)
 
     # Backtrack the best beam per row through the parent pointers.
-    final = penalized(scores, lengths)
+    final = penalized_scores(scores, lengths, length_penalty)
     best = jnp.argmax(final, axis=-1)  # (B,)
 
     def backtrack(beam_idx, t):

@@ -294,7 +294,11 @@ def beam_decode(model: nn.Module, params, src, max_len: int = 32,
     :func:`greedy_decode`: position ``i`` holds the prediction after
     consuming ``i`` decoded tokens); ``beam=1`` with ``eos_id=None``
     reduces exactly to greedy."""
-    from chainermn_tpu.models.decoding import NEG, beam_step
+    from chainermn_tpu.models.decoding import (
+        NEG,
+        beam_step,
+        penalized_scores,
+    )
 
     if beam < 1:
         raise ValueError(f"beam must be >= 1, got {beam}")
@@ -307,11 +311,6 @@ def beam_decode(model: nn.Module, params, src, max_len: int = 32,
     alive = jnp.ones((B, K), bool)
     lengths = jnp.zeros((B, K), jnp.int32)
     batch_idx = jnp.arange(B)[:, None]
-
-    def penalized(s, ln):
-        if length_penalty == 0.0:
-            return s
-        return s / jnp.maximum(ln, 1).astype(jnp.float32) ** length_penalty
 
     def body(i, carry):
         tgt, scores, alive, lengths = carry
@@ -329,7 +328,7 @@ def beam_decode(model: nn.Module, params, src, max_len: int = 32,
     tgt, scores, alive, lengths = jax.lax.fori_loop(
         0, max_len - 1, body, (tgt, scores, alive, lengths)
     )
-    best = jnp.argmax(penalized(scores, lengths), axis=-1)  # (B,)
+    best = jnp.argmax(penalized_scores(scores, lengths, length_penalty), axis=-1)  # (B,)
     rows = (jnp.arange(B) * K + best)
     best_tgt = tgt[rows]  # (B, max_len): BOS + decoded tokens
     # Same contract as greedy_decode: predictions per position — decoded
