@@ -148,18 +148,57 @@ class MultiNodeCheckpointer(Extension):
                 step, args=ocp.args.StandardRestore(template)
             )
         except Exception:
-            if "it_inexact" not in template["loop"]:
+            # Backward-compatible retries: snapshots predating leaves the
+            # CURRENT template carries (it_inexact; ema_params when the
+            # user enables EMA on an existing run) restore against a
+            # template without those leaves, then the new leaves re-seed.
+            # The snapshot may be missing EITHER or BOTH, so each drop
+            # combination is tried independently (dropping a leaf the
+            # snapshot HAS would hit the opposite structure mismatch).
+            ts = template["train_state"]
+            has_ema = getattr(ts, "ema_params", None) is not None
+            has_it = "it_inexact" in template["loop"]
+            drop_sets = []
+            if has_ema:
+                drop_sets.append({"ema"})
+            if has_it:
+                drop_sets.append({"it"})
+            if has_ema and has_it:
+                drop_sets.append({"ema", "it"})
+            if not drop_sets:
                 raise
-            # Snapshot predates the always-present it_inexact leaf: retry
-            # with a matching (key-less) template so old runs stay
-            # resumable.
-            template["loop"] = {
-                k: v for k, v in template["loop"].items()
-                if k != "it_inexact"
-            }
-            restored = self._mngr.restore(
-                step, args=ocp.args.StandardRestore(template)
-            )
+            restored = dropped_ema = None
+            for drops in drop_sets:
+                t2 = {
+                    "train_state": (
+                        ts.replace(ema_params=None)
+                        if "ema" in drops else ts
+                    ),
+                    "loop": (
+                        {k: v for k, v in template["loop"].items()
+                         if k != "it_inexact"}
+                        if "it" in drops else template["loop"]
+                    ),
+                }
+                try:
+                    restored = self._mngr.restore(
+                        step, args=ocp.args.StandardRestore(t2)
+                    )
+                    dropped_ema = "ema" in drops
+                    break
+                except Exception:
+                    continue
+            if restored is None:
+                raise
+            if dropped_ema:
+                # Seed the average from the restored params (the same
+                # no-debias init a fresh EMA run uses), in fp32.
+                rs = restored["train_state"]
+                restored["train_state"] = rs.replace(
+                    ema_params=jax.tree_util.tree_map(
+                        lambda p: np.asarray(p, np.float32), rs.params
+                    )
+                )
         new_state = restored["train_state"]
         # Re-place on the communicator's mesh, honoring each INPUT leaf's
         # sharding (ZeRO states carry 1/N shards — blanket replication would

@@ -346,7 +346,14 @@ class TransformerLM(nn.Module):
             else:
                 pos_arr = decode_pos[:, None]  # (B, 1) per-row decode
             rope = rope_tables(pos_arr, D // self.n_heads)
-        block_cls = nn.remat(_DecoderBlock) if self.remat else _DecoderBlock
+        # Remat is a TRAINING memory lever; the decode path never needs it
+        # (no backward), and rematting it would also trace the static
+        # `rolling` flag into a TracerBool error.
+        block_cls = (
+            nn.remat(_DecoderBlock)
+            if self.remat and cache is None
+            else _DecoderBlock
+        )
         new_cache = []
         for i in range(self.n_layers):
             blk = block_cls(

@@ -132,6 +132,10 @@ class TrainState:
     # quantization error, rankwise ((size, *param.shape) sharded over the
     # mesh — the one device-varying piece of the train state).
     ef_residual: Any = None
+    # Exponential moving average of params (``ema_decay`` set): evaluate /
+    # export with these for the Polyak-averaged model.  Initialized to the
+    # params themselves, so no debias term is needed.
+    ema_params: Any = None
 
 
 class MultiNodeOptimizer:
@@ -149,10 +153,19 @@ class MultiNodeOptimizer:
         double_buffering: bool = False,
         grad_reduce: Optional[Callable] = None,
         grad_compression: Optional[str] = None,
+        ema_decay: Optional[float] = None,
     ):
         self.tx = tx
         self.comm = communicator
         self.double_buffering = double_buffering
+        if ema_decay is not None and not 0.0 < ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in (0, 1), got {ema_decay}"
+            )
+        # Polyak/EMA weight averaging: the eval-time smoothing standard for
+        # vision models (and common for LMs); the averaged copy rides the
+        # train state and updates in-graph after every optimizer step.
+        self.ema_decay = ema_decay
         if grad_compression not in (None, "int8_ef"):
             raise ValueError(
                 f"grad_compression={grad_compression!r}: expected None or "
@@ -216,6 +229,19 @@ class MultiNodeOptimizer:
             pending_grads=pending,
             model_state=model_state,
             ef_residual=resid,
+            ema_params=(
+                # fp32 regardless of the param dtype: with bf16 params a
+                # 0.999-decay increment is ~1000x below bf16's relative
+                # resolution — the average would freeze at init.  jnp.array
+                # (not asarray): same-dtype asarray ALIASES the param
+                # buffers and the donating train step would then see the
+                # same buffer twice.
+                jax.tree_util.tree_map(
+                    lambda p: jnp.array(p, jnp.float32), params
+                )
+                if self.ema_decay is not None
+                else None
+            ),
         )
 
     # ------------------------------------------------------------- allreduce
@@ -297,6 +323,7 @@ class MultiNodeOptimizer:
         axes = comm.axes
         dbuf = self.double_buffering
         compression = self.grad_compression
+        ema_decay = self.ema_decay
         tx = self.tx
 
         grad_one = _make_grad_one(loss_fn, has_aux, stateful)
@@ -347,6 +374,15 @@ class MultiNodeOptimizer:
                 pending = state.pending_grads
             updates, opt_state = tx.update(apply_grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+            if ema_decay is not None:
+                ema = jax.tree_util.tree_map(
+                    lambda e, p: e * ema_decay
+                    + p.astype(e.dtype) * (1.0 - ema_decay),
+                    state.ema_params,
+                    params,
+                )
+            else:
+                ema = state.ema_params
             metrics = {"loss": lax.pmean(loss, comm.axis_name)}
             for k, v in aux.items():
                 metrics[k] = lax.pmean(v, comm.axis_name)
@@ -358,6 +394,7 @@ class MultiNodeOptimizer:
                     pending_grads=pending,
                     model_state=new_model_state,
                     ef_residual=new_resid,
+                    ema_params=ema,
                 ),
                 metrics,
             )
@@ -374,6 +411,7 @@ class MultiNodeOptimizer:
             step=P(), params=P(), opt_state=P(), pending_grads=P(),
             model_state=P(),
             ef_residual=P(axes) if compression is not None else P(),
+            ema_params=P(),
         )
         mapped = jax.shard_map(
             body,
@@ -448,17 +486,21 @@ def create_multi_node_optimizer(
     double_buffering: bool = False,
     grad_reduce: Optional[Callable] = None,
     grad_compression: Optional[str] = None,
+    ema_decay: Optional[float] = None,
 ) -> MultiNodeOptimizer:
     """Reference anchor: ``chainermn/optimizers.py — create_multi_node_optimizer
     (opt, comm, double_buffering=False)``.  ``grad_compression='int8_ef'``
     extends the reference's fp16-wire idea (§2.3) to a 4x-compressed int8
-    wire with error feedback."""
+    wire with error feedback.  ``ema_decay`` maintains a Polyak-averaged
+    copy of the params on the train state (``state.ema_params``) for
+    eval/export."""
     return MultiNodeOptimizer(
         actual_optimizer,
         communicator,
         double_buffering=double_buffering,
         grad_reduce=grad_reduce,
         grad_compression=grad_compression,
+        ema_decay=ema_decay,
     )
 
 

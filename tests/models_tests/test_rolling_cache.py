@@ -63,6 +63,25 @@ def test_rolling_cache_is_window_sized():
             assert layer["k"].shape == (2, 8, 2, 16)
 
 
+def test_remat_model_generates():
+    # Generation on a remat=True model must not route decode through the
+    # remat wrapper (regression: the static `rolling` flag became a traced
+    # bool under nn.remat — TracerBoolConversionError in the lm example's
+    # --remat --generate recipe).
+    model = TransformerLM(vocab=40, n_layers=2, d_model=32, n_heads=2,
+                          d_ff=64, max_len=32, dtype=jnp.float32,
+                          attention="xla", remat=True, window=8)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 40, (2, 6)).astype(np.int32)
+    )
+    out = lm_generate(model, params, prompt, n_new=8)
+    ring = lm_generate(model, params, prompt, n_new=8, rolling=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ring))
+
+
 def test_rolling_validation():
     no_window = _model(window=0)
     p1 = _params(no_window)

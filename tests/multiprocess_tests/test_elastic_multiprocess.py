@@ -51,11 +51,22 @@ def _results(res):
         errors="replace"
     )
     assert res.returncode == 0, log[-3000:]
-    out = [
-        json.loads(line.split("WORKER_RESULT ", 1)[1])
-        for line in res.stdout.decode(errors="replace").splitlines()
-        if "WORKER_RESULT " in line
-    ]
+    # raw_decode each marker-delimited chunk instead of assuming one
+    # marker per LINE: when both workers finish simultaneously their
+    # writes can interleave on the shared pipe without a newline between
+    # them ("...}WORKER_RESULT {..." observed in CI).
+    dec = json.JSONDecoder()
+    out = []
+    for chunk in res.stdout.decode(errors="replace").split(
+        "WORKER_RESULT "
+    )[1:]:
+        try:
+            out.append(dec.raw_decode(chunk.lstrip())[0])
+        except json.JSONDecodeError:
+            # A worker killed mid-write can leave a truncated payload
+            # after the marker; skip it so the diagnostic asserts below
+            # see the log context instead of a parse error.
+            continue
     assert out, log[-3000:]
     return out, log
 
