@@ -52,7 +52,10 @@ from chainermn_tpu.models.transformer import (
     lm_loss_chunked,
     parallel_lm_specs,
 )
-from chainermn_tpu.models.decoding import lm_beam_search
+from chainermn_tpu.models.decoding import (
+    lm_beam_search,
+    lm_speculative_generate,
+)
 
 __all__ = [
     "MLP",
@@ -79,6 +82,7 @@ __all__ = [
     "TransformerLM",
     "lm_generate",
     "lm_beam_search",
+    "lm_speculative_generate",
     "lm_loss",
     "lm_loss_chunked",
     "ParallelLM",

@@ -195,3 +195,139 @@ def lm_beam_search(
         after = (hit - (out == eos_id).astype(jnp.int32)) > 0
         out = jnp.where(after, pad_id, out)
     return out, final[jnp.arange(B), best]
+
+
+def lm_speculative_generate(
+    model,
+    params,
+    draft_model,
+    draft_params,
+    prompt: jax.Array,
+    n_new: int,
+    k: int = 4,
+):
+    """Greedy speculative decoding: a cheap DRAFT model proposes ``k``
+    tokens autoregressively, the TARGET model scores all of them in ONE
+    ``k + 1``-position forward, and the longest agreeing prefix plus the
+    target's own token at the first disagreement (or the bonus token when
+    everything agrees) is accepted.
+
+    Output is EXACTLY the target model's greedy generation — speculation
+    changes the schedule, never the tokens.  Each round costs ``k``
+    sequential draft steps + ONE target forward and accepts 1..``k + 1``
+    tokens, so a well-matched draft cuts the target's sequential forwards
+    (the latency-bound part of decode) by up to ``k + 1``×.
+
+    Batched rows accept the MINIMUM agreeing prefix across the batch
+    (scalar cache positions keep the verify a single static-shape
+    forward); correctness is unaffected — agreeing-but-unaccepted tokens
+    are re-derived next round — but the speedup degrades with batch
+    diversity (the standard speculative tradeoff).
+
+    Both models must share the vocabulary and the ``TransformerLM`` cache
+    API.  Stale cache rows from rejected drafts are harmless by
+    construction: every position ≥ the next round's start is rewritten
+    before attention reads it, and causal masking hides the rest.
+
+    Returns ``(tokens, target_forwards)``: ``(B, n_new)`` int32 and the
+    number of sequential target executions used (prefill included;
+    non-speculative greedy costs ``n_new``).
+    """
+    from chainermn_tpu.models.transformer import _check_generation_length
+
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, P = prompt.shape
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if n_new < 1:
+        return jnp.zeros((B, 0), jnp.int32), 0
+    # The verify chunk can touch positions up to P + n_new - 2 + k, so a
+    # learned position table needs k - 1 slots of headroom past the plain
+    # generation bound — without this, the table's dynamic_slice CLAMPS
+    # near max_len and the verify forward silently diverges from greedy.
+    for m, label in ((model, "model"), (draft_model, "draft_model")):
+        if m.pos_enc == "learned" and P + n_new + k - 1 > m.max_len:
+            raise ValueError(
+                f"{label}: speculative verify needs P + n_new + k - 1 "
+                f"(= {P + n_new + k - 1}) <= max_len ({m.max_len}); "
+                "raise max_len, lower k, or use pos_enc='rope'"
+            )
+        _check_generation_length(m, P, n_new)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    draft_params = jax.tree_util.tree_map(jnp.asarray, draft_params)
+
+    # Cache headroom: the last round may write k + 1 positions starting
+    # at P + n_new - 2.
+    cap = P + n_new + k + 1
+    cache = model.init_cache(B, cap)
+    dcache = draft_model.init_cache(B, cap)
+
+    # Prefill BOTH models; the target's last-position logits give the
+    # first token (identical to greedy's first step).
+    logits, cache = model.apply(
+        {"params": params}, prompt, cache=cache, decode_pos=0
+    )
+    _, dcache = draft_model.apply(
+        {"params": draft_params}, prompt, cache=dcache, decode_pos=0
+    )
+    tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    # Padded by k + 1 so each round's window write is a static-size slice;
+    # trimmed on return.
+    out = jnp.zeros((B, n_new + k + 1), jnp.int32).at[:, 0].set(tok0)
+
+    def cond(carry):
+        filled, rounds, *_ = carry
+        return filled < n_new
+
+    def body(carry):
+        filled, rounds, out, cache, dcache, last = carry
+        pos = P + filled  # absolute position of the next token to fill
+
+        # k sequential draft proposals from `last` (position pos - 1).
+        def draft_step(c, i):
+            tok, dcache = c
+            dlogits, dcache = draft_model.apply(
+                {"params": draft_params}, tok[:, None], cache=dcache,
+                decode_pos=pos - 1 + i,
+            )
+            nxt = jnp.argmax(dlogits[:, 0], axis=-1).astype(jnp.int32)
+            return (nxt, dcache), nxt
+
+        (_, dcache), drafts = lax.scan(
+            draft_step, (last, dcache), jnp.arange(k)
+        )
+        drafts = drafts.T  # (B, k)
+
+        # ONE target forward over [last, drafts]: row i's logits give the
+        # target's choice after consuming element i, so t_next[:, :k]
+        # verifies every draft and t_next[:, k] is the bonus token when
+        # all k agree.
+        chunk = jnp.concatenate([last[:, None], drafts], axis=1)
+        tlogits, cache = model.apply(
+            {"params": params}, chunk, cache=cache, decode_pos=pos - 1
+        )
+        t_next = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, k+1)
+
+        agree = t_next[:, :k] == drafts
+        prefix = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+        n_agree = jnp.min(prefix.sum(axis=1))  # batch-uniform, 0..k
+        accepted = jnp.minimum(n_agree + 1, n_new - filled)
+
+        # One masked window write: slots [filled, filled + accepted) take
+        # t_next (`out` is padded by k + 1 so the static window never
+        # crosses the buffer end).
+        window = lax.dynamic_slice_in_dim(out, filled, k + 1, axis=1)
+        keep = jnp.arange(k + 1) < accepted
+        out = lax.dynamic_update_slice_in_dim(
+            out, jnp.where(keep[None, :], t_next, window), filled, axis=1
+        )
+        last = jnp.take(t_next, accepted - 1, axis=1)
+        return (filled + accepted, rounds + 1, out, cache, dcache, last)
+
+    filled, rounds, out, _, _, _ = lax.while_loop(
+        cond, body,
+        (jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32), out, cache,
+         dcache, tok0),
+    )
+    # Target forwards: the prefill + one verify per round.
+    return out[:, :n_new], rounds + 1

@@ -1,0 +1,102 @@
+"""Speculative decoding: output must EXACTLY equal the target model's
+greedy generation (speculation changes the schedule, never the tokens),
+and a perfect draft must cut the sequential target forwards."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models import (
+    TransformerLM,
+    lm_generate,
+    lm_speculative_generate,
+)
+
+
+def _model(seed=0, layers=2):
+    return TransformerLM(vocab=40, n_layers=layers, d_model=32, n_heads=2,
+                         d_ff=64, max_len=128, dtype=jnp.float32,
+                         attention="xla")
+
+
+def _params(model, seed=0, T=64):
+    return model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_speculative_equals_target_greedy(k):
+    target = _model(layers=2)
+    draft = _model(layers=1)
+    tp = _params(target, seed=0)
+    dp = _params(draft, seed=1)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 40, (2, 8)).astype(np.int32)
+    )
+    want = lm_generate(target, tp, prompt, n_new=17)
+    got, forwards = lm_speculative_generate(
+        target, tp, draft, dp, prompt, n_new=17, k=k
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(forwards) >= 1
+
+
+def test_perfect_draft_max_acceptance():
+    # Draft == target: rounds should accept ~k+1 tokens each.  Not exactly
+    # every round: the draft's sequential T=1 steps and the target's
+    # batched (k+1)-token verify reduce in different float orders, so a
+    # near-tie argmax can flip and cost an extra round — tokens stay
+    # exact (acceptance always emits the TARGET's choices), only the
+    # schedule wobbles.  Assert a real forwards cut with slack.
+    target = _model()
+    tp = _params(target)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, 40, (1, 6)).astype(np.int32)
+    )
+    n_new, k = 25, 4
+    got, forwards = lm_speculative_generate(
+        target, tp, target, tp, prompt, n_new=n_new, k=k
+    )
+    want = lm_generate(target, tp, prompt, n_new=n_new)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    ideal = 1 + -(-(n_new - 1) // (k + 1))  # 6
+    assert ideal <= int(forwards) <= ideal + 2
+    assert int(forwards) < n_new // 2  # >2x fewer sequential target runs
+
+
+def test_speculative_validation():
+    target = _model()
+    tp = _params(target)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="k must"):
+        lm_speculative_generate(target, tp, target, tp, prompt, n_new=4,
+                                k=0)
+
+
+def test_learned_pos_needs_verify_headroom():
+    # The verify chunk touches up to P + n_new - 2 + k; a learned table
+    # with only generation-length headroom would CLAMP its dynamic_slice
+    # near max_len and silently diverge from greedy — rejected up front.
+    tight = TransformerLM(vocab=40, n_layers=1, d_model=32, n_heads=2,
+                          d_ff=64, max_len=25, dtype=jnp.float32,
+                          attention="xla")
+    tp = tight.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 25), jnp.int32)
+    )["params"]
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="verify needs"):
+        lm_speculative_generate(tight, tp, tight, tp, prompt, n_new=17,
+                                k=5)
+    # rope has no table — the same geometry is fine.
+    rope = TransformerLM(vocab=40, n_layers=1, d_model=32, n_heads=2,
+                         d_ff=64, max_len=25, dtype=jnp.float32,
+                         attention="xla", pos_enc="rope")
+    rp = rope.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 25), jnp.int32)
+    )["params"]
+    out, _ = lm_speculative_generate(rope, rp, rope, rp, prompt, n_new=17,
+                                     k=5)
+    assert out.shape == (1, 17)
