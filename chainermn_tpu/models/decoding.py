@@ -205,6 +205,8 @@ def lm_speculative_generate(
     prompt: jax.Array,
     n_new: int,
     k: int = 4,
+    temperature: float = 0.0,
+    rng=None,
 ):
     """Greedy speculative decoding: a cheap DRAFT model proposes ``k``
     tokens autoregressively, the TARGET model scores all of them in ONE
@@ -217,6 +219,13 @@ def lm_speculative_generate(
     sequential draft steps + ONE target forward and accepts 1..``k + 1``
     tokens, so a well-matched draft cuts the target's sequential forwards
     (the latency-bound part of decode) by up to ``k + 1``×.
+
+    ``temperature > 0`` (requires ``rng``) switches to speculative
+    SAMPLING: drafts are sampled from the draft model and kept with
+    probability ``min(1, p/q)``, with the residual-distribution resample
+    at the first rejection (:func:`speculative_accept`) — the emitted
+    tokens are then exactly ``target``-sampling distributed, per the
+    Leviathan et al. correctness argument.
 
     Batched rows accept the MINIMUM agreeing prefix across the batch
     (scalar cache positions keep the verify a single static-shape
@@ -239,6 +248,8 @@ def lm_speculative_generate(
     B, P = prompt.shape
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
     if n_new < 1:
         return jnp.zeros((B, 0), jnp.int32), 0
     # The verify chunk can touch positions up to P + n_new - 2 + k, so a
@@ -270,7 +281,15 @@ def lm_speculative_generate(
     _, dcache = draft_model.apply(
         {"params": draft_params}, prompt, cache=dcache, decode_pos=0
     )
-    tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    sampling = temperature > 0
+    key = rng if rng is not None else jax.random.PRNGKey(0)
+    if sampling:
+        key, k0 = jax.random.split(key)
+        tok0 = jax.random.categorical(
+            k0, logits[:, -1].astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+    else:
+        tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
     # Padded by k + 1 so each round's window write is a static-size slice;
     # trimmed on return.
     out = jnp.zeros((B, n_new + k + 1), jnp.int32).at[:, 0].set(tok0)
@@ -280,8 +299,9 @@ def lm_speculative_generate(
         return filled < n_new
 
     def body(carry):
-        filled, rounds, out, cache, dcache, last = carry
+        filled, rounds, out, cache, dcache, last, key = carry
         pos = P + filled  # absolute position of the next token to fill
+        key, kd, ka = jax.random.split(key, 3)
 
         # k sequential draft proposals from `last` (position pos - 1).
         def draft_step(c, i):
@@ -290,44 +310,119 @@ def lm_speculative_generate(
                 {"params": draft_params}, tok[:, None], cache=dcache,
                 decode_pos=pos - 1 + i,
             )
-            nxt = jnp.argmax(dlogits[:, 0], axis=-1).astype(jnp.int32)
+            dl = dlogits[:, 0].astype(jnp.float32)
+            if sampling:
+                dl = dl / temperature
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(kd, i), dl, axis=-1
+                ).astype(jnp.int32)
+                # The accept rule needs the full q distributions; greedy
+                # mode returns only tokens (no (k, B, V) stacked buffer).
+                return (nxt, dcache), (nxt, dl)
+            nxt = jnp.argmax(dl, axis=-1).astype(jnp.int32)
             return (nxt, dcache), nxt
 
-        (_, dcache), drafts = lax.scan(
-            draft_step, (last, dcache), jnp.arange(k)
-        )
+        if sampling:
+            (_, dcache), (drafts, dlog) = lax.scan(
+                draft_step, (last, dcache), jnp.arange(k)
+            )
+        else:
+            (_, dcache), drafts = lax.scan(
+                draft_step, (last, dcache), jnp.arange(k)
+            )
         drafts = drafts.T  # (B, k)
 
         # ONE target forward over [last, drafts]: row i's logits give the
-        # target's choice after consuming element i, so t_next[:, :k]
-        # verifies every draft and t_next[:, k] is the bonus token when
-        # all k agree.
+        # target's distribution after consuming element i, so rows 0..k-1
+        # verify every draft and row k yields the bonus token when all
+        # k are accepted.
         chunk = jnp.concatenate([last[:, None], drafts], axis=1)
         tlogits, cache = model.apply(
             {"params": params}, chunk, cache=cache, decode_pos=pos - 1
         )
-        t_next = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, k+1)
+        tlog = tlogits.astype(jnp.float32)
 
-        agree = t_next[:, :k] == drafts
-        prefix = jnp.cumprod(agree.astype(jnp.int32), axis=1)
-        n_agree = jnp.min(prefix.sum(axis=1))  # batch-uniform, 0..k
-        accepted = jnp.minimum(n_agree + 1, n_new - filled)
+        if sampling:
+            tokens, n_accept = speculative_accept(
+                tlog / temperature, dlog.transpose(1, 0, 2), drafts, ka
+            )
+            n_uniform = jnp.min(n_accept)  # batch-uniform, 0..k
+        else:
+            tokens = jnp.argmax(tlog, axis=-1).astype(jnp.int32)  # (B,k+1)
+            agree = tokens[:, :k] == drafts
+            prefix = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+            n_uniform = jnp.min(prefix.sum(axis=1))
+        accepted = jnp.minimum(n_uniform + 1, n_new - filled)
 
         # One masked window write: slots [filled, filled + accepted) take
-        # t_next (`out` is padded by k + 1 so the static window never
-        # crosses the buffer end).
+        # `tokens` (`out` is padded by k + 1 so the static window never
+        # crosses the buffer end).  Rows whose own acceptance ran past the
+        # batch minimum emit their (accepted) draft tokens there; rows cut
+        # at the minimum emit their correction — both p-exact.
         window = lax.dynamic_slice_in_dim(out, filled, k + 1, axis=1)
         keep = jnp.arange(k + 1) < accepted
         out = lax.dynamic_update_slice_in_dim(
-            out, jnp.where(keep[None, :], t_next, window), filled, axis=1
+            out, jnp.where(keep[None, :], tokens, window), filled, axis=1
         )
-        last = jnp.take(t_next, accepted - 1, axis=1)
-        return (filled + accepted, rounds + 1, out, cache, dcache, last)
+        last = jnp.take(tokens, accepted - 1, axis=1)
+        return (filled + accepted, rounds + 1, out, cache, dcache, last,
+                key)
 
-    filled, rounds, out, _, _, _ = lax.while_loop(
+    filled, rounds, out, _, _, _, _ = lax.while_loop(
         cond, body,
         (jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32), out, cache,
-         dcache, tok0),
+         dcache, tok0, key),
     )
     # Target forwards: the prefill + one verify per round.
     return out[:, :n_new], rounds + 1
+
+
+def speculative_accept(p_logits, q_logits, drafts, key):
+    """One round of the speculative-sampling accept/reject rule (Leviathan
+    et al. 2023) — the core :func:`lm_speculative_generate` uses at
+    ``temperature > 0``, exposed for direct (statistical-oracle) testing.
+
+    ``p_logits`` (B, k+1, V): target logits (temperature already applied)
+    for positions 0..k; ``q_logits`` (B, k, V): draft logits; ``drafts``
+    (B, k): the draft's sampled tokens (x_i ~ softmax(q_i)).
+
+    Per position: accept x_i with probability ``min(1, p_i(x)/q_i(x))``;
+    at the first rejection resample from ``normalize(max(p_i − q_i, 0))``;
+    if everything is accepted, sample the bonus token from ``p_k``.  The
+    emitted token at every position is then EXACTLY ``p_i``-distributed —
+    the property the statistical oracle test checks.
+
+    Returns ``(tokens, n_accept)``: ``tokens`` (B, k+1) holds the accepted
+    drafts with each row's correction (resample or bonus) at index
+    ``n_accept[row]``; positions past it are meaningless.  ``n_accept``
+    (B,) in 0..k.
+    """
+    B, K1, V = p_logits.shape
+    k = K1 - 1
+    p = jax.nn.softmax(p_logits, axis=-1)
+    q = jax.nn.softmax(q_logits, axis=-1)
+    ku, kr, kb = jax.random.split(key, 3)
+    u = jax.random.uniform(ku, (B, k))
+    px = jnp.take_along_axis(p[:, :k], drafts[..., None], axis=-1)[..., 0]
+    qx = jnp.take_along_axis(q, drafts[..., None], axis=-1)[..., 0]
+    accept = u < jnp.minimum(1.0, px / jnp.maximum(qx, 1e-20))
+    n_accept = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+    # Residual distribution at each row's first rejection (index n_accept,
+    # clamped for the all-accepted rows whose correction is the bonus).
+    ridx = jnp.minimum(n_accept, k - 1)
+    rows = jnp.arange(B)
+    resid = jnp.maximum(p[rows, ridx] - q[rows, ridx], 0.0)  # (B, V)
+    rsum = resid.sum(-1, keepdims=True)
+    # p == q makes rejection probability 0; the guard only matters for
+    # float dust — fall back to p itself there.
+    resid = jnp.where(rsum > 1e-12, resid / jnp.maximum(rsum, 1e-20),
+                      p[rows, ridx])
+    resample = jax.random.categorical(kr, jnp.log(resid + 1e-38), axis=-1)
+    bonus = jax.random.categorical(kb, p_logits[:, k], axis=-1)
+    correction = jnp.where(n_accept == k, bonus, resample).astype(jnp.int32)
+    tokens = jnp.concatenate(
+        [drafts, bonus[:, None].astype(jnp.int32)], axis=1
+    )
+    tokens = tokens.at[rows, n_accept].set(correction)
+    return tokens, n_accept

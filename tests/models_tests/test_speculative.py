@@ -100,3 +100,66 @@ def test_learned_pos_needs_verify_headroom():
     out, _ = lm_speculative_generate(rope, rp, rope, rp, prompt, n_new=17,
                                      k=5)
     assert out.shape == (1, 17)
+
+
+def test_speculative_accept_statistical_oracle():
+    # The Leviathan exactness theorem: the emitted token at each position
+    # is p-distributed regardless of q.  Empirically check position 0 over
+    # 20k independent rounds with a deliberately skewed draft.
+    from chainermn_tpu.models.decoding import speculative_accept
+
+    V, k, N = 4, 2, 20000
+    p_row = jnp.asarray([0.45, 0.30, 0.20, 0.05])
+    q_row = jnp.asarray([0.10, 0.20, 0.30, 0.40])  # skewed wrong on purpose
+    p_logits = jnp.log(jnp.broadcast_to(p_row, (1, k + 1, V)))
+    q_logits = jnp.log(jnp.broadcast_to(q_row, (1, k, V)))
+
+    def one(key):
+        kd, ka = jax.random.split(key)
+        drafts = jax.random.categorical(
+            kd, jnp.broadcast_to(jnp.log(q_row), (1, k, V)), axis=-1
+        ).astype(jnp.int32)
+        tokens, _ = speculative_accept(p_logits, q_logits, drafts, ka)
+        return tokens[0, 0]
+
+    toks = jax.vmap(one)(jax.random.split(jax.random.PRNGKey(0), N))
+    hist = np.bincount(np.asarray(toks), minlength=V) / N
+    np.testing.assert_allclose(hist, np.asarray(p_row), atol=0.015)
+
+
+def test_speculative_accept_identical_models_always_accept():
+    from chainermn_tpu.models.decoding import speculative_accept
+
+    V, k = 8, 3
+    logits = jnp.asarray(np.random.RandomState(0).randn(2, k + 1, V),
+                         jnp.float32)
+    drafts = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    _, n_accept = speculative_accept(
+        logits, logits[:, :k], drafts, jax.random.PRNGKey(0)
+    )
+    np.testing.assert_array_equal(np.asarray(n_accept), k)  # p/q == 1
+
+
+def test_speculative_sampling_integration():
+    target = _model(layers=2)
+    draft = _model(layers=1)
+    tp = _params(target, seed=0)
+    dp = _params(draft, seed=1)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 40, (2, 8)).astype(np.int32)
+    )
+    key = jax.random.PRNGKey(7)
+    out1, f1 = lm_speculative_generate(
+        target, tp, draft, dp, prompt, n_new=15, k=3, temperature=0.8,
+        rng=key,
+    )
+    out2, _ = lm_speculative_generate(
+        target, tp, draft, dp, prompt, n_new=15, k=3, temperature=0.8,
+        rng=key,
+    )
+    assert out1.shape == (2, 15)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert (np.asarray(out1) >= 0).all() and (np.asarray(out1) < 40).all()
+    with pytest.raises(ValueError, match="requires rng"):
+        lm_speculative_generate(target, tp, draft, dp, prompt, n_new=4,
+                                k=2, temperature=0.5)
