@@ -771,8 +771,8 @@ def _default_block(length: int, cap: int) -> int:
 #: (TPU v5 lite, bf16): XLA's materialized-scores attention WINS below it —
 #: at T=512/D=64 flash ran 0.86× of XLA end-to-end
 #: (result/seq2seq_tpu.json) because the block machinery doesn't amortize —
-#: while flash wins 2.1–2.5× at T=2048 (result/flash_tpu{_d64,}.json) and
-#: its advantage grows with T (result/longcontext_tpu.json).
+#: while flash wins 2.1–2.5× at T=2048 (result/flash_tpu{_d64,}.json);
+#: longer-T rows await the queued on-chip longcontext sweep.
 FLASH_MIN_SEQ = 1024
 
 
