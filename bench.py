@@ -531,6 +531,17 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         **BASELINE_PROVENANCE,
     }
+    if arch == "vit":
+        # Tag the RESOLVED attention impl, not just the requested one: the
+        # model default is "auto", which picks XLA below FLASH_MIN_SEQ —
+        # a recorded payload must say which kernel actually ran (ADVICE r3).
+        from chainermn_tpu.ops import resolve_attention
+
+        tokens = (image_size // model.patch) ** 2
+        payload["attention_requested"] = model.attention
+        payload["attention_resolved"] = resolve_attention(
+            model.attention, tokens
+        )
     if flops_per_step is not None:
         payload["tflops_per_step"] = round(flops_per_step / 1e12, 3)
         from chainermn_tpu.utils import PEAK_BF16_FLOPS as _peaks

@@ -54,6 +54,7 @@ def main():
     import numpy as np
 
     from chainermn_tpu.models import TransformerLM, lm_generate
+    from chainermn_tpu.ops import resolve_attention
 
     platform = jax.devices()[0].platform
     if platform != "tpu" and not args.smoke:
@@ -129,6 +130,13 @@ def main():
                    "heads": args.heads, "d_ff": args.d_ff,
                    "vocab": args.vocab},
         "ms_per_gen_step": round(dt / args.iters / steps * 1000.0, 3),
+        # Resolved impl tag (ADVICE r3): the model default is "auto" — the
+        # PREFILL resolves per-shape; generation steps always run the
+        # cached single-position path (never the Pallas kernel).
+        "attention_requested": model.attention,
+        "attention_resolved_prefill": resolve_attention(
+            model.attention, args.prompt
+        ),
     }
     if args.window:
         payload["window"] = args.window

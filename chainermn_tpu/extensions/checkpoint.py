@@ -155,14 +155,19 @@ class MultiNodeCheckpointer(Extension):
             # The snapshot may be missing EITHER or BOTH, so each drop
             # combination is tried independently (dropping a leaf the
             # snapshot HAS would hit the opposite structure mismatch).
+            # Ordered LEAST-destructive first (ADVICE r3): {it} costs only
+            # a counter re-seed, {ema} discards a trained average — if a
+            # future orbax version ever tolerates an extra checkpoint
+            # subtree, trying {ema} first would silently throw away a
+            # saved EMA from a snapshot that merely predates it_inexact.
             ts = template["train_state"]
             has_ema = getattr(ts, "ema_params", None) is not None
             has_it = "it_inexact" in template["loop"]
             drop_sets = []
-            if has_ema:
-                drop_sets.append({"ema"})
             if has_it:
                 drop_sets.append({"it"})
+            if has_ema:
+                drop_sets.append({"ema"})
             if has_ema and has_it:
                 drop_sets.append({"ema", "it"})
             if not drop_sets:

@@ -44,27 +44,31 @@ def test_speculative_equals_target_greedy(k):
     assert int(forwards) >= 1
 
 
-def test_perfect_draft_max_acceptance():
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_perfect_draft_max_acceptance(k):
     # Draft == target: rounds should accept ~k+1 tokens each.  Not exactly
     # every round: the draft's sequential T=1 steps and the target's
     # batched (k+1)-token verify reduce in different float orders, so a
     # near-tie argmax can flip and cost an extra round — tokens stay
     # exact (acceptance always emits the TARGET's choices), only the
-    # schedule wobbles.  Assert a real forwards cut with slack.
+    # schedule wobbles.  Slack is ONE round: before the last-proposal KV
+    # backfill, the zero-KV hole degraded this to 27 forwards vs 21 ideal
+    # at k=1 (ADVICE r3) — this bound is the regression gate for it.
     target = _model()
     tp = _params(target)
     prompt = jnp.asarray(
         np.random.RandomState(1).randint(0, 40, (1, 6)).astype(np.int32)
     )
-    n_new, k = 25, 4
+    n_new = 25
     got, forwards = lm_speculative_generate(
         target, tp, target, tp, prompt, n_new=n_new, k=k
     )
     want = lm_generate(target, tp, prompt, n_new=n_new)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
-    ideal = 1 + -(-(n_new - 1) // (k + 1))  # 6
-    assert ideal <= int(forwards) <= ideal + 2
-    assert int(forwards) < n_new // 2  # >2x fewer sequential target runs
+    ideal = 1 + -(-(n_new - 1) // (k + 1))
+    assert ideal <= int(forwards) <= ideal + 1
+    if k >= 3:
+        assert int(forwards) < n_new // 2  # >2x fewer sequential runs
 
 
 def test_speculative_validation():
