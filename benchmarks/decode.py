@@ -37,6 +37,17 @@ def main():
     ap.add_argument("--rope", action="store_true",
                     help="rotary positions (required to stream past "
                          "max_len; pairs naturally with --rolling)")
+    ap.add_argument("--speculative", type=int, default=0, metavar="K",
+                    help="also time speculative decoding with K proposals "
+                         "per round from a shallow draft model")
+    ap.add_argument("--draft-layers", type=int, default=0,
+                    help="draft depth (default: layers // 4, min 1)")
+    ap.add_argument("--draft-self", action="store_true",
+                    help="draft = the target itself (perfect agreement): "
+                         "measures the IDEAL-acceptance schedule — the "
+                         "forwards cut a well-trained draft approaches — "
+                         "rather than a random-weights draft whose "
+                         "near-zero acceptance only shows overhead")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
@@ -53,7 +64,11 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
-    from chainermn_tpu.models import TransformerLM, lm_generate
+    from chainermn_tpu.models import (
+        TransformerLM,
+        lm_generate,
+        lm_speculative_generate,
+    )
     from chainermn_tpu.ops import resolve_attention
 
     platform = jax.devices()[0].platform
@@ -78,7 +93,11 @@ def main():
     model = TransformerLM(
         vocab=args.vocab, n_layers=args.layers, d_model=args.d_model,
         n_heads=args.heads, d_ff=args.d_ff,
-        max_len=args.prompt + args.new,
+        # --speculative needs verify headroom: the (k+1)-token verify chunk
+        # touches positions past the plain generation bound.
+        max_len=args.prompt + args.new + (
+            args.speculative + 1 if args.speculative else 0
+        ),
         window=args.window,
         pos_enc="rope" if args.rope else "learned",
     )
@@ -99,7 +118,7 @@ def main():
             lambda p, pr: lm_generate(model, p, pr, args.new,
                                       rolling=rolling)
         )
-        np.asarray(gen(params, prompt))  # compile + warm (value-synced)
+        warm = np.asarray(gen(params, prompt))  # compile+warm, value-synced
         # Sync each iteration with a real device->host readback: over the
         # axon tunnel `block_until_ready` can return EARLY on queued steps
         # (observed here as ms_per_gen_step 0.0 => a 22M tok/s fantasy); a
@@ -108,10 +127,10 @@ def main():
         for _ in range(args.iters):
             out_tokens = gen(params, prompt)
             _ = np.asarray(out_tokens[:1, -1:])
-        return time.perf_counter() - t0
+        return time.perf_counter() - t0, warm
 
-    dt = timed(False)
-    rolling_dt = timed(True) if args.rolling else None
+    dt, plain_toks = timed(False)
+    rolling_dt = timed(True)[0] if args.rolling else None
 
     # Batched prefill = ONE forward; the sequential part is the n_new-1
     # generation steps (plus that prefill program).
@@ -142,6 +161,55 @@ def main():
         payload["window"] = args.window
     if args.rope:
         payload["pos_enc"] = "rope"
+    if args.speculative:
+        # Draft-propose / target-verify: output is EXACTLY the target's
+        # greedy generation (asserted below on real outputs), so the
+        # speedup — if any — is pure schedule.  Decode is latency-bound
+        # per sequential step; a k-round accepts 1..k+1 tokens for
+        # k draft steps + ONE target forward.
+        k = args.speculative
+        if args.draft_self:
+            draft, dparams = model, params
+        else:
+            draft = TransformerLM(
+                vocab=args.vocab,
+                n_layers=args.draft_layers or max(1, args.layers // 4),
+                d_model=args.d_model, n_heads=args.heads, d_ff=args.d_ff,
+                max_len=args.prompt + args.new + k + 1,
+                window=args.window,
+                pos_enc="rope" if args.rope else "learned",
+            )
+            dparams = jax.jit(
+                lambda r: draft.init(
+                    r, jnp.zeros((1, args.prompt), jnp.int32)
+                )
+            )(jax.random.PRNGKey(1))["params"]
+        spec = jax.jit(
+            lambda tp, dp, pr: lm_speculative_generate(
+                model, tp, draft, dp, pr, n_new=args.new, k=k
+            )
+        )
+        toks, fwds = spec(params, dparams, prompt)
+        toks = np.asarray(toks)  # compile + warm, value-synced
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            toks_i, fwds = spec(params, dparams, prompt)
+            _ = np.asarray(toks_i[:1, -1:])
+        spec_dt = time.perf_counter() - t0
+        payload["speculative"] = {
+            "k": k,
+            "draft_layers": draft.n_layers,
+            "draft": "self (ideal acceptance)" if args.draft_self
+                     else "random init (near-zero acceptance: overhead "
+                          "bound only — untrained drafts can't agree)",
+            "tokens_per_sec": round(
+                args.batch * args.new * args.iters / spec_dt, 1
+            ),
+            "speedup_vs_plain": round(dt / spec_dt, 3),
+            "target_forwards": int(fwds),
+            "plain_sequential_steps": args.new,
+            "matches_target_greedy": bool((toks == plain_toks).all()),
+        }
     if rolling_dt is not None:
         payload["rolling"] = {
             "tokens_per_sec": round(

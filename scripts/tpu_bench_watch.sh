@@ -160,11 +160,44 @@ print(float((x@x).sum()))
       echo "# lm 774M bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/longcontext_tpu.json ]; then
+      # Generous budget: T=8k/16k Mosaic compiles take minutes each over
+      # the tunnel (compile cache amortizes retries across windows).
       echo "# running longcontext sweep at $(date +%H:%M:%S)" >&2
-      timeout 1800 python benchmarks/longcontext.py \
+      timeout 3600 python benchmarks/longcontext.py \
         --out result/longcontext_tpu.json \
         >>result/bench_watch_stderr.log 2>&1
       echo "# longcontext rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/flash_tests_tpu_r04.txt ]; then
+      # Re-run the on-chip flash module: the r3 capture predates the
+      # chunked-kernel test (test_chunked_kernels_compile_on_tpu) — the
+      # VMEM-chunk fix has only ever run in interpret mode (VERDICT r3
+      # missing #1); this validates it where it was born.
+      # The module is skipif-gated on TPU availability: a CPU fallback
+      # between our probe and pytest's jax init would exit 0 with every
+      # test skipped — only a run with real passes and ZERO skips counts.
+      echo "# running flash TPU tests (r4, incl. chunked) at $(date +%H:%M:%S)" >&2
+      timeout 2400 env CMN_TESTS_TPU=1 python -m pytest \
+        tests/ops_tests/test_flash_tpu.py -q --no-header \
+        >result/flash_tests_tpu_r04.txt.tmp 2>&1 \
+        && grep -q " passed" result/flash_tests_tpu_r04.txt.tmp \
+        && ! grep -qE "skipped|no tests ran" result/flash_tests_tpu_r04.txt.tmp \
+        && mv result/flash_tests_tpu_r04.txt.tmp result/flash_tests_tpu_r04.txt
+      echo "# flash tests r4 rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    # NOT queued: benchmarks/hetero_pipeline.py — on the 1-chip tunnel
+    # S = comm.size = 1, so "replicated" and "pipeline" run the identical
+    # program and the capture would measure nothing (the bench needs a
+    # multi-device mesh; its CPU-mesh capture is result/hetero_pipeline_cpu.json).
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/decode_spec_tpu.json ]; then
+      # Speculative decoding on chip: --draft-self measures the IDEAL-
+      # acceptance schedule (the forwards cut a trained draft approaches)
+      # plus the per-round overhead, honestly labeled in the payload.
+      echo "# running speculative decode bench at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/decode.py --speculative 4 --draft-self \
+        --out result/decode_spec_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# speculative decode rc=$? at $(date +%H:%M:%S)" >&2
     fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/lm_tpu_355m.json ]; then
       echo "# running lm 355M bench at $(date +%H:%M:%S)" >&2
@@ -185,7 +218,9 @@ print(float((x@x).sum()))
        && [ -s result/bench_tpu_vit_auto.json ] \
        && [ -s result/lm_tpu_774m.json ] \
        && [ -s result/decode_tpu_b64.json ] \
-       && [ -s result/decode_streaming_tpu.json ]; then
+       && [ -s result/decode_streaming_tpu.json ] \
+       && [ -s result/flash_tests_tpu_r04.txt ] \
+       && [ -s result/decode_spec_tpu.json ]; then
       exit 0
     fi
   else
