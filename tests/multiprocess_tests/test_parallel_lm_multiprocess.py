@@ -1,0 +1,56 @@
+"""8-OS-process ParallelLM at real geometry (VERDICT r3 next-round item 6).
+
+The 5-way-parallel train step (pipeline x tensor x MoE x sequence x data)
+previously ran multi-process only at toy widths; this tier runs it at
+d_model=512 / 8 heads / d_ff=2048 / rope with every mesh axis crossing an
+OS-process boundary, and asserts the loss actually decreases over 3 steps.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+WORKER = os.path.join(
+    REPO, "tests", "multiprocess_tests", "worker_parallel_lm.py"
+)
+
+
+def test_eight_process_parallel_lm_real_geometry(tmp_path):
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env.update(
+        {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "CMN_TEST_TMP": str(tmp_path),
+        }
+    )
+    res = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.launch", "-n", "8",
+         "--grace", "5", WORKER],
+        env=env, cwd=REPO, capture_output=True, timeout=900,
+    )
+    log = res.stderr.decode(errors="replace") + res.stdout.decode(
+        errors="replace"
+    )
+    assert res.returncode == 0, log[-4000:]
+    losses = None
+    for pid in range(8):
+        out = tmp_path / f"verdict_{pid}.json"
+        assert out.exists(), f"rank {pid} wrote no verdict:\n{log[-4000:]}"
+        v = json.loads(out.read_text())
+        assert v.get("status") == "ok", v.get("traceback", v)
+        assert v.get("param_count", 0) > 5_000_000, v
+        # Every process must see the SAME (psum-replicated) loss curve.
+        if losses is None:
+            losses = v["losses"]
+        else:
+            assert v["losses"] == losses, (pid, v["losses"], losses)
+    assert losses[-1] < losses[0], losses

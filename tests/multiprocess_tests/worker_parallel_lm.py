@@ -1,0 +1,130 @@
+"""Worker: one REAL-geometry ParallelLM train step across 8 OS processes.
+
+VERDICT r3 next-round item 6: the 5-way-parallel program had only ever run
+multi-process at toy widths (d_model=16).  This worker runs the full
+train step — forward, backward, pipeline, tensor-parallel heads, MoE
+all_to_all, sequence-parallel ring attention, gradient reduction,
+SGD-momentum update — at real LM geometry (d_model=512, 8 heads, d_ff=2048,
+rope) on a (data=1, stage=2, model=2, seq=2) mesh whose every shard
+boundary is an OS-PROCESS boundary (gloo collectives), with a tiny batch so
+the step finishes on CPU.
+"""
+
+import json
+import os
+import sys
+import traceback
+
+import numpy as np
+
+N = 8
+
+
+def main() -> dict:
+    import jax
+    import optax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models.transformer import (
+        ParallelLM,
+        ParallelLMConfig,
+        init_parallel_lm,
+        parallel_lm_specs,
+    )
+    from chainermn_tpu.optimizers import optimizer_state_specs
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid}
+    assert jax.process_count() == N, jax.process_count()
+    assert len(jax.devices()) == N, len(jax.devices())
+
+    mesh = cmn.hybrid_mesh(
+        {"data": 1, "stage": 2, "model": 2, "seq": 2}
+    )
+    comm = cmn.XlaCommunicator(mesh)
+
+    cfg = ParallelLMConfig(
+        vocab=4096, n_stages=2, d_model=512, n_heads=8, d_ff=2048,
+        max_len=128, n_experts=2, moe_k=1, pos_enc="rope",
+    )
+    lm = ParallelLM(cfg, comm.sub("stage"), n_microbatches=2)
+    specs = parallel_lm_specs(cfg)
+
+    rng = np.random.RandomState(0)  # same seed every process: replicated init
+    params = init_parallel_lm(rng, cfg)
+    B, T = 2, cfg.max_len
+    tokens = rng.randint(0, cfg.vocab, size=(B, T)).astype(np.int32)
+    targets = np.concatenate(
+        [tokens[:, 1:], np.full((B, 1), -1, np.int32)], axis=1
+    )
+    batch_specs = (P("data", "seq"), P("data", "seq"))
+
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+    opt_specs = optimizer_state_specs(opt_state, params, specs)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(lm.loss)(params, batch)
+        grads = lm.grad_reduce(grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        total = lax.psum(loss, ("data", "stage", "model", "seq"))
+        return params, opt_state, total
+
+    step = jax.jit(
+        jax.shard_map(
+            train_step,
+            mesh=mesh,
+            in_specs=(specs, opt_specs, batch_specs),
+            out_specs=(specs, opt_specs, P()),
+            check_vma=False,
+        )
+    )
+    # Multi-host placement: every process computed identical host values
+    # (same seed); params/opt state go up replicated, the batch with its
+    # (data, seq) spec via the make_array_from_callback path.
+    from jax.sharding import NamedSharding
+
+    params = comm.replicate(params)
+    opt_state = comm.replicate(opt_state)
+    bsh = NamedSharding(mesh, P("data", "seq"))
+    batch = (comm.place(tokens, bsh), comm.place(targets, bsh))
+    losses = []
+    state = (params, opt_state)
+    for _ in range(3):
+        p2, o2, loss = step(*state, batch)
+        jax.block_until_ready(loss)
+        losses.append(float(np.asarray(loss)))
+        state = (p2, o2)
+    out["losses"] = losses
+    assert all(np.isfinite(l) for l in losses), losses
+    # SGD on a fixed batch at real width must make progress.
+    assert losses[-1] < losses[0], losses
+
+    param_count = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
+    )
+    out["param_count"] = param_count
+    assert param_count > 5_000_000, param_count  # real geometry, not a toy
+
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    result_path = os.path.join(
+        os.environ["CMN_TEST_TMP"],
+        f"verdict_{os.environ['CMN_PROCESS_ID']}.json",
+    )
+    try:
+        verdict = main()
+    except BaseException:
+        verdict = {"status": "fail", "traceback": traceback.format_exc()}
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)
