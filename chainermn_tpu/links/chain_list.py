@@ -43,6 +43,81 @@ from jax import lax
 from chainermn_tpu.functions.point_to_point import send_recv
 
 
+#: Last JAX release KNOWN to mis-route ``lax.switch`` cotangents under the
+#: ``check_vma=True`` transpose when the branch index is device-varying
+#: (all closures collapse onto branch 0's operands) — the defect pinned by
+#: ``tests/links_tests/test_hetero_pipeline.py``.  Versions at or below
+#: this skip the probe and run the hetero chain with the checker off.
+_SWITCH_VMA_LAST_KNOWN_BAD = (0, 9, 0)
+
+_switch_vma_probe_cache: dict = {}
+
+
+def switch_vma_safe(mesh) -> bool:
+    """Does ``lax.switch`` with a device-varying index differentiate
+    correctly under ``check_vma=True`` on the installed JAX?
+
+    Versions up to :data:`_SWITCH_VMA_LAST_KNOWN_BAD` return ``False``
+    without spending a compile.  NEWER versions run a one-off numeric
+    probe (tiny switch-grad vs oracle, cached per process) so the
+    debug-mode default flips back ON the moment upstream ships the fix
+    (VERDICT r3 item 9) — and stays off if the fix regresses."""
+    ver = tuple(
+        int(p) for p in jax.__version__.split(".")[:3] if p.isdigit()
+    )
+    if ver <= _SWITCH_VMA_LAST_KNOWN_BAD:
+        return False
+    key = (ver, tuple(d.id for d in mesh.devices.flat))
+    hit = _switch_vma_probe_cache.get(key)
+    if hit is None:
+        hit = _switch_vma_probe_cache[key] = _probe_switch_vma(mesh)
+    return hit
+
+
+def _probe_switch_vma(mesh) -> bool:
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = list(mesh.devices.flat)
+    S = len(devices)
+    if S < 2:
+        return True  # no device-varying index possible: nothing to mis-route
+    rng = np.random.RandomState(0)
+    pm = Mesh(np.array(devices), ("_vmaprobe",))
+    params = tuple(
+        jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))
+        for _ in range(S)
+    )
+    x = jnp.asarray(rng.normal(size=(2, 4)).astype(np.float32))
+
+    def f(ps, xx):
+        def body(pl, b):
+            idx = lax.axis_index("_vmaprobe")
+            branches = [
+                (lambda bb, s=s: jnp.tanh(bb @ pl[s])) for s in range(S)
+            ]
+            y = lax.switch(idx, branches, b)
+            mask = (idx == S - 1).astype(y.dtype)
+            return jnp.sum(lax.psum(y * mask, "_vmaprobe") ** 2)
+
+        return jax.shard_map(
+            body, mesh=pm, in_specs=(P(), P()), out_specs=P(),
+            check_vma=True,
+        )(ps, xx)
+
+    try:
+        g = jax.jit(jax.grad(f))(params, x)
+    except Exception:
+        return False  # checker rejects the program outright: not safe
+    oracle = jax.grad(
+        lambda ps, xx: jnp.sum(jnp.tanh(xx @ ps[S - 1]) ** 2)
+    )(params, x)
+    return all(
+        bool(np.allclose(np.asarray(g[s]), np.asarray(oracle[s]),
+                         atol=1e-5))
+        for s in range(S)
+    )
+
+
 def _make_unravel(treedef, shapes):
     """Traced inverse of the host-side flat ravel in ``shard_params``:
     slices a flat row back into the stage's leaves (same ``tree_flatten``
@@ -233,7 +308,18 @@ class PipelineChain:
             return nxt, out
 
         T = S + M - 1
-        buf0 = jnp.zeros(mb_shape, x.dtype)
+        from chainermn_tpu.utils import pvary_to_match
+
+        # The carry becomes device-varying after the first tick (ppermute +
+        # stage compute); its initial type must match — including any OUTER
+        # axes the INPUT already varies over when the pipeline is nested in
+        # a wider program (the 4-axis ParallelLM).  Matched to x, not to
+        # stage_params: param-only axes (e.g. tensor-parallel model) are
+        # reduced INSIDE the stage, and over-typing the carry with them
+        # would mark the whole pipeline output spuriously varying there.
+        buf0 = pvary_to_match(
+            jnp.zeros(mb_shape, x.dtype), x, axes=comm.axis_name,
+        )
         _, outs = lax.scan(tick, buf0, jnp.arange(T))
         # Microbatch m leaves the last stage at tick (S - 1 + m).
         valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
@@ -287,13 +373,16 @@ class HeteroPipelineChain:
     shape ``(B, *io_shapes[0][0])`` replicated; returns the final stage's
     output ``(B, *io_shapes[-1][1])`` replicated.
 
-    .. warning:: wrap with ``check_vma=False`` (:meth:`as_spmd_fn` does).
-       The current JAX release mis-routes ``lax.switch`` cotangents under
+    .. warning:: JAX ≤ 0.9.0 mis-routes ``lax.switch`` cotangents under
        the ``check_vma=True`` transpose when the branch index is
        device-varying (all closures collapse onto branch 0's operands);
        with the checker off, switch AD is exact — pinned by
-       ``tests/links_tests/test_hetero_pipeline.py``'s upstream-defect
-       regression test.
+       ``tests/links_tests/test_hetero_pipeline.py``.
+       :meth:`as_spmd_fn` / :meth:`sharded_spmd_fn` pick the flag via
+       :func:`switch_vma_safe` (version gate + numeric probe), so the
+       debug-mode guarantee returns automatically on a fixed JAX; custom
+       ``comm.spmd`` wrappers should pass
+       ``check_vma=switch_vma_safe(comm.mesh)`` the same way.
     """
 
     def __init__(self, comm, stages: Sequence[Callable],
@@ -398,11 +487,14 @@ class HeteroPipelineChain:
             return nxt, out
 
         T = S + M - 1
-        from chainermn_tpu.utils import pvary
+        from chainermn_tpu.utils import pvary_to_match
 
         # The carry becomes device-varying after the first tick (switch on
-        # axis_index); the initial zeros must carry the same vma type.
-        buf0 = pvary(jnp.zeros((b, F), dtype), comm.axis_name)
+        # axis_index); the initial zeros must carry the same vma type —
+        # matched to the inputs so nesting under extra mesh axes works.
+        buf0 = pvary_to_match(
+            jnp.zeros((b, F), dtype), x, mine, axes=comm.axis_name,
+        )
         _, outs = lax.scan(tick, buf0, jnp.arange(T))
         valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
         out_feat = self._feat[-1][1]
@@ -513,29 +605,31 @@ class HeteroPipelineChain:
     def sharded_spmd_fn(self):
         """``jit(shard_map(...))``-wrapped :meth:`apply_sharded`:
         ``(stacked, x) -> y`` with the stack split over the stage axis and
-        ``x``/output replicated (``check_vma=False`` — see the class
-        warning)."""
+        ``x``/output replicated (``check_vma`` via
+        :func:`switch_vma_safe` — see the class warning)."""
         from jax.sharding import PartitionSpec as P
 
         f = self.comm.spmd(
             lambda st, xx: self.apply_sharded(st, xx),
             in_specs=(P(self.comm.axes), P()),
             out_specs=P(),
-            check_vma=False,
+            check_vma=switch_vma_safe(self.comm.mesh),
         )
         return jax.jit(f)
 
     def as_spmd_fn(self):
         """``jit(shard_map(...))``-wrapped forward ``(params_list, x) -> y``
-        with replicated in/out specs and ``check_vma=False`` (see the class
-        warning).  For custom losses, wrap :meth:`__call__` in
-        ``comm.spmd(..., check_vma=False)`` yourself."""
+        with replicated in/out specs and ``check_vma`` picked by
+        :func:`switch_vma_safe` (see the class warning).  For custom
+        losses, wrap :meth:`__call__` in
+        ``comm.spmd(..., check_vma=switch_vma_safe(comm.mesh))``
+        yourself."""
         from jax.sharding import PartitionSpec as P
 
         f = self.comm.spmd(
             lambda pl, xx: self(pl, xx),
             in_specs=(P(), P()),
             out_specs=P(),
-            check_vma=False,
+            check_vma=switch_vma_safe(self.comm.mesh),
         )
         return jax.jit(f)

@@ -790,7 +790,17 @@ class ParallelLM:
         moe = MoELayer(expert_apply, "model", k=cfg.moe_k,
                        capacity_factor=cap_f)
         y, aux = moe(p["router"][0], (p["w1"][0], p["w2"][0]), mine)
-        y = lax.all_gather(y, "model", axis=0, tiled=True)  # (N, D)
+        # Reassemble the expert outputs as an offset-placed psum rather
+        # than all_gather: numerically identical (each rank contributes
+        # only its own slice), but psum output is TYPED model-invarying,
+        # so check_vma=True can verify the stage output's replication —
+        # all_gather stays varying-typed and would force the checker off
+        # (this JAX has no all_gather_invariant).  Costs ~2x the wire
+        # bytes of an all_gather; acceptable for the debug guarantee.
+        y_full = lax.dynamic_update_slice_in_dim(
+            jnp.zeros((N, D), y.dtype), y, mrank * (N // E), axis=0
+        )
+        y = lax.psum(y_full, "model")  # (N, D), model-invarying
         h = h + y.reshape(B, Tl, D)
         return h
 
@@ -826,18 +836,24 @@ class ParallelLM:
     def loss(self, params, batch):
         """This rank's SHARE of the global masked CE.
 
-        Two normalizations make shard_map AD produce the exact global
-        gradient with no fudge factors:
+        The numerator is local but the denominator is the GLOBAL
+        valid-token count (shards hold unequal mask counts, so a
+        mean-of-local-means would be biased).  The replica convention then
+        depends on the checker mode, discriminated at trace time by the
+        tokens' vma type:
 
-        * numerator is local but the denominator is the GLOBAL valid-token
-          count (shards hold unequal mask counts, so a mean-of-local-means
-          would be biased);
-        * divided by the stage×model replica count — those ranks compute
-          IDENTICAL loss copies, and ``value_and_grad`` seeds a cotangent
-          per rank, so without the division the total seeded mass would be
-          ``stage·model × L`` instead of ``L``.
+        * ``check_vma=True`` — the vma-aware transpose seeds ONE cotangent
+          per logical value (the share is typed invarying over
+          stage/model), so the share needs no correction; the global loss
+          is ``utils.psum_over_varying`` of the shares.
+        * ``check_vma=False`` — everything is untyped; ``value_and_grad``
+          seeds a cotangent on each of the stage×model identical copies,
+          so the share is pre-divided by that replica count to keep the
+          seeded mass at ``L``; the global loss is the psum of shares over
+          ALL mesh axes.
 
-        The global loss value is the psum of shares over ALL mesh axes.
+        Both modes are pinned to the dense single-device oracle (loss AND
+        reduced grads) by ``test_parallel_loss_and_grads_match_dense``.
         """
         tokens, targets = batch
         logits = self.apply(params, tokens)
@@ -846,8 +862,21 @@ class ParallelLM:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         ce = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
         n_total = lax.psum(jnp.sum(mask), ("data", "seq"))
+        share = jnp.sum(ce * mask) / jnp.maximum(n_total, 1.0)
+        if jax.typeof(tokens).vma:
+            # check_vma=True: the vma-aware transpose seeds ONE cotangent
+            # per logical value (the loss is typed invarying over
+            # stage/model, where every rank holds an identical copy), so
+            # no replica correction exists or is needed — and the global
+            # loss is the psum of shares over the axes the share VARIES
+            # over (utils.psum_over_varying), not over all axes.
+            return share
+        # check_vma=False: every value is untyped, value_and_grad seeds a
+        # cotangent on each of the stage×model identical copies, so the
+        # share is pre-divided to keep the total seeded mass at L — and
+        # the global loss is the psum of shares over ALL mesh axes.
         replicas = lax.axis_size("stage") * lax.axis_size("model")
-        return jnp.sum(ce * mask) / jnp.maximum(n_total, 1.0) / replicas
+        return share / replicas
 
     # ------------------------------------------------------ grad reduction
     def grad_reduce(self, grads, axes=("data", "stage", "model", "seq")):
@@ -863,6 +892,15 @@ class ParallelLM:
         stage and model) sums over data/seq only.
         """
         specs = parallel_lm_specs(self.cfg)
+        # Mode discriminator: under check_vma=True the AD transpose has
+        # ALREADY reduced the cotangent of any leaf whose primal was
+        # replicated (the vma type forces it), so summing again would
+        # multiply by the axis size — reduce only over axes the grad still
+        # VARIES on.  Under check_vma=False everything is untyped (vma
+        # empty on every leaf) and each free axis needs the explicit psum.
+        vma_on = any(
+            jax.typeof(l).vma for l in jax.tree_util.tree_leaves(grads)
+        )
 
         def reduce_leaf(g, spec):
             used = set()
@@ -874,6 +912,10 @@ class ParallelLM:
                 else:
                     used.add(entry)
             free = tuple(a for a in axes if a not in used)
+            if vma_on:
+                from chainermn_tpu.utils import psum_over_varying
+
+                return psum_over_varying(g, free)
             return lax.psum(g, free) if free else g
 
         # NB: is_leaf keys on the grads tree (arrays), so the matching specs

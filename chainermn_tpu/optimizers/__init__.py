@@ -556,7 +556,15 @@ def model_parallel_grad_reduce(data_comm, model_comm) -> Callable:
     each stage's owner rank and zero elsewhere, so a PMEAN over the model
     axis simultaneously (a) restores the owner's update on every shard —
     without it non-owner shards silently keep stale params — and (b) cancels
-    the replicated-loss multiplicity.  Then the usual mean over data."""
+    the replicated-loss multiplicity.  Then the usual mean over data.
+
+    .. note:: the multiplicity in (b) is the ``check_vma=False`` seeding
+       semantics; the ``MultiNodeChainList`` flows that use this reducer
+       run with the checker off (their spmd wrappers pass
+       ``check_vma=False``).  Under ``check_vma=True`` the vma-aware
+       transpose seeds once and this pmean would under-scale — the
+       checker-on path uses vma-aware reducers instead
+       (``ParallelLM.grad_reduce`` keys on ``jax.typeof(...).vma``)."""
 
     def reduce_leaf(g):
         g = lax.pmean(g, model_comm.axis_name)

@@ -93,10 +93,19 @@ def ring_self_attention(
 
     # Fresh accumulators are device-INVARIANT until marked varying; the scan
     # carry mixes them with the (varying) rotating K/V blocks, so the vma
-    # checker requires pvary here.
-    m0 = pvary(jnp.full((B, H, T), -jnp.inf, jnp.float32), axis_name)
-    l0 = pvary(jnp.zeros((B, H, T), jnp.float32), axis_name)
-    o0 = pvary(jnp.zeros((B, T, H, D), jnp.float32), axis_name)
+    # checker requires them typed to MATCH the inputs — including any OUTER
+    # axes q/k/v already vary over when the ring runs nested in a wider
+    # program (data/stage/model in the 4-axis ParallelLM).
+    from chainermn_tpu.utils import pvary_to_match
+
+    m0 = pvary_to_match(
+        jnp.full((B, H, T), -jnp.inf, jnp.float32), q, k, v,
+        axes=(axis_name,),
+    )
+    l0 = pvary_to_match(jnp.zeros((B, H, T), jnp.float32), q, k, v,
+                        axes=(axis_name,))
+    o0 = pvary_to_match(jnp.zeros((B, T, H, D), jnp.float32), q, k, v,
+                        axes=(axis_name,))
 
     perm = [(i, (i + 1) % S) for i in range(S)]
     rel = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]  # q_pos - k_pos (local)
@@ -220,13 +229,22 @@ def ring_flash_self_attention(
             # discarding (≈half the ring's flash FLOPs in causal mode); the
             # rank-varying predicate is SPMD-safe — no collectives inside.
             src = (my - step) % S
+            from chainermn_tpu.utils import pvary_to_match
+
+            # Both cond branches must carry the same vma type — the zero
+            # branch matches the kernel branch's inputs (which may vary
+            # over outer axes when the ring is nested in a wider program).
             o_blk, lse_blk = lax.cond(
                 src < my,
                 lambda: local(q, k_cur, v_cur, False, seg_arg),
                 lambda: (
-                    pvary(jnp.zeros((B, T, H, D), jnp.float32), axis_name),
-                    pvary(
-                        jnp.full((B, H, T), -jnp.inf, jnp.float32), axis_name
+                    pvary_to_match(
+                        jnp.zeros((B, T, H, D), jnp.float32),
+                        q, k_cur, v_cur, axes=(axis_name,),
+                    ),
+                    pvary_to_match(
+                        jnp.full((B, H, T), -jnp.inf, jnp.float32),
+                        q, k_cur, v_cur, axes=(axis_name,),
                     ),
                 ),
             )

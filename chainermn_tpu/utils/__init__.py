@@ -55,6 +55,44 @@ def pvary(x: Any, axis_name) -> Any:
     return lax.pvary(x, axis_name)
 
 
+def pvary_to_match(x: Any, *refs, axes: tuple = ()) -> Any:
+    """Pvary ``x`` over the axes the ``refs`` vary over (plus ``axes``)
+    that ``x`` does not — the scan-carry initializer's friend: a fresh
+    zeros accumulator must enter a ``lax.scan`` with the same vma type its
+    carry leaves with (the union of whatever the loop body mixes in), or
+    ``check_vma=True`` rejects the loop.  Matching the actual inputs
+    instead of hardcoding one axis keeps the same code correct on a
+    single-axis mesh AND nested inside a wider program (e.g. the ring
+    ported into the 4-axis ParallelLM, where q/k/v arrive already varying
+    over data/stage/model — the r3 reason dryrun ran check_vma=False)."""
+    want = set(axes if isinstance(axes, (tuple, list, set)) else (axes,))
+    for r in refs:
+        for leaf in jax.tree_util.tree_leaves(r):
+            want |= set(jax.typeof(leaf).vma)
+
+    def one(v):
+        missing = tuple(sorted(want - set(jax.typeof(v).vma)))
+        return pvary(v, missing) if missing else v
+
+    return jax.tree_util.tree_map(one, x)
+
+
+def psum_over_varying(x: Any, axes) -> Any:
+    """``lax.psum`` over the subset of ``axes`` that ``x`` actually varies
+    over.  Summing over an axis the value is REPLICATED on multiplies it
+    by the axis size — a silent correctness bug ``check_vma=True`` rejects
+    (and exactly what the r3 dryrun did to its reported loss: the pipeline
+    output is already stage-reduced, so the all-axes psum inflated the
+    total by the stage extent).  Only meaningful under ``check_vma=True``
+    (with the checker off every value types as invarying and nothing would
+    be summed) — callers run with the checker ON."""
+    from jax import lax
+
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    vary = tuple(a for a in axes if a in set(jax.typeof(x).vma))
+    return lax.psum(x, vary) if vary else x
+
+
 def sync(tree: Any) -> None:
     """Wait for device work by MATERIALIZING a value, not just
     ``block_until_ready`` — readiness can report early on donated-aliased

@@ -156,14 +156,19 @@ def test_vgg_hetero_pipeline_matches_sequential(devices):
 
 
 def test_upstream_switch_vma_defect_still_present(devices):
-    """WHY HeteroPipelineChain requires check_vma=False: lax.switch with a
-    device-varying index mis-routes cotangents under the check_vma=True
-    transpose (closures collapse onto branch 0's operands), while the same
-    program with the checker off differentiates exactly.
+    """WHY HeteroPipelineChain defaults check_vma off on this JAX:
+    lax.switch with a device-varying index mis-routes cotangents under the
+    check_vma=True transpose (closures collapse onto branch 0's operands),
+    while the same program with the checker off differentiates exactly.
 
-    WHEN THIS TEST FAILS: the installed JAX fixed the defect — flip
-    HeteroPipelineChain (and as_spmd_fn) to check_vma=True and delete this
-    test."""
+    Since round 4, :func:`switch_vma_safe` (version gate ≤ 0.9.0 + numeric
+    probe on newer JAX) picks the flag automatically, so a fixed upstream
+    restores the debug guarantee with no code change —
+    ``test_switch_vma_gate_consistent`` below pins that the gate's verdict
+    always matches the measured defect.  WHEN THIS test fails: the
+    installed JAX fixed the defect — verify the gate flipped (the
+    consistency test stays green), then delete THIS test and keep the
+    gate."""
     mesh = jax.sharding.Mesh(np.array(devices), ("d",))
     S = len(devices)
     rng = np.random.RandomState(0)
@@ -210,9 +215,40 @@ def test_upstream_switch_vma_defect_still_present(devices):
     )
     assert err > 1e-3, (
         "lax.switch + check_vma=True now differentiates correctly: the "
-        "upstream defect is fixed — switch HeteroPipelineChain to "
-        "check_vma=True and remove this regression test."
+        "upstream defect is fixed. switch_vma_safe's gate should flip "
+        "automatically (see test_switch_vma_gate_consistent) — verify it "
+        "does, then delete this test and keep the gate."
     )
+
+
+def test_switch_vma_gate_consistent(devices):
+    """The auto-restore contract (VERDICT r3 item 9): switch_vma_safe's
+    verdict must MATCH the measured defect on the installed JAX — False
+    while the mis-route exists (the version gate covers ≤ 0.9.0), True the
+    moment a newer JAX differentiates the probe correctly."""
+    import jax as _jax
+
+    from chainermn_tpu.links.chain_list import (
+        _SWITCH_VMA_LAST_KNOWN_BAD,
+        _probe_switch_vma,
+        switch_vma_safe,
+    )
+
+    mesh = jax.sharding.Mesh(np.array(devices), ("d",))
+    ver = tuple(
+        int(p) for p in _jax.__version__.split(".")[:3] if p.isdigit()
+    )
+    measured_ok = _probe_switch_vma(mesh)
+    if ver <= _SWITCH_VMA_LAST_KNOWN_BAD:
+        # Pinned-bad version: the gate must short-circuit to False, and
+        # the probe must agree the defect is real (else the pin is stale).
+        assert switch_vma_safe(mesh) is False
+        assert measured_ok is False, (
+            f"JAX {_jax.__version__} no longer shows the switch-vma "
+            "defect: lower/remove _SWITCH_VMA_LAST_KNOWN_BAD"
+        )
+    else:
+        assert switch_vma_safe(mesh) == measured_ok
 
 
 def test_hetero_compute_is_distributed_not_replicated(devices):

@@ -22,8 +22,8 @@ N = 8
 
 def main() -> dict:
     import jax
+    import jax.numpy as jnp
     import optax
-    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     import chainermn_tpu as cmn
@@ -66,21 +66,29 @@ def main() -> dict:
     opt_state = tx.init(params)
     opt_specs = optimizer_state_specs(opt_state, params, specs)
 
+    from chainermn_tpu.utils import psum_over_varying
+
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(lm.loss)(params, batch)
         grads = lm.grad_reduce(grads)
+        gn = sum(
+            jnp.sum(g.astype(jnp.float32) ** 2)
+            for g in jax.tree_util.tree_leaves(grads)
+        )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        total = lax.psum(loss, ("data", "stage", "model", "seq"))
-        return params, opt_state, total
+        total = psum_over_varying(loss, ("data", "stage", "model", "seq"))
+        return params, opt_state, total, psum_over_varying(
+            gn, ("data", "stage", "model", "seq")
+        )
 
     step = jax.jit(
         jax.shard_map(
             train_step,
             mesh=mesh,
             in_specs=(specs, opt_specs, batch_specs),
-            out_specs=(specs, opt_specs, P()),
-            check_vma=False,
+            out_specs=(specs, opt_specs, P(), P()),
+            check_vma=True,
         )
     )
     # Multi-host placement: every process computed identical host values
@@ -92,15 +100,18 @@ def main() -> dict:
     opt_state = comm.replicate(opt_state)
     bsh = NamedSharding(mesh, P("data", "seq"))
     batch = (comm.place(tokens, bsh), comm.place(targets, bsh))
-    losses = []
+    losses, grad_norms = [], []
     state = (params, opt_state)
     for _ in range(3):
-        p2, o2, loss = step(*state, batch)
+        p2, o2, loss, gn = step(*state, batch)
         jax.block_until_ready(loss)
         losses.append(float(np.asarray(loss)))
+        grad_norms.append(float(np.asarray(gn)))
         state = (p2, o2)
     out["losses"] = losses
+    out["grad_norms"] = grad_norms
     assert all(np.isfinite(l) for l in losses), losses
+    assert all(g > 0 for g in grad_norms), grad_norms
     # SGD on a fixed batch at real width must make progress.
     assert losses[-1] < losses[0], losses
 
