@@ -165,6 +165,10 @@ def _config_matches(prev: dict) -> bool:
     break _fail's one-JSON-line contract, and a cache hit would mask a
     misconfiguration the live path errors on."""
     try:
+        if os.environ.get("CMN_BENCH_DATA"):
+            # A file-backed request asks a different question than the
+            # cached synthetic-batch capture — never substitute.
+            return False
         arch = os.environ.get("CMN_BENCH_ARCH", "resnet50")
         opt_kind = os.environ.get("CMN_BENCH_OPT", "replicated")
         if arch not in ("resnet50", "vit") or \
@@ -288,6 +292,52 @@ def _is_transient(e: Exception) -> bool:
     return any(t in s for t in ("UNAVAILABLE", "DEADLINE_EXCEEDED"))
 
 
+def _ensure_file_dataset(path, n, image_size):
+    """Materialize the uint8-image / int32-label ``.npy`` pair the
+    file-backed mode feeds from (``CMN_BENCH_DATA=auto`` → a repo-local
+    cache dir).  uint8 is the realistic storage format — decoded images —
+    and mmap-able, so the prefetch workers page rows off disk."""
+    import numpy as np
+
+    os.makedirs(path, exist_ok=True)
+    xp = os.path.join(path, "x.npy")
+    yp = os.path.join(path, "y.npy")
+    if not (os.path.exists(xp) and os.path.exists(yp)):
+        _mark(f"generating file-backed dataset ({n} images) at {path}")
+        rng = np.random.RandomState(0)
+        x = rng.randint(
+            0, 256, size=(n, image_size, image_size, 3), dtype=np.uint8
+        )
+        np.save(xp, x)
+        np.save(yp, rng.randint(0, 1000, size=(n,)).astype(np.int32))
+    return path
+
+
+def _file_batch_source(comm, global_batch, image_size, spec):
+    """``NpzDataset → PrefetchIterator → DevicePrefetchIterator`` — the
+    full host input pipeline (VERDICT r3 next-round item 3: the headline
+    step rate had never been measured against it).  Returns an iterator
+    yielding mesh-sharded device batches of ``(x_u8, y)``."""
+    from chainermn_tpu.datasets import NpzDataset
+    from chainermn_tpu.iterators import PrefetchIterator
+    from chainermn_tpu.iterators.device_prefetch import (
+        DevicePrefetchIterator,
+    )
+
+    if spec == "auto":
+        n = int(os.environ.get("CMN_BENCH_DATA_N", "1024"))
+        spec = _ensure_file_dataset(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_data", f"i{image_size}_n{n}"),
+            n, image_size,
+        )
+    ds = NpzDataset(spec)
+    host_it = PrefetchIterator(
+        ds, global_batch, repeat=True, shuffle=True, seed=7,
+    )
+    return DevicePrefetchIterator(host_it, comm, depth=2)
+
+
 def _device_batch(comm, global_batch, image_size):
     """Synthesize the benchmark batch ON DEVICE with the data-axis sharding.
 
@@ -335,8 +385,10 @@ def main():
             else (8 if on_cpu else 256)
         )
         int(os.environ.get("CMN_BENCH_ACCUM", "1"))
+        int(os.environ.get("CMN_BENCH_ITERS", "1"))
+        int(os.environ.get("CMN_BENCH_DATA_N", "1"))
     except ValueError as e:
-        _fail(f"unparsable CMN_BENCH_BATCH/CMN_BENCH_ACCUM: {e}")
+        _fail(f"unparsable CMN_BENCH_BATCH/ACCUM/ITERS/DATA_N: {e}")
     explicit_batch = batch_env is not None
     # The driver runs this unattended at round end: if the headline batch
     # OOMs on the chip, degrade (halving); if the tunnel hiccups
@@ -398,6 +450,11 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     device_kind = devices[0].device_kind
     image_size = 64 if on_cpu else 224
     warmup, iters = (1, 2) if on_cpu else (5, 20)
+    # Iteration override for slow-feed modes (the file-backed H2D rides
+    # the axon tunnel); parse failures were rejected in main's env gate.
+    it_env = os.environ.get("CMN_BENCH_ITERS")
+    if it_env:
+        iters = max(1, int(it_env))
 
     _mark(f"client up: {platform} x{n_dev}, per_chip_batch={per_chip_batch}")
     comm = cmn.create_communicator("xla", allreduce_grad_dtype=jnp.bfloat16)
@@ -457,17 +514,39 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     # CMN_BENCH_ACCUM=k microbatches each device batch k ways (activation
     # memory lever — lets the headline per-chip batch run on smaller HBM).
     accum = int(os.environ.get("CMN_BENCH_ACCUM", "1"))
+    # CMN_BENCH_DATA=auto|<dir>: feed the IDENTICAL train step from
+    # file-backed data through the full host pipeline instead of a
+    # device-resident synthetic batch (VERDICT r3 item 3).  Storage is
+    # uint8 (decoded-image format); the cast to f32 happens in-graph so
+    # the wire/H2D carries 1/4 the bytes.
+    data_mode = os.environ.get("CMN_BENCH_DATA")
+    loss_fn = vit_loss(model) if arch == "vit" else resnet_loss(model)
+    if data_mode:
+        inner_loss = loss_fn
+
+        # Batch is always the LAST positional arg under both loss
+        # contracts: (params, batch) for ViT, (params, model_state, batch)
+        # for the stateful ResNet loss.
+        def loss_fn(params, *rest):  # noqa: F811
+            *pre, batch = rest
+            x, y = batch
+            x = x.astype(jnp.float32) / 127.5 - 1.0
+            return inner_loss(params, *pre, (x, y))
+
     if arch == "vit":
-        step = opt.make_train_step(
-            vit_loss(model), has_aux=True, accum_steps=accum
-        )
+        step = opt.make_train_step(loss_fn, has_aux=True, accum_steps=accum)
     else:
         step = opt.make_train_step(
-            resnet_loss(model), stateful=True, accum_steps=accum
+            loss_fn, stateful=True, accum_steps=accum
         )
 
     global_batch = per_chip_batch * n_dev
-    batch = _device_batch(comm, global_batch, image_size)
+    if data_mode:
+        dit = _file_batch_source(comm, global_batch, image_size, data_mode)
+        _mark("file-backed pipeline up; first batch sharded")
+        batch = next(dit)
+    else:
+        batch = _device_batch(comm, global_batch, image_size)
 
     _mark("batch on device; AOT compiling train step")
     step, flops_per_step = _aot_compile(step, state, batch)
@@ -477,6 +556,8 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     # tunnel, ``block_until_ready`` on donated-aliased outputs has been
     # observed to report ready early; a device→host value transfer cannot lie.
     for _ in range(warmup):
+        if data_mode:
+            batch = next(dit)
         state, metrics = step(state, batch)
         _ = float(metrics["loss"])
 
@@ -485,8 +566,13 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     # the same sequential-dependency argument the reference's wall-clock
     # epoch timing rests on, with no host round-trip per iteration.
     _mark("warmup done; entering timed loop")
+    input_wait = 0.0
     t0 = time.perf_counter()
     for _ in range(iters):
+        if data_mode:
+            w0 = time.perf_counter()
+            batch = next(dit)
+            input_wait += time.perf_counter() - w0
         state, metrics = step(state, batch)
     final_loss = float(metrics["loss"])  # true data dependency on all steps
     dt = time.perf_counter() - t0
@@ -506,7 +592,10 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     step_ms = dt / iters * 1000.0
 
     payload = {
-        "metric": f"{arch}_train_images_per_sec_per_chip",
+        "metric": (
+            f"{arch}_train_filebacked_images_per_sec_per_chip"
+            if data_mode else f"{arch}_train_images_per_sec_per_chip"
+        ),
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         # The 125 img/s/GPU reference is a ResNet-50 number; a ViT run has
@@ -531,6 +620,25 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         **BASELINE_PROVENANCE,
     }
+    if data_mode:
+        bytes_per_step = global_batch * image_size * image_size * 3  # u8
+        payload["input"] = {
+            "mode": "file-backed",
+            "pipeline": "NpzDataset(mmap u8) -> PrefetchIterator -> "
+                        "DevicePrefetchIterator(depth=2)",
+            "host_wait_ms_per_step": round(
+                input_wait / iters * 1000.0, 2
+            ),
+            "h2d_mib_per_step": round(bytes_per_step / 2 ** 20, 1),
+            "achieved_h2d_mib_per_sec": round(
+                bytes_per_step * iters / dt / 2 ** 20, 1
+            ),
+            "note": (
+                "on this rig H2D rides the remote axon tunnel, not a "
+                "local PCIe/DMA path — the transfer bandwidth measured "
+                "here bounds a tunnel, not the TPU host's input path"
+            ),
+        }
     if arch == "vit":
         # Tag the RESOLVED attention impl, not just the requested one: the
         # model default is "auto", which picks XLA below FLASH_MIN_SEQ —
