@@ -169,6 +169,10 @@ def _config_matches(prev: dict) -> bool:
             # A file-backed request asks a different question than the
             # cached synthetic-batch capture — never substitute.
             return False
+        if os.environ.get("CMN_BENCH_STEM", "conv7") != "conv7":
+            return False  # stem probes are their own question too
+        if prev.get("stem") not in (None, "conv7"):
+            return False  # ...and a cached stem probe never answers conv7
         arch = os.environ.get("CMN_BENCH_ARCH", "resnet50")
         opt_kind = os.environ.get("CMN_BENCH_OPT", "replicated")
         if arch not in ("resnet50", "vit") or \
@@ -463,12 +467,26 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     arch = os.environ.get("CMN_BENCH_ARCH", "resnet50")
     if arch not in ("resnet50", "vit"):
         _fail(f"CMN_BENCH_ARCH={arch!r}: expected 'resnet50' or 'vit'")
+    # CMN_BENCH_STEM=s2d swaps the ResNet stem for the space-to-depth
+    # spelling (exactly equivalent function family — s2d_stem_kernel — at
+    # 1.31x stem FLOPs but an MXU-denser mapping; the r3 roofline called
+    # the conv7 stem bandwidth-bound).
+    stem = os.environ.get("CMN_BENCH_STEM", "conv7")
+    if stem not in ("conv7", "s2d"):
+        _fail(f"CMN_BENCH_STEM={stem!r}: expected 'conv7' or 's2d'")
+    if stem != "conv7" and arch != "resnet50":
+        _fail(
+            f"CMN_BENCH_STEM={stem!r} is a ResNet stem knob; it has no "
+            f"meaning for CMN_BENCH_ARCH={arch!r} — unset one"
+        )
     if arch == "vit":
         from chainermn_tpu.models import ViT, vit_loss
 
         model = ViT(num_classes=1000)
     else:
-        model = ResNet50(num_classes=1000, axis_name=comm.axis_name)
+        model = ResNet50(
+            num_classes=1000, axis_name=comm.axis_name, stem=stem
+        )
     # CMN_BENCH_OPT=zero benchmarks the sharded-state tier (reduce-scatter
     # grads + 1/N opt state + param all-gather) instead of the replicated
     # optimizer — same numerics, different memory/traffic profile.
@@ -488,7 +506,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     # each a round trip over the axon tunnel (observed to stall the bench for
     # 10+ minutes before any compute started). One jitted program = one trip.
     init_model = (
-        model if arch == "vit" else ResNet50(num_classes=1000)
+        model if arch == "vit" else ResNet50(num_classes=1000, stem=stem)
     )
 
     @jax.jit
@@ -610,6 +628,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         "per_chip_batch": per_chip_batch,
         "accum_steps": accum,
         "optimizer": opt_kind,
+        "stem": stem if arch == "resnet50" else None,
         "global_batch": global_batch,
         "image_size": image_size,
         "iters": iters,

@@ -22,6 +22,7 @@ from typing import Any, Callable, Sequence, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
 
@@ -92,9 +93,58 @@ class BasicBlock(nn.Module):
         return nn.relu(y + residual.astype(y.dtype))
 
 
+def space_to_depth(x, block: int = 2):
+    """NHWC ``(B, H, W, C) → (B, H/b, W/b, b²·C)``: each ``b×b`` spatial
+    tile becomes channels, packed ``(a, b, c)``-major (row offset, col
+    offset, then original channel)."""
+    B, H, W, C = x.shape
+    if H % block or W % block:
+        raise ValueError(f"H/W {H}x{W} not divisible by block {block}")
+    x = x.reshape(B, H // block, block, W // block, block, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, H // block, W // block, block * block * C)
+
+
+def s2d_stem_kernel(w7):
+    """Rearrange a ``conv_init`` ``(7, 7, C, O)`` kernel into the EXACTLY
+    equivalent ``(4, 4, 4C, O)`` kernel for the space-to-depth stem.
+
+    Identity: the stride-2 7×7 SAME conv (pad (2,3)) satisfies
+    ``out[i,j] = Σ_{t,s∈-1..2, a,b∈0..1} W[2t+a+2, 2s+b+2] ·
+    x[2(i+t)+a, 2(j+s)+b]`` — i.e. a stride-1 4×4 conv with pad (1,2) on
+    the s2d(2) tensor, with ``W'[t+1, s+1, (2a+b)·C + c] = W[2t+a+2,
+    2s+b+2, c]`` and zeros where the 7-tap index falls outside (t=2,a=1).
+    ``test_s2d_stem_exact_equivalence`` pins this bit-for-bit (fp32).
+    Use for checkpoint migration between stems.
+    """
+    k7 = np.asarray(w7)
+    assert k7.shape[:2] == (7, 7), k7.shape
+    C, O = k7.shape[2], k7.shape[3]
+    out = np.zeros((4, 4, 4 * C, O), k7.dtype)
+    for t in range(-1, 3):
+        for s in range(-1, 3):
+            for a in (0, 1):
+                for b in (0, 1):
+                    di, dj = 2 * t + a + 2, 2 * s + b + 2
+                    if 0 <= di < 7 and 0 <= dj < 7:
+                        out[t + 1, s + 1,
+                            (2 * a + b) * C:(2 * a + b + 1) * C] = \
+                            k7[di, dj]
+    return out
+
+
 class ResNet(nn.Module):
     """NHWC ResNet; ``stage_sizes=[3,4,6,3]`` with the bottleneck block is
-    ResNet-50, ``[2,2,2,2]`` with the basic block is ResNet-18."""
+    ResNet-50, ``[2,2,2,2]`` with the basic block is ResNet-18.
+
+    ``stem="s2d"`` replaces the stride-2 7×7 input conv with
+    space-to-depth(2) + a stride-1 4×4 conv — the same function family
+    expressed MXU-friendlier (12 input channels instead of 3, no strided
+    window): the roofline analysis flagged the stem as bandwidth-bound
+    (VERDICT r3 item 8).  Stem FLOPs rise 4·4·12/(7·7·3) = 1.31× in
+    exchange for the denser mapping; everything downstream is unchanged,
+    and :func:`s2d_stem_kernel` converts trained conv7 weights exactly.
+    """
 
     stage_sizes: Sequence[int]
     num_classes: int = 1000
@@ -102,13 +152,28 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     axis_name: Any = None
     block: Callable = BottleneckBlock
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.stem not in ("conv7", "s2d"):
+            raise ValueError(
+                f"stem={self.stem!r}: expected 'conv7' or 's2d'"
+            )
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
-                    dtype=self.dtype, param_dtype=jnp.float32,
-                    kernel_init=nn.initializers.he_normal(), name="conv_init")(x)
+        if self.stem == "s2d":
+            x = space_to_depth(x, 2)
+            x = nn.Conv(self.width, (4, 4), strides=(1, 1),
+                        padding=((1, 2), (1, 2)), use_bias=False,
+                        dtype=self.dtype, param_dtype=jnp.float32,
+                        kernel_init=nn.initializers.he_normal(),
+                        name="conv_init_s2d")(x)
+        else:
+            x = nn.Conv(
+                self.width, (7, 7), strides=(2, 2), use_bias=False,
+                dtype=self.dtype, param_dtype=jnp.float32,
+                kernel_init=nn.initializers.he_normal(),
+                name="conv_init")(x)
         x = nn.relu(
             MultiNodeBatchNormalization(
                 self.width, axis_name=self.axis_name,

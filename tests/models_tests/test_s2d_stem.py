@@ -1,0 +1,132 @@
+"""Space-to-depth ResNet stem (VERDICT r3 item 8 — the one real swing at
+the MFU ceiling the roofline analysis called for).
+
+The claim that makes the probe honest: the s2d stem is not an
+approximation — a stride-2 7×7 SAME conv is EXACTLY a stride-1 4×4 conv on
+the s2d(2) tensor under the kernel rearrangement ``s2d_stem_kernel``, so
+the perf comparison is between two spellings of the same function."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.models.resnet import (
+    ResNet50,
+    ResNetTiny,
+    resnet_loss,
+    s2d_stem_kernel,
+    space_to_depth,
+)
+
+
+def test_s2d_stem_exact_equivalence():
+    """conv7(stride 2, SAME) == conv4(stride 1, pad (1,2)) ∘ s2d(2) with
+    the rearranged kernel — fp32, elementwise exact within conv-order
+    tolerance, on an odd non-square size to exercise the padding math."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(size=(2, 64, 96, 3)).astype(np.float32))
+    w7 = jnp.asarray(rng.normal(size=(7, 7, 3, 16)).astype(np.float32))
+
+    ref = lax.conv_general_dilated(
+        x, w7, window_strides=(2, 2), padding=((2, 3), (2, 3)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    got = lax.conv_general_dilated(
+        space_to_depth(x, 2), jnp.asarray(s2d_stem_kernel(w7)),
+        window_strides=(1, 1), padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_s2d_matches_flax_same_padding():
+    """The flax conv_init uses padding='SAME'; pin that SAME at k=7/s=2
+    really is the (2,3)/(2,3) padding the rearrangement derives from, for
+    both the 224 and the CPU-bench 64 sizes."""
+    import flax.linen as nn
+
+    for H in (64, 224):
+        x = jnp.asarray(
+            np.random.RandomState(1).normal(size=(1, H, H, 3)).astype(
+                np.float32)
+        )
+        w7 = jnp.asarray(
+            np.random.RandomState(2).normal(size=(7, 7, 3, 8)).astype(
+                np.float32)
+        )
+        conv = nn.Conv(8, (7, 7), strides=(2, 2), use_bias=False,
+                       padding="SAME", param_dtype=jnp.float32)
+        ref = conv.apply({"params": {"kernel": w7}}, x)
+        man = lax.conv_general_dilated(
+            x, w7, window_strides=(2, 2), padding=((2, 3), (2, 3)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(man), atol=1e-5, rtol=1e-5
+        )
+
+
+def test_s2d_resnet_forward_and_grads(devices):
+    """End-to-end: the s2d model trains (shapes right, grads finite) and
+    its stem param is the (4, 4, 12, width) kernel."""
+    model = ResNetTiny(num_classes=10, stem="s2d", dtype=jnp.float32)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.normal(size=(4, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, size=(4,)).astype(np.int32))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    k = variables["params"]["conv_init_s2d"]["kernel"]
+    assert k.shape == (4, 4, 12, 64), k.shape
+
+    loss_fn = resnet_loss(model)
+    (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        variables["params"], variables["batch_stats"], (x, y)
+    )
+    assert np.isfinite(float(loss))
+    assert all(
+        np.isfinite(np.asarray(g)).all()
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+
+
+def test_s2d_weight_migration_matches_conv7_model():
+    """Migrating a trained conv7 model's stem kernel through
+    s2d_stem_kernel yields a model with IDENTICAL logits (eval mode) —
+    checkpoint portability between stems."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)).astype(np.float32))
+
+    m7 = ResNetTiny(num_classes=10, dtype=jnp.float32)
+    v7 = m7.init(jax.random.PRNGKey(1), x, train=False)
+    ref = m7.apply(v7, x, train=False)
+
+    ms = ResNetTiny(num_classes=10, stem="s2d", dtype=jnp.float32)
+    vs = ms.init(jax.random.PRNGKey(2), x, train=False)
+    p7 = v7["params"]
+    ps = dict(vs["params"])
+    for name in ps:
+        if name == "conv_init_s2d":
+            ps[name] = {"kernel": jnp.asarray(
+                s2d_stem_kernel(p7["conv_init"]["kernel"])
+            )}
+        else:
+            ps[name] = p7[name]
+    got = ms.apply(
+        {"params": ps, "batch_stats": v7["batch_stats"]}, x, train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-4
+    )
+
+
+def test_stem_validated():
+    with pytest.raises(ValueError, match="stem="):
+        ResNet50(stem="bogus").init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 32, 32, 3), jnp.float32), train=False,
+        )
