@@ -662,6 +662,10 @@ class ParallelLMConfig(NamedTuple):
     #: ``seq_rank·T_local + arange``, so the ring-circulated keys carry
     #: their true positions and relative attention is exact across shards).
     pos_enc: str = "learned"
+    #: ring-local attention impl: "auto" (default — flash-block ring when
+    #: the local shard length clears ``ops.FLASH_MIN_SEQ``, XLA blocks
+    #: below), or force "flash"/"xla".  Both exact; perf-only.
+    attention: str = "auto"
 
 
 def _check_pos_enc(cfg: ParallelLMConfig) -> None:
@@ -752,6 +756,12 @@ class ParallelLM:
 
     def __init__(self, cfg: ParallelLMConfig, stage_comm, n_microbatches: int):
         _check_pos_enc(cfg)
+        # Fail fast on a bad attention impl too — otherwise the
+        # resolve_attention ValueError surfaces mid-trace inside
+        # jit+shard_map, buried in a trace stack.
+        from chainermn_tpu.ops import resolve_attention
+
+        resolve_attention(cfg.attention, 1)
         self.cfg = cfg
         self.scomm = stage_comm
         self.n_micro = n_microbatches
@@ -773,7 +783,18 @@ class ParallelLM:
 
             q = apply_rope(q, tables=rope)
             k = apply_rope(k, tables=rope)
-        a = ring_self_attention(q, k, v, "seq", causal=True)  # SP ring
+        # SP ring.  The measured auto policy picks the flash-block ring
+        # when the LOCAL shard length clears the crossover (that's the
+        # block length each ring step attends at); both rings are
+        # oracle-exact, so this is purely a perf selection.
+        from chainermn_tpu.ops import resolve_attention
+
+        if resolve_attention(cfg.attention, Tl) == "flash":
+            from chainermn_tpu.parallel import ring_flash_self_attention
+
+            a = ring_flash_self_attention(q, k, v, "seq", causal=True)
+        else:
+            a = ring_self_attention(q, k, v, "seq", causal=True)
         o = jnp.einsum("bthe,hed->btd", a, p["wo"][0])
         o = lax.psum(o, "model")  # TP contraction over head shards
         h = h + o

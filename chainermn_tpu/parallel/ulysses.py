@@ -13,6 +13,7 @@ whose AD transpose is the reverse all-to-all.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Optional
 
 import jax
@@ -20,9 +21,23 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _default_attention(q, k, v, causal, segment_ids=None):
-    from chainermn_tpu.ops import reference_attention
+def _default_attention(q, k, v, causal, segment_ids=None, impl="auto"):
+    """The measured `auto` policy (ops.resolve_attention): the Pallas
+    flash kernel once the FULL sequence length clears the on-chip
+    crossover, XLA attention below it — after the all_to_all, q here
+    carries the full T with H/S heads, which is exactly the shape the
+    crossover was measured at.  ``impl`` forces either branch (tests pin
+    the flash branch's numerics at small T through the force)."""
+    from chainermn_tpu.ops import (
+        flash_attention,
+        reference_attention,
+        resolve_attention,
+    )
 
+    if resolve_attention(impl, q.shape[1]) == "flash":
+        return flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids
+        )
     return reference_attention(q, k, v, causal, segment_ids=segment_ids)
 
 
@@ -34,15 +49,17 @@ def ulysses_attention(
     causal: bool = False,
     attn_fn: Optional[Callable] = None,
     segment_ids: Optional[jax.Array] = None,
+    impl: str = "auto",
 ) -> jax.Array:
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Call inside ``shard_map`` with local blocks ``(B, T/S, H, D)``; requires
     ``H % S == 0``.  ``attn_fn(q, k, v, causal) -> out`` runs on
-    full-length sequences with ``H/S`` heads (default: XLA softmax
-    attention; drop in a flash/Pallas kernel here); when ``segment_ids``
-    is used, the attn_fn must accept a fifth positional argument (the
-    full-length segment array).
+    full-length sequences with ``H/S`` heads; the default picks the
+    Pallas flash kernel or XLA attention by the measured crossover
+    (``impl``: "auto" — or force "flash"/"xla"; ignored when a custom
+    ``attn_fn`` is given); when ``segment_ids`` is used, the attn_fn must
+    accept a fifth positional argument (the full-length segment array).
 
     ``segment_ids`` is the LOCAL ``(B, T/S)`` slice of packed rows'
     segments: it is all-gathered to the full sequence (the head dimension
@@ -53,7 +70,8 @@ def ulysses_attention(
     B, T, H, D = q.shape
     if H % S != 0:
         raise ValueError(f"heads {H} not divisible by sequence shards {S}")
-    attn_fn = attn_fn or _default_attention
+    if attn_fn is None:
+        attn_fn = partial(_default_attention, impl=impl)
 
     def seq_to_heads(x):
         # (B, T/S, H, D) → (B, T, H/S, D): gather sequence, scatter heads.

@@ -25,12 +25,7 @@ CFG = ParallelLMConfig(
 )
 
 
-@pytest.fixture(params=["learned", "rope"])
-def setup(request, devices):
-    # Both positional schemes run the SAME oracle-parity suite: under
-    # "rope" each seq shard rotates q/k at its GLOBAL positions before the
-    # ring, and the param tree carries no "pos" table.
-    cfg = CFG._replace(pos_enc=request.param)
+def _build(cfg, devices):
     mesh = cmn.hybrid_mesh(
         {"data": 1, "stage": 2, "model": 2, "seq": 2}, devices=devices
     )
@@ -47,6 +42,14 @@ def setup(request, devices):
     return cfg, mesh, lm, params, tokens, targets
 
 
+@pytest.fixture(params=["learned", "rope"])
+def setup(request, devices):
+    # Both positional schemes run the SAME oracle-parity suite: under
+    # "rope" each seq shard rotates q/k at its GLOBAL positions before the
+    # ring, and the param tree carries no "pos" table.
+    return _build(CFG._replace(pos_enc=request.param), devices)
+
+
 @pytest.mark.parametrize("check_vma", [False, True])
 def test_parallel_forward_matches_dense(setup, check_vma):
     cfg, mesh, lm, params, tokens, _ = setup
@@ -58,6 +61,27 @@ def test_parallel_forward_matches_dense(setup, check_vma):
             in_specs=(specs, P("data", "seq")),
             out_specs=P("data", "seq"),
             check_vma=check_vma,
+        )
+    )
+    out = np.asarray(f(params, tokens))
+    ref = np.asarray(dense_lm_reference(params, cfg, tokens))
+    np.testing.assert_allclose(out, ref, atol=5e-5, rtol=1e-3)
+
+
+def test_parallel_forward_flash_ring_matches_dense(devices):
+    """cfg.attention='flash' forces the flash-block ring (interpret mode
+    off-TPU); the dense oracle must still hold — the auto policy is a
+    perf selection between two exact rings, never a numerics change."""
+    cfg, mesh, lm, params, tokens, _ = _build(
+        CFG._replace(attention="flash"), devices
+    )
+    specs = parallel_lm_specs(cfg)
+    f = jax.jit(
+        jax.shard_map(
+            lm.apply, mesh=mesh,
+            in_specs=(specs, P("data", "seq")),
+            out_specs=P("data", "seq"),
+            check_vma=True,
         )
     )
     out = np.asarray(f(params, tokens))

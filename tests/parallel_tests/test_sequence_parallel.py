@@ -99,6 +99,29 @@ def test_ulysses_matches_full(seq_comm, causal):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_branch_matches_full(seq_comm, causal):
+    """impl='flash' forces the default attn through the Pallas kernel at
+    small T (interpret off-TPU) — the auto policy's flash branch would
+    otherwise only ever run above FLASH_MIN_SEQ on real hardware."""
+    q, k, v = _qkv(np.random.RandomState(2))
+    comm = seq_comm
+    spec = P(None, comm.axes)
+    f = jax.jit(
+        comm.spmd(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, comm.axis_name, causal=causal, impl="flash"
+            ),
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(q, k, v))
+    ref = np.asarray(_oracle_attention(q, k, v, causal))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+
 def test_ulysses_rejects_indivisible_heads(seq_comm):
     comm = seq_comm
     q, k, v = _qkv(np.random.RandomState(3), H=4)  # 4 heads, 8 shards
