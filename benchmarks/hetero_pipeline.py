@@ -97,6 +97,21 @@ def measure(B: int = 128, M: int = 4, iters: int = 3, width_base: int = 256):
     pip = benchmark(lambda: pipe_step(params, x), warmup=2,
                     iters=iters)["mean_s"]
 
+    # --- stage-SHARDED params tier (round 4: the 1/S-memory path) --------
+    # Same pipeline, but the ravel/pad/stack happens once outside the step
+    # and each device holds only its own row — the per-step stack and its
+    # gradient disappear from the program.
+    stacked = pipe.shard_params(params)  # bare-array leaves shard fine
+    sspmd = comm.spmd(
+        lambda st, b: jnp.sum(pipe.apply_sharded(st, b) ** 2),
+        in_specs=(P(comm.axes), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    shard_step = jax.jit(jax.grad(sspmd))
+    shd = benchmark(lambda: shard_step(stacked, x), warmup=2,
+                    iters=iters)["mean_s"]
+
     return {
         "devices": S,
         "stages": S,
@@ -106,6 +121,8 @@ def measure(B: int = 128, M: int = 4, iters: int = 3, width_base: int = 256):
         "replicated_s": round(rep, 4),
         "pipeline_s": round(pip, 4),
         "speedup": round(rep / pip, 3),
+        "sharded_params_s": round(shd, 4),
+        "sharded_vs_replicated_params_speedup": round(pip / shd, 3),
     }
 
 
