@@ -24,7 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CITE = re.compile(r"`(result/[A-Za-z0-9_./-]+)`")
 
 _DOCS = ["BASELINE.md", "README.md", "CHANGELOG.md", "docs/tutorial.md",
-         "docs/migration.md"]
+         "docs/migration.md", "docs/parity.md", "docs/api.md"]
 
 
 def _cited(doc):
