@@ -666,6 +666,11 @@ class ParallelLMConfig(NamedTuple):
     #: the local shard length clears ``ops.FLASH_MIN_SEQ``, XLA blocks
     #: below), or force "flash"/"xla".  Both exact; perf-only.
     attention: str = "auto"
+    #: grouped-query attention: 0 (default) = dense (kv heads == heads);
+    #: else the kv head count — must divide ``n_heads``, and the TP
+    #: sharding additionally needs it divisible by the ``model`` axis
+    #: extent (kv heads shard over ``model`` like q heads).
+    n_kv_heads: int = 0
 
 
 def _check_pos_enc(cfg: ParallelLMConfig) -> None:
@@ -690,13 +695,21 @@ def init_parallel_lm(rng: np.random.RandomState, cfg: ParallelLMConfig) -> Dict:
         scale = scale if scale is not None else 1.0 / math.sqrt(shape[0])
         return (rng.normal(size=shape) * scale).astype(np.float32)
 
+    KH = cfg.n_kv_heads or H
+    if KH != H:
+        qkv_leaves = {
+            "wq": g(S, D, H, Dh, scale=1.0 / math.sqrt(D)),
+            "wkv": g(S, D, 2, KH, Dh, scale=1.0 / math.sqrt(D)),
+        }
+    else:
+        qkv_leaves = {"wqkv": g(S, D, 3, H, Dh, scale=1.0 / math.sqrt(D))}
     tree = {
         "embed": g(cfg.vocab, D, scale=0.02),
         "pos": g(cfg.max_len, D, scale=0.02),
         "stages": {
             "ln1_scale": np.ones((S, D), np.float32),
             "ln1_bias": np.zeros((S, D), np.float32),
-            "wqkv": g(S, D, 3, H, Dh, scale=1.0 / math.sqrt(D)),
+            **qkv_leaves,
             "wo": g(S, H, Dh, D, scale=1.0 / math.sqrt(D)),
             "ln2_scale": np.ones((S, D), np.float32),
             "ln2_bias": np.zeros((S, D), np.float32),
@@ -718,13 +731,22 @@ def parallel_lm_specs(cfg: ParallelLMConfig):
     from jax.sharding import PartitionSpec as P
 
     _check_pos_enc(cfg)
+    if cfg.n_kv_heads and cfg.n_kv_heads != cfg.n_heads:
+        qkv_specs = {
+            "wq": P("stage", None, "model", None),
+            "wkv": P("stage", None, None, "model", None),  # kv heads TP too
+        }
+    else:
+        qkv_specs = {
+            "wqkv": P("stage", None, None, "model", None),  # heads TP
+        }
     specs = {
         "embed": P(),
         "pos": P(),
         "stages": {
             "ln1_scale": P("stage", None),
             "ln1_bias": P("stage", None),
-            "wqkv": P("stage", None, None, "model", None),  # heads TP-sharded
+            **qkv_specs,
             "wo": P("stage", "model", None, None),
             "ln2_scale": P("stage", None),
             "ln2_bias": P("stage", None),
@@ -762,6 +784,14 @@ class ParallelLM:
         from chainermn_tpu.ops import resolve_attention
 
         resolve_attention(cfg.attention, 1)
+        if cfg.n_kv_heads and (
+            not 0 < cfg.n_kv_heads <= cfg.n_heads
+            or cfg.n_heads % cfg.n_kv_heads
+        ):
+            raise ValueError(
+                f"n_kv_heads ({cfg.n_kv_heads}) must be in (0, n_heads] "
+                f"and divide n_heads ({cfg.n_heads})"
+            )
         self.cfg = cfg
         self.scomm = stage_comm
         self.n_micro = n_microbatches
@@ -773,8 +803,21 @@ class ParallelLM:
         cfg = self.cfg
         B, Tl, D = h.shape
         x = _layer_norm(h, p["ln1_scale"][0], p["ln1_bias"][0])
-        qkv = jnp.einsum("btd,dche->btche", x, p["wqkv"][0])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if "wkv" in p:
+            # GQA: fewer kv heads (TP-sharded like q heads); repeat to the
+            # query head count before the ring — group g's queries read kv
+            # head g.  (The kv projections shrink H/KH×; the ring still
+            # circulates repeated heads — a kv-compact ring is a possible
+            # future wire optimization.)
+            q = jnp.einsum("btd,dhe->bthe", x, p["wq"][0])
+            kv = jnp.einsum("btd,dche->btche", x, p["wkv"][0])
+            k, v = kv[:, :, 0], kv[:, :, 1]
+            G = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, G, axis=2)
+            v = jnp.repeat(v, G, axis=2)
+        else:
+            qkv = jnp.einsum("btd,dche->btche", x, p["wqkv"][0])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if rope is not None:
             # Rotation at GLOBAL positions happens BEFORE the ring: the
             # keys each shard circulates already carry their true
@@ -975,8 +1018,16 @@ def dense_lm_reference(params_host: Dict, cfg: ParallelLMConfig, tokens):
     for s in range(cfg.n_stages):
         st = {k: v[s] for k, v in p["stages"].items()}
         x = _layer_norm(h, st["ln1_scale"], st["ln1_bias"])
-        qkv = jnp.einsum("btd,dche->btche", x, st["wqkv"])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if "wkv" in st:
+            q = jnp.einsum("btd,dhe->bthe", x, st["wq"])
+            kv = jnp.einsum("btd,dche->btche", x, st["wkv"])
+            k, v = kv[:, :, 0], kv[:, :, 1]
+            G = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, G, axis=2)
+            v = jnp.repeat(v, G, axis=2)
+        else:
+            qkv = jnp.einsum("btd,dche->btche", x, st["wqkv"])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if rope is not None:
             from chainermn_tpu.ops.rope import apply_rope
 

@@ -42,12 +42,36 @@ def _build(cfg, devices):
     return cfg, mesh, lm, params, tokens, targets
 
 
-@pytest.fixture(params=["learned", "rope"])
+@pytest.fixture(params=[
+    ("learned", 0), ("rope", 0), ("learned", 2), ("rope", 2),
+], ids=["learned", "rope", "learned-gqa", "rope-gqa"])
 def setup(request, devices):
-    # Both positional schemes run the SAME oracle-parity suite: under
-    # "rope" each seq shard rotates q/k at its GLOBAL positions before the
-    # ring, and the param tree carries no "pos" table.
-    return _build(CFG._replace(pos_enc=request.param), devices)
+    # The full oracle-parity suite runs over both positional schemes AND
+    # both attention head layouts: under "rope" each seq shard rotates q/k
+    # at its GLOBAL positions before the ring (no "pos" table); under GQA
+    # (n_kv_heads=2 < n_heads=4) the kv projections are TP-sharded and
+    # repeated to the query head count — rope×GQA pins the rotation-after-
+    # repeat ordering against the dense reference.
+    pos_enc, n_kv = request.param
+    return _build(
+        CFG._replace(pos_enc=pos_enc, n_kv_heads=n_kv), devices
+    )
+
+
+def test_parallel_gqa_param_layout_and_validation(devices):
+    """GQA structural pins (the numerics run through the whole
+    fixture-parametrized suite): the param tree swaps wqkv for wq/wkv,
+    and bad head counts fail fast at construction."""
+    cfg, mesh, lm, params, _, _ = _build(
+        CFG._replace(n_kv_heads=2), devices
+    )
+    assert "wkv" in params["stages"] and "wqkv" not in params["stages"]
+    comm = cmn.XlaCommunicator(mesh)
+    for bad in (3, -2, 8):
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            ParallelLM(
+                CFG._replace(n_kv_heads=bad), comm.sub("stage"), 2
+            )
 
 
 @pytest.mark.parametrize("check_vma", [False, True])
