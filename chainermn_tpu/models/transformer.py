@@ -804,17 +804,14 @@ class ParallelLM:
         B, Tl, D = h.shape
         x = _layer_norm(h, p["ln1_scale"][0], p["ln1_bias"][0])
         if "wkv" in p:
-            # GQA: fewer kv heads (TP-sharded like q heads); repeat to the
-            # query head count before the ring — group g's queries read kv
-            # head g.  (The kv projections shrink H/KH×; the ring still
-            # circulates repeated heads — a kv-compact ring is a possible
-            # future wire optimization.)
+            # GQA: fewer kv heads (TP-sharded like q heads).  k/v stay
+            # COMPACT here — both rings consume them directly (the XLA
+            # ring expands per visiting block at attend time, the flash
+            # kernel streams shared kv natively), so the ring circulates
+            # H/KH× fewer kv bytes.
             q = jnp.einsum("btd,dhe->bthe", x, p["wq"][0])
             kv = jnp.einsum("btd,dche->btche", x, p["wkv"][0])
             k, v = kv[:, :, 0], kv[:, :, 1]
-            G = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, G, axis=2)
-            v = jnp.repeat(v, G, axis=2)
         else:
             qkv = jnp.einsum("btd,dche->btche", x, p["wqkv"][0])
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]

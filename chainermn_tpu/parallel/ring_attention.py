@@ -110,6 +110,14 @@ def ring_self_attention(
     perm = [(i, (i + 1) % S) for i in range(S)]
     rel = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]  # q_pos - k_pos (local)
 
+    # GQA: k/v may carry FEWER heads than q (contiguous groups).  The ring
+    # circulates the COMPACT kv blocks — wire bytes shrink H/KH× — and
+    # each block expands to the query head count only at attend time.
+    KH = k.shape[2]
+    if H % KH:
+        raise ValueError(f"q heads {H} must be a multiple of kv heads {KH}")
+    G = H // KH
+
     def body(carry, step):
         k_cur, v_cur, seg_cur, m, l, o = carry
         if causal:
@@ -123,7 +131,9 @@ def ring_self_attention(
         if segment_ids is not None:
             seg_mask = segment_ids[:, :, None] == seg_cur[:, None, :]
             mask = seg_mask if mask is None else (mask[None] & seg_mask)
-        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, mask)
+        k_att = jnp.repeat(k_cur, G, axis=2) if G > 1 else k_cur
+        v_att = jnp.repeat(v_cur, G, axis=2) if G > 1 else v_cur
+        m, l, o = _block_attend(q, k_att, v_att, m, l, o, mask)
         k_nxt = lax.ppermute(k_cur, axis_name, perm=perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm=perm)
         seg_nxt = (

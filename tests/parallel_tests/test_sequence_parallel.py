@@ -99,6 +99,42 @@ def test_ulysses_matches_full(seq_comm, causal):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("ring", ["xla", "flash"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gqa_compact_kv_matches_expanded(seq_comm, causal, ring):
+    """GQA rings: q with H=8 heads, k/v with KH=2 — the COMPACT kv blocks
+    circulate (H/KH× fewer wire bytes) and must equal attention over the
+    explicitly repeated kv.  Covers both the XLA-block ring (expand at
+    attend time) and the flash ring (kernel streams shared kv)."""
+    from chainermn_tpu.parallel import (
+        ring_flash_self_attention,
+        ring_self_attention,
+    )
+
+    comm = seq_comm
+    rng = np.random.RandomState(7)
+    H, KH = 8, 2
+    q = (rng.normal(size=(2, 32, H, 8)) * 0.5).astype(np.float32)
+    k = (rng.normal(size=(2, 32, KH, 8)) * 0.5).astype(np.float32)
+    v = (rng.normal(size=(2, 32, KH, 8)) * 0.5).astype(np.float32)
+    fn = ring_self_attention if ring == "xla" else ring_flash_self_attention
+    spec = P(None, comm.axes)
+    f = jax.jit(
+        comm.spmd(
+            lambda q, k, v: fn(q, k, v, comm.axis_name, causal=causal),
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    out = np.asarray(f(q, k, v))
+    ref = np.asarray(_oracle_attention(
+        q, np.repeat(k, H // KH, axis=2), np.repeat(v, H // KH, axis=2),
+        causal,
+    ))
+    np.testing.assert_allclose(out, ref, atol=3e-5, rtol=1e-4)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_flash_branch_matches_full(seq_comm, causal):
     """impl='flash' forces the default attn through the Pallas kernel at
