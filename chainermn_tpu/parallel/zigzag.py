@@ -137,6 +137,15 @@ def zigzag_ring_self_attention(
     def split(x):
         return x[:, :c], x[:, c:]
 
+    # GQA: compact kv (fewer heads) circulates the zigzag; the flash
+    # quadrants stream shared kv natively, the einsum quadrants expand to
+    # the query head count at attend time — same convention as the plain
+    # rings.
+    KH = k.shape[2]
+    if H % KH:
+        raise ValueError(f"q heads {H} must be a multiple of kv heads {KH}")
+    G = H // KH
+
     def attend_pair(qc, q_id, sq, kc, vc, k_id, sk, m, l, o):
         """Attend one (q_chunk, k_chunk) quadrant under the chunk-level
         causal structure; skipped entirely when the quadrant is fully
@@ -207,6 +216,12 @@ def zigzag_ring_self_attention(
         for kc, vc, k_id, sk in (
             (k_e, v_e, src_e, sk_e), (k_l, v_l, src_l, sk_l)
         ):
+            if impl != "flash" and G > 1:
+                # Expand compact GQA kv once per visiting chunk (the flash
+                # kernel streams shared kv natively; same convention as
+                # the plain ring).
+                kc = jnp.repeat(kc, G, axis=2)
+                vc = jnp.repeat(vc, G, axis=2)
             m_e, l_e, o_e = attend_pair(
                 q_e, my_e, sq_e, kc, vc, k_id, sk, m_e, l_e, o_e
             )

@@ -63,6 +63,28 @@ def test_matches_full_attention_oracle(devices, impl):
 
 
 @pytest.mark.parametrize("impl", ["einsum", "flash"])
+def test_gqa_compact_kv_matches_expanded(devices, impl):
+    """Compact kv (KH=2 < H=8) circulates the zigzag; output must equal
+    attention over explicitly repeated kv — einsum expands at attend
+    time, flash streams shared kv natively (same convention as the plain
+    rings)."""
+    comm = cmn.XlaCommunicator(cmn.hybrid_mesh({"seq": 8}, devices=devices))
+    B, T, H, KH, D = 2, 64, 8, 2, 16
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, T, KH, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, T, KH, D)).astype(np.float32))
+    got = zigzag_attention(comm, q, k, v, impl=impl)
+    want = reference_attention(
+        q, jnp.repeat(k, H // KH, axis=2), jnp.repeat(v, H // KH, axis=2),
+        causal=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("impl", ["einsum", "flash"])
 def test_gradients_match_oracle(devices, impl):
     comm = cmn.XlaCommunicator(cmn.hybrid_mesh({"seq": 8}, devices=devices))
     B, T, H, D = 1, 32, 2, 8
