@@ -115,8 +115,9 @@ def main():
             print(json.dumps(rec), flush=True)
 
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(out, f, indent=1)
+        from chainermn_tpu.utils import atomic_json_dump
+
+        atomic_json_dump(out, args.out)
 
 
 if __name__ == "__main__":

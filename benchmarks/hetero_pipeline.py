@@ -154,8 +154,9 @@ def main():
     line = json.dumps(res)
     print(line)
     if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+        from chainermn_tpu.utils import atomic_json_dump
+
+        atomic_json_dump(res, args.out)
 
 
 if __name__ == "__main__":

@@ -158,8 +158,9 @@ def main():
     line = json.dumps(out)
     print(line)
     if args.out and not args.smoke:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+        from chainermn_tpu.utils import atomic_json_dump
+
+        atomic_json_dump(out, args.out)
 
 
 if __name__ == "__main__":

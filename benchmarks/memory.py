@@ -132,8 +132,9 @@ def main():
                 rec["temp_vs_baseline"] = round(rec["temp_size_mb"] / base, 3)
     print(json.dumps({k: v for k, v in out.items() if k != "config"}))
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(out, f, indent=1)
+        from chainermn_tpu.utils import atomic_json_dump
+
+        atomic_json_dump(out, args.out)
 
 
 if __name__ == "__main__":

@@ -117,8 +117,9 @@ def main():
     res = measure(args.dim, args.batch, args.microbatches, args.iters)
     print(json.dumps(res), flush=True)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(res, f, indent=1)
+        from chainermn_tpu.utils import atomic_json_dump
+
+        atomic_json_dump(res, args.out)
 
 
 if __name__ == "__main__":

@@ -115,8 +115,9 @@ def main():
     line = json.dumps({k: v for k, v in res.items() if k != "layers"})
     print(line)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(res, f, indent=1)
+        from chainermn_tpu.utils import atomic_json_dump
+
+        atomic_json_dump(res, args.out)
 
 
 if __name__ == "__main__":

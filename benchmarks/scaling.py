@@ -123,8 +123,9 @@ def main():
     results["comm_overhead_pct"] = overhead
     print(json.dumps({"comm_overhead_pct": overhead, "sizes": sizes}), flush=True)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(results, f, indent=1)
+        from chainermn_tpu.utils import atomic_json_dump
+
+        atomic_json_dump(results, args.out)
     return results
 
 

@@ -215,10 +215,17 @@ def lm_speculative_generate(
     everything agrees) is accepted.
 
     Output is EXACTLY the target model's greedy generation — speculation
-    changes the schedule, never the tokens.  Each round costs ``k``
-    sequential draft steps + ONE target forward and accepts 1..``k + 1``
-    tokens, so a well-matched draft cuts the target's sequential forwards
-    (the latency-bound part of decode) by up to ``k + 1``×.
+    changes the schedule, never the tokens.  That equality is an
+    exact-arithmetic property (pinned bitwise by the CPU f32 oracle
+    tests): under finite precision the ``k + 1``-token verify chunk and
+    the 1-token plain step are different XLA kernels whose logits round
+    differently (~0.04 absolute on TPU bf16, measured 2026-08-01), so a
+    near-tie in the target's argmax can resolve differently — true of any
+    speculative implementation, not a property of this one.  Each round
+    costs ``k`` sequential draft steps + ONE target forward and accepts
+    1..``k + 1`` tokens, so a well-matched draft cuts the target's
+    sequential forwards (the latency-bound part of decode) by up to
+    ``k + 1``×.
 
     ``temperature > 0`` (requires ``rng``) switches to speculative
     SAMPLING: drafts are sampled from the draft model and kept with

@@ -42,7 +42,8 @@ class _EncoderBlock(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
         qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if resolve_attention(self.attention, h.shape[1]) == "flash":
+        if resolve_attention(self.attention, h.shape[1],
+                             causal=False) == "flash":
             a = flash_attention(q, k, v, causal=False)
         else:
             a = reference_attention(q, k, v, causal=False).astype(q.dtype)
@@ -66,10 +67,14 @@ class ViT(nn.Module):
     d_ff: int = 1536
     n_layers: int = 12
     dtype: Any = jnp.bfloat16
-    #: "flash", "xla", or "auto" (default): the ViT token count (e.g. 196
-    #: at 224²/p16) sits BELOW the measured flash crossover
-    #: (``ops.FLASH_MIN_SEQ``), so auto runs XLA attention there —
-    #: short rows don't amortize the Pallas block machinery.
+    #: "flash", "xla", or "auto" (default).  ViT rows are NON-CAUSAL
+    #: self-attention, so auto resolves through the lower measured
+    #: crossover ``ops.FLASH_MIN_SEQ_NONCAUSAL`` (= 196, exactly this
+    #: family's on-chip measurement: flash 2010.6 img/s vs auto→XLA's
+    #: 1919.4 at 224²/p16, `result/bench_tpu_vit.json` vs
+    #: `result/bench_tpu_vit_auto.json`) — and auto is backend-aware, so
+    #: CPU/GPU runs keep fast XLA attention instead of interpret-mode
+    #: Pallas.
     attention: str = "auto"
     remat: bool = False
 

@@ -16,6 +16,7 @@ from chainermn_tpu.ops.augment import (
 )
 from chainermn_tpu.ops.flash_attention import (
     FLASH_MIN_SEQ,
+    FLASH_MIN_SEQ_NONCAUSAL,
     flash_attention,
     flash_attention_lse,
     reference_attention,
@@ -28,6 +29,7 @@ __all__ = [
     "reference_attention",
     "resolve_attention",
     "FLASH_MIN_SEQ",
+    "FLASH_MIN_SEQ_NONCAUSAL",
     "chunked_softmax_cross_entropy",
     "apply_rope",
     "random_crop",

@@ -768,28 +768,60 @@ def _default_block(length: int, cap: int) -> int:
 
 
 #: Measured flash-vs-XLA crossover sequence length on the real chip
-#: (TPU v5 lite, bf16): XLA's materialized-scores attention WINS below it —
-#: at T=512/D=64 flash ran 0.86× of XLA end-to-end
-#: (result/seq2seq_tpu.json) because the block machinery doesn't amortize —
-#: while flash wins 2.1–2.5× at T=2048 (result/flash_tpu{_d64,}.json);
-#: longer-T rows await the queued on-chip longcontext sweep.
+#: (TPU v5 lite, bf16) for CAUSAL / cross attention: XLA's
+#: materialized-scores attention WINS below it — at T=512/D=64 flash ran
+#: 0.86× of XLA end-to-end (result/seq2seq_tpu.json) because the block
+#: machinery doesn't amortize — while flash wins 2.1–2.5× at T=2048
+#: (result/flash_tpu{_d64,}.json) and 1.3–1.6× fwd+bwd at T=2048–4096
+#: (result/longcontext_tpu.json).
 FLASH_MIN_SEQ = 1024
 
+#: Measured crossover for NON-CAUSAL UNMASKED self-attention (no mask
+#: work, every block live): flash already wins at T=196 — the ViT-S/16
+#: on-chip pair measured 2010.6 img/s (flash) vs 1919.4 (XLA) for the
+#: full train step (result/bench_tpu_vit.json vs
+#: result/bench_tpu_vit_auto.json).  The threshold sits AT the measured
+#: point; below it is unmeasured and keeps the conservative XLA choice.
+#: SEGMENT-MASKED non-causal rows (e.g. the packed seq2seq encoder) are a
+#: different, unmeasured category — their call sites keep the generic
+#: crossover (the T=512 seq2seq composite measured flash 0.86× overall).
+FLASH_MIN_SEQ_NONCAUSAL = 196
 
-def resolve_attention(impl: str, *lengths: int) -> str:
+
+def resolve_attention(impl: str, *lengths: int, causal: bool = True,
+                      platform: Optional[str] = None) -> str:
     """Resolve an ``attention`` impl choice for the given sequence
     length(s): ``'auto'`` returns ``'flash'`` when every length clears the
-    measured crossover (:data:`FLASH_MIN_SEQ`) AND tiles legally
-    (a multiple-of-8 block divides it — Mosaic's sublane rule), else
-    ``'xla'``.  Explicit ``'flash'``/``'xla'`` pass through unchanged."""
+    measured crossover AND tiles legally (a multiple-of-8 block divides it
+    or a full-dim block fits — Mosaic's sublane rule), else ``'xla'``.
+    Explicit ``'flash'``/``'xla'`` pass through unchanged.
+
+    ``'auto'`` is BACKEND-AWARE: off-TPU (``platform`` defaults to the
+    current JAX backend) it always resolves ``'xla'`` — the Pallas kernels
+    run in interpret mode there, a numerics-testing vehicle, never a perf
+    win.  It is also CAUSALITY-AWARE: pass ``causal=False`` for UNMASKED
+    non-causal single-length self-attention (the ViT family measurement)
+    to use the lower crossover :data:`FLASH_MIN_SEQ_NONCAUSAL`; causal,
+    cross, and segment-masked rows use :data:`FLASH_MIN_SEQ` (callers
+    with segment ids should keep the default ``causal=True`` resolution —
+    that category is unmeasured below 1024)."""
     if impl not in ("flash", "xla", "auto"):
         raise ValueError(
             f"attention={impl!r}: expected 'flash', 'xla' or 'auto'"
         )
     if impl != "auto":
         return impl
+    if platform is None:
+        platform = jax.default_backend()
+    if platform != "tpu":
+        return "xla"
+    min_seq = (
+        FLASH_MIN_SEQ_NONCAUSAL
+        if not causal and len(lengths) == 1
+        else FLASH_MIN_SEQ
+    )
     for n in lengths:
-        if n < FLASH_MIN_SEQ:
+        if n < min_seq:
             return "xla"
         try:
             if _default_block(n, 512) < 8:

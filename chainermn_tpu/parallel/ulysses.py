@@ -34,7 +34,7 @@ def _default_attention(q, k, v, causal, segment_ids=None, impl="auto"):
         resolve_attention,
     )
 
-    if resolve_attention(impl, q.shape[1]) == "flash":
+    if resolve_attention(impl, q.shape[1], causal=causal) == "flash":
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids
         )

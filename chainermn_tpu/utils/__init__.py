@@ -43,6 +43,35 @@ def respect_jax_platforms_env() -> None:
         pass
 
 
+def atomic_json_dump(obj: Any, path: str, indent: int = 1) -> None:
+    """Publish a JSON artifact atomically (write ``path.tmp``, then rename).
+
+    Every ``benchmarks/*.py --out`` artifact is gated on by file
+    NON-EMPTINESS in ``scripts/tpu_bench_watch.sh`` — a SIGTERM (the
+    watcher's ``timeout``) or disk-full landing mid-write must not leave a
+    truncated non-empty file the gate would accept as done forever.
+    ``os.replace`` is atomic on POSIX for same-filesystem renames.
+    """
+    import json
+    import os
+
+    tmp = f"{path}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=indent)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        # Don't strand a partial .tmp on a failed dump (non-serializable
+        # obj, disk full).  A SIGKILL can still strand one — .gitignore
+        # keeps result/*.tmp out of the end-of-round snapshots.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def pvary(x: Any, axis_name) -> Any:
     """Mark ``x`` device-varying over ``axis_name`` (vma type system).
 

@@ -1,40 +1,79 @@
 """attention='auto': the measured flash-vs-XLA crossover policy.
 
 On-chip, XLA's materialized-scores attention beat the Pallas kernel at
-T=512/D=64 (result/seq2seq_tpu.json: flash 0.86×) while flash wins 2.1–2.5×
-at T=2048 (result/flash_tpu{_d64,}.json) — 'auto' encodes that crossover so
-models pick the measured-best path per shape."""
+T=512/D=64 causal/cross rows (result/seq2seq_tpu.json: flash 0.86×) while
+flash wins 2.1–2.5× at T=2048 (result/flash_tpu{_d64,}.json) — 'auto'
+encodes that crossover so models pick the measured-best path per shape.
+Non-causal self-attention crosses over LOWER: the ViT-S/16 pair measured
+flash 2010.6 vs XLA 1919.4 img/s at T=196 (result/bench_tpu_vit.json vs
+result/bench_tpu_vit_auto.json).  And 'auto' is backend-aware: off-TPU the
+Pallas path is interpret mode (a numerics vehicle, never a perf win), so
+auto always resolves 'xla' there."""
 
 import numpy as np
 
 from chainermn_tpu.ops import resolve_attention
-from chainermn_tpu.ops.flash_attention import FLASH_MIN_SEQ
+from chainermn_tpu.ops.flash_attention import (
+    FLASH_MIN_SEQ,
+    FLASH_MIN_SEQ_NONCAUSAL,
+)
 
 
 def test_explicit_impls_pass_through():
+    # Explicit choices ignore platform and length entirely.
     assert resolve_attention("flash", 64) == "flash"
-    assert resolve_attention("xla", 65536) == "xla"
+    assert resolve_attention("flash", 64, platform="cpu") == "flash"
+    assert resolve_attention("xla", 65536, platform="tpu") == "xla"
 
 
 def test_auto_crossover():
-    assert resolve_attention("auto", FLASH_MIN_SEQ - 1) == "xla"
-    assert resolve_attention("auto", FLASH_MIN_SEQ) == "flash"
-    assert resolve_attention("auto", 2048) == "flash"
+    assert resolve_attention("auto", FLASH_MIN_SEQ - 1, platform="tpu") == "xla"
+    assert resolve_attention("auto", FLASH_MIN_SEQ, platform="tpu") == "flash"
+    assert resolve_attention("auto", 2048, platform="tpu") == "flash"
     # Cross-attention: BOTH lengths must clear the crossover.
-    assert resolve_attention("auto", 2048, 512) == "xla"
-    assert resolve_attention("auto", 2048, 4096) == "flash"
+    assert resolve_attention("auto", 2048, 512, platform="tpu") == "xla"
+    assert resolve_attention("auto", 2048, 4096, platform="tpu") == "flash"
+
+
+def test_auto_noncausal_crossover():
+    # Non-causal SELF attention (single length) uses the ViT-measured
+    # threshold; cross attention (two lengths) keeps the causal one even
+    # when non-causal.
+    T = FLASH_MIN_SEQ_NONCAUSAL
+    assert resolve_attention("auto", T, causal=False, platform="tpu") == "flash"
+    assert resolve_attention("auto", T - 1, causal=False,
+                             platform="tpu") == "xla"
+    assert resolve_attention("auto", T, causal=True, platform="tpu") == "xla"
+    assert resolve_attention("auto", T, T, causal=False,
+                             platform="tpu") == "xla"
+
+
+def test_auto_is_backend_aware():
+    # Off-TPU, auto NEVER picks the interpret-mode Pallas path — at any
+    # length, causal or not.
+    for plat in ("cpu", "gpu"):
+        assert resolve_attention("auto", 4096, platform=plat) == "xla"
+        assert resolve_attention("auto", 196, causal=False,
+                                 platform=plat) == "xla"
+    # Default platform is the live backend (CPU under the test mesh).
+    assert resolve_attention("auto", 4096) == "xla"
 
 
 def test_auto_rejects_untileable_lengths():
     # 1031 is prime: no multiple-of-8 block divides it and a full-dim
     # block would be tile-legal only up to 1024 — auto falls back to XLA
     # instead of letting the kernel raise.
-    assert resolve_attention("auto", 1031) == "xla"
+    assert resolve_attention("auto", 1031, platform="tpu") == "xla"
+    # 196 itself is full-dim tile-legal (196 ≤ 1024): the non-causal
+    # threshold is usable, not just nominal.
+    assert resolve_attention("auto", 196, causal=False,
+                             platform="tpu") == "flash"
 
 
 def test_models_resolve_auto(monkeypatch):
-    # A tiny ViT (T << crossover) built with the default 'auto' must take
-    # the XLA branch: flash_attention should never be called.
+    # A tiny ViT (T << crossover) with the default 'auto' must take the
+    # XLA branch: flash_attention should never be called (doubly so under
+    # the CPU test mesh, where auto is pinned to XLA by backend).
     import jax
     import jax.numpy as jnp
 
