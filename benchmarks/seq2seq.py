@@ -33,6 +33,13 @@ def main():
     ap.add_argument("--nonpad", type=float, default=0.87,
                     help="simulated non-pad fraction (the bucketing tier's "
                          "measured 0.87 at bucket_width=4)")
+    ap.add_argument("--enc-attention", default=None,
+                    choices=("flash", "xla", "auto"),
+                    help="encoder-only attention override applied to BOTH "
+                         "ablation arms (e.g. --enc-attention flash makes "
+                         "the 'xla' arm the encoder-flash hybrid) — probes "
+                         "the segment-masked non-causal encoder category "
+                         "separately from the decoder's causal/cross rows")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
@@ -72,6 +79,7 @@ def main():
         "config": {k: getattr(args, k.replace("-", "_")) for k in
                    ("batch", "src_len", "tgt_len", "d_model", "heads",
                     "d_ff", "enc", "dec", "vocab")},
+        "enc_attention_override": args.enc_attention,
         "nonpad_fraction": args.nonpad,
     }
 
@@ -92,12 +100,18 @@ def main():
     )
 
     for impl in ("flash", "xla"):
+        if args.enc_attention == impl:
+            # The override makes this arm identical to the uniform
+            # configuration already captured elsewhere — don't spend half
+            # a scarce tunnel window re-measuring known data.
+            continue
         model = TransformerSeq2Seq(
             vocab_src=args.vocab, vocab_tgt=args.vocab,
             d_model=args.d_model, n_heads=args.heads, d_ff=args.d_ff,
             n_enc=args.enc, n_dec=args.dec,
             max_len=max(args.src_len, args.tgt_len),
             dtype=jnp.bfloat16, attention=impl,
+            enc_attention=args.enc_attention,
         )
         opt = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
         params = jax.jit(
@@ -146,8 +160,16 @@ def main():
             m = mfu(compiled, dt / args.iters, n_dev, out["device_kind"])
             if m is not None:
                 rec["mfu_pct"] = round(m, 2)
-        out[impl] = rec
-        print(json.dumps({impl: rec}), flush=True)
+        # With an encoder override, name the record by its RESOLVED
+        # config — the bare 'xla' key would silently mean "enc-flash
+        # hybrid" and invite misreads against earlier pure-arm captures.
+        key = (
+            f"enc_{args.enc_attention}_dec_{impl}"
+            if args.enc_attention and args.enc_attention != impl
+            else impl
+        )
+        out[key] = rec
+        print(json.dumps({key: rec}), flush=True)
 
     if "flash" in out and "xla" in out:
         out["flash_speedup"] = round(

@@ -14,7 +14,7 @@ the whole step stays one XLA program.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -187,6 +187,12 @@ class TransformerSeq2Seq(nn.Module):
     max_len: int = 128
     dtype: Any = jnp.float32
     attention: str = "auto"
+    #: Encoder-only override ("flash"/"xla"/"auto"; None = follow
+    #: ``attention``).  The encoder's rows are non-causal segment-masked
+    #: self-attention — a different measured category from the decoder's
+    #: causal + cross rows — so the two sides can be mixed to measure (or
+    #: exploit) per-component crossovers.
+    enc_attention: Optional[str] = None
 
     @nn.compact
     def __call__(self, src, tgt_in):
@@ -195,6 +201,13 @@ class TransformerSeq2Seq(nn.Module):
             raise ValueError(
                 f"attention={self.attention!r}: expected 'flash', 'xla' "
                 "or 'auto'"
+            )
+        if self.enc_attention is not None and self.enc_attention not in (
+            "flash", "xla", "auto"
+        ):
+            raise ValueError(
+                f"enc_attention={self.enc_attention!r}: expected 'flash', "
+                "'xla', 'auto' or None"
             )
         if D % self.n_heads:
             raise ValueError(
@@ -216,7 +229,8 @@ class TransformerSeq2Seq(nn.Module):
         for i in range(self.n_enc):
             h = _EncBlock(
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
-                dtype=self.dtype, attention=self.attention,
+                dtype=self.dtype,
+                attention=self.enc_attention or self.attention,
                 name=f"enc_{i}",
             )(h, src_seg)
         enc = nn.LayerNorm(dtype=self.dtype, name="ln_enc")(h)
