@@ -244,6 +244,24 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# lm 355M bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    # Fresh round-4 headline, LAST among the stanzas: never-measured
+    # artifacts get the scarce window first; this one re-captures the
+    # already-covered conv7 config so the round has its own dated
+    # headline and the cached fallback (bench_tpu_done.json) serves the
+    # newest measurement.  Guard rejects BOTH the unreachable and the
+    # deliberate zero-value "failed" payloads (bench.py exits 0 on them)
+    # so a failure record can never clobber the known-good done-artifact.
+    if [ -s result/bench_tpu_done.json ] \
+       && [ -s result/seq2seq_tpu_encflash.json ] \
+       && [ ! -s result/bench_tpu_r04.json ]; then
+      echo "# running fresh r4 headline bench at $(date +%H:%M:%S)" >&2
+      CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH timeout 1800 python bench.py \
+        >result/bench_tpu_r04.json.tmp 2>>result/bench_watch_stderr.log \
+        && ! grep -qE 'unreachable|"failed"' result/bench_tpu_r04.json.tmp \
+        && mv result/bench_tpu_r04.json.tmp result/bench_tpu_r04.json \
+        && cp result/bench_tpu_r04.json result/bench_tpu_done.json
+      echo "# r4 headline rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ -s result/flash_tpu.json ] \
        && [ -s result/flash_tests_tpu.txt ] \
        && [ -s result/bench_tpu_b512.json ] \
@@ -260,7 +278,8 @@ print(float((x@x).sum()))
        && [ -s result/decode_spec_tpu.json ] \
        && [ -s result/bench_tpu_filebacked.json ] \
        && [ -s result/bench_tpu_s2d.json ] \
-       && [ -s result/seq2seq_tpu_encflash.json ]; then
+       && [ -s result/seq2seq_tpu_encflash.json ] \
+       && [ -s result/bench_tpu_r04.json ]; then
       exit 0
     fi
   else

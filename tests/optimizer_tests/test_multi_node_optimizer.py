@@ -59,6 +59,35 @@ def test_dp_matches_single_device_oracle(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5)
 
 
+def test_adafactor_dp_matches_single_device_oracle(devices):
+    """The low-memory tier (factored second moments — the optimizer that
+    put 1.5B-param training on one 16 GB chip, result/lm_tpu_1558m.json)
+    through the multi-node step == plain single-device optax.adafactor on
+    the identical global batch stream."""
+    comm, model, params, loss_fn = _setup(devices)
+    tx = optax.adafactor(1e-3)
+    opt = cmn.create_multi_node_optimizer(tx, comm)
+    state = opt.init(params)
+
+    batches = _batches(5, 64)
+
+    oparams = params
+    oopt = tx.init(params)
+    for b in batches:
+        (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(oparams, b)
+        updates, oopt = tx.update(grads, oopt, oparams)
+        oparams = optax.apply_updates(oparams, updates)
+
+    for b in batches:
+        state, metrics = opt.update(state, b, loss_fn, has_aux=True)
+
+    flat_a = jax.tree_util.tree_leaves(state.params)
+    flat_b = jax.tree_util.tree_leaves(oparams)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
 def test_loss_decreases(devices):
     comm, model, params, loss_fn = _setup(devices)
     opt = cmn.create_multi_node_optimizer(optax.adam(1e-2), comm)
