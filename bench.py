@@ -173,6 +173,10 @@ def _config_matches(prev: dict) -> bool:
             return False  # stem probes are their own question too
         if prev.get("stem") not in (None, "conv7"):
             return False  # ...and a cached stem probe never answers conv7
+        if os.environ.get("CMN_BENCH_MAXPOOL", "xla") != "xla":
+            return False  # maxpool probes likewise
+        if prev.get("maxpool") not in (None, "xla"):
+            return False
         arch = os.environ.get("CMN_BENCH_ARCH", "resnet50")
         opt_kind = os.environ.get("CMN_BENCH_OPT", "replicated")
         if arch not in ("resnet50", "vit") or \
@@ -479,13 +483,25 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
             f"CMN_BENCH_STEM={stem!r} is a ResNet stem knob; it has no "
             f"meaning for CMN_BENCH_ARCH={arch!r} — unset one"
         )
+    # CMN_BENCH_MAXPOOL=fused swaps the stem max-pool's backward from
+    # XLA's select_and_scatter (largest non-conv kernel in the headline
+    # trace, 10.6 ms) for the scatter-free ops.max_pool_fused.
+    maxpool = os.environ.get("CMN_BENCH_MAXPOOL", "xla")
+    if maxpool not in ("xla", "fused"):
+        _fail(f"CMN_BENCH_MAXPOOL={maxpool!r}: expected 'xla' or 'fused'")
+    if maxpool != "xla" and arch != "resnet50":
+        _fail(
+            f"CMN_BENCH_MAXPOOL={maxpool!r} is a ResNet knob; it has no "
+            f"meaning for CMN_BENCH_ARCH={arch!r} — unset one"
+        )
     if arch == "vit":
         from chainermn_tpu.models import ViT, vit_loss
 
         model = ViT(num_classes=1000)
     else:
         model = ResNet50(
-            num_classes=1000, axis_name=comm.axis_name, stem=stem
+            num_classes=1000, axis_name=comm.axis_name, stem=stem,
+            maxpool=maxpool,
         )
     # CMN_BENCH_OPT=zero benchmarks the sharded-state tier (reduce-scatter
     # grads + 1/N opt state + param all-gather) instead of the replicated
@@ -629,6 +645,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         "accum_steps": accum,
         "optimizer": opt_kind,
         "stem": stem if arch == "resnet50" else None,
+        "maxpool": maxpool if arch == "resnet50" else None,
         "global_batch": global_batch,
         "image_size": image_size,
         "iters": iters,

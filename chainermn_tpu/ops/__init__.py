@@ -22,6 +22,7 @@ from chainermn_tpu.ops.flash_attention import (
     reference_attention,
     resolve_attention,
 )
+from chainermn_tpu.ops.pooling import max_pool_fused
 
 __all__ = [
     "flash_attention",
@@ -30,6 +31,7 @@ __all__ = [
     "resolve_attention",
     "FLASH_MIN_SEQ",
     "FLASH_MIN_SEQ_NONCAUSAL",
+    "max_pool_fused",
     "chunked_softmax_cross_entropy",
     "apply_rope",
     "random_crop",

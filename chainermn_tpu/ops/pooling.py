@@ -1,0 +1,173 @@
+"""Max pooling with a hand-written backward pass.
+
+Motivation (round 4): the xprof trace of the ResNet-50 headline step showed
+``select-and-scatter`` — XLA's lowering of max-pool's AD — as the single
+largest non-conv kernel (10.6 ms of the 109.15 ms step, ~10%;
+``BASELINE.md`` b512 row).  Its gather/scatter structure resists fusion.
+This implementation makes the backward pure shifted-window arithmetic:
+
+- forward: one running max/argmax chain over the ``kh*kw`` shifted slices
+  of the padded input (elementwise selects — no materialized
+  ``(..., kh*kw)`` stack), saving the winning offset index per window
+  (uint8 residual, 1 byte per output element instead of the full input);
+- backward: for each window offset, the masked cotangent is placed back
+  onto the input grid with an interior-dilated ``lax.pad`` (stride
+  becomes dilation) and the ``kh*kw`` placements are summed — pads and
+  adds only, fully fusable, no scatter.
+
+Tie semantics: the FIRST maximum in row-major window order wins, matching
+``jnp.argmax`` and XLA's ``select_and_scatter`` (GE select scans in the
+same order), so gradients agree with ``nn.max_pool``'s AD even on exact
+ties; ``tests/ops_tests/test_pooling.py`` pins both the tie-free and the
+constructed-tie cases.  NaNs propagate through the forward exactly like
+``lax.max`` in ``reduce_window`` (an upstream blow-up must surface, not
+be masked by the pool); gradient ROUTING on a NaN window is not
+meaningful in either implementation and is not pinned.
+
+Reference anchor: ChainerMN itself delegated pooling to Chainer/cuDNN
+(``F.max_pooling_2d`` in its ImageNet example); this is the TPU-side
+equivalent of owning that hot op.  Wired into :class:`models.ResNet` via
+``maxpool="fused"`` (default stays ``"xla"`` until the on-chip A/B lands —
+same measured-decision discipline as ``stem="s2d"``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _same_pads(size: int, window: int, stride: int) -> Tuple[int, int]:
+    """XLA SAME padding: total = what's needed for ceil(size/stride) wins."""
+    out = -(-size // stride)
+    total = max((out - 1) * stride + window - size, 0)
+    return total // 2, total - total // 2
+
+
+def _resolve_pads(shape, window, strides, padding):
+    if isinstance(padding, str):
+        if padding == "VALID":
+            return ((0, 0), (0, 0))
+        if padding == "SAME":
+            return tuple(
+                _same_pads(s, w, st)
+                for s, w, st in zip(shape, window, strides)
+            )
+        raise ValueError(f"padding={padding!r}: expected 'SAME'/'VALID' "
+                         "or explicit ((lo, hi), (lo, hi))")
+    return tuple((int(lo), int(hi)) for lo, hi in padding)
+
+
+def _fwd_argmax(x, window, strides, pads):
+    """Running max + first-max argmax over the window offsets."""
+    kh, kw = window
+    sh, sw = strides
+    (plh, phh), (plw, phw) = pads
+    B, H, W, C = x.shape
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (plh, phh), (plw, phw), (0, 0)),
+                 constant_values=neg)
+    Hp, Wp = H + plh + phh, W + plw + phw
+    Ho = max((Hp - kh) // sh + 1, 0)
+    Wo = max((Wp - kw) // sw + 1, 0)
+    if Ho == 0 or Wo == 0:
+        # Window larger than the padded input: nn.max_pool returns the
+        # empty output — match it (gradient is all-zeros, handled by the
+        # same guard in the backward).
+        empty = jnp.zeros((B, Ho, Wo, C), x.dtype)
+        return empty, jnp.zeros((B, Ho, Wo, C), jnp.uint8), (Ho, Wo, Hp, Wp)
+    is_float = jnp.issubdtype(x.dtype, jnp.floating)
+    best = None
+    arg = None
+    for a in range(kh):          # row-major window order = XLA's scan
+        for b in range(kw):      # order for select_and_scatter ties
+            sl = lax.slice(
+                xp, (0, a, b, 0),
+                (B, a + (Ho - 1) * sh + 1, b + (Wo - 1) * sw + 1, C),
+                (1, sh, sw, 1),
+            )
+            k = a * kw + b
+            if best is None:
+                best, arg = sl, jnp.zeros(sl.shape, jnp.uint8)
+            else:
+                # Strict > keeps the EARLIER offset on ties (XLA's GE
+                # select order).  NaNs must PROPAGATE like lax.max does
+                # in reduce_window — a bare strict compare would silently
+                # drop them (and mask upstream blow-ups in training).
+                take = sl > best
+                if is_float:
+                    take = take | jnp.isnan(sl)
+                best = jnp.where(take, sl, best)
+                arg = jnp.where(take, jnp.uint8(k), arg)
+    return best, arg, (Ho, Wo, Hp, Wp)
+
+
+def max_pool_fused(
+    x: jax.Array,
+    window: Sequence[int] = (3, 3),
+    strides: Sequence[int] = (2, 2),
+    padding="SAME",
+) -> jax.Array:
+    """``nn.max_pool`` (NHWC) with the scatter-free custom backward."""
+    window = tuple(int(w) for w in window)
+    strides = tuple(int(s) for s in strides)
+    if x.ndim != 4:
+        raise ValueError(f"expected NHWC rank-4 input, got shape {x.shape}")
+    pads = _resolve_pads(x.shape[1:3], window, strides, padding)
+    return _max_pool_p(x, window, strides, pads,
+                       (tuple(x.shape), jnp.dtype(x.dtype).name))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _max_pool_p(x, window, strides, pads, shape_dtype):
+    best, _, _ = _fwd_argmax(x, window, strides, pads)
+    return best
+
+
+def _mp_fwd(x, window, strides, pads, shape_dtype):
+    best, arg, _ = _fwd_argmax(x, window, strides, pads)
+    return best, arg
+
+
+def _mp_bwd(window, strides, pads, shape_dtype, arg, g):
+    x_shape, x_dtype = shape_dtype
+    kh, kw = window
+    sh, sw = strides
+    (plh, phh), (plw, phw) = pads
+    B, H, W, C = x_shape
+    Hp, Wp = H + plh + phh, W + plw + phw
+    Ho = max((Hp - kh) // sh + 1, 0)
+    Wo = max((Wp - kw) // sw + 1, 0)
+    if Ho == 0 or Wo == 0:
+        return (jnp.zeros(x_shape, x_dtype),)
+    # fp32 accumulation: up to kh*kw window contributions overlap one
+    # input position at stride < window.
+    acc = jnp.zeros((B, Hp, Wp, C), jnp.float32)
+    g32 = g.astype(jnp.float32)
+    dil_h = (Ho - 1) * sh + 1
+    dil_w = (Wo - 1) * sw + 1
+    for a in range(kh):
+        for b in range(kw):
+            k = a * kw + b
+            contrib = jnp.where(arg == jnp.uint8(k), g32, 0.0)
+            # Stride -> interior dilation, window offset -> edge padding:
+            # the masked cotangent lands exactly on the input positions
+            # this shifted slice read.  Pure pad + add, no scatter.
+            placed = lax.pad(
+                contrib, jnp.float32(0),
+                ((0, 0, 0),
+                 (a, Hp - a - dil_h, sh - 1),
+                 (b, Wp - b - dil_w, sw - 1),
+                 (0, 0, 0)),
+            )
+            acc = acc + placed
+    grad = acc[:, plh:plh + H, plw:plw + W, :]
+    return (grad.astype(x_dtype),)
+
+
+_max_pool_p.defvjp(_mp_fwd, _mp_bwd)

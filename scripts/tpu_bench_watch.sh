@@ -244,6 +244,19 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# lm 355M bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/bench_tpu_maxpool.json ]; then
+      # Scatter-free maxpool backward vs the 109.15 ms conv7 headline:
+      # the xprof trace put select_and_scatter at 10.6 ms — the fused
+      # form (pads+adds only, oracle-identical grads incl. ties) targets
+      # most of that.  Positive or null, the delta gets a BASELINE row.
+      echo "# running fused-maxpool bench at $(date +%H:%M:%S)" >&2
+      CMN_BENCH_PROBE_S=60 CMN_BENCH_MAXPOOL=fused CMN_BENCH_BATCH=256 \
+        timeout 1800 python bench.py \
+        >result/bench_tpu_maxpool.json.tmp 2>>result/bench_watch_stderr.log \
+        && ! grep -qE 'unreachable|"failed"' result/bench_tpu_maxpool.json.tmp \
+        && mv result/bench_tpu_maxpool.json.tmp result/bench_tpu_maxpool.json
+      echo "# fused-maxpool bench rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     # Fresh round-4 headline, LAST among the stanzas: never-measured
     # artifacts get the scarce window first; this one re-captures the
     # already-covered conv7 config so the round has its own dated
@@ -279,6 +292,7 @@ print(float((x@x).sum()))
        && [ -s result/bench_tpu_filebacked.json ] \
        && [ -s result/bench_tpu_s2d.json ] \
        && [ -s result/seq2seq_tpu_encflash.json ] \
+       && [ -s result/bench_tpu_maxpool.json ] \
        && [ -s result/bench_tpu_r04.json ]; then
       exit 0
     fi

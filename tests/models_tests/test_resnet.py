@@ -21,6 +21,45 @@ def test_resnet_forward_shapes(devices):
     assert logits.dtype == jnp.float32  # head in fp32
 
 
+def test_resnet_fused_maxpool_matches_xla(devices):
+    # maxpool="fused" (scatter-free backward, the select_and_scatter
+    # replacement) must be forward-IDENTICAL and gradient-equal to the
+    # default through the full model on shared params.
+    # axis_name=None: this is a single-program numerics comparison (the
+    # sync-BN pmean needs a live mesh axis, which opt.update supplies in
+    # the DP tests — irrelevant to the maxpool question).
+    kw = dict(num_classes=4, width=8, axis_name=None, dtype=jnp.float32)
+    base = ResNetTiny(**kw)
+    fused = ResNetTiny(maxpool="fused", **kw)
+    x = np.random.RandomState(0).randn(8, 32, 32, 3).astype(np.float32)
+    y = np.arange(8, dtype=np.int32) % 4
+    variables = base.init(jax.random.PRNGKey(0), x, train=True)
+
+    lb = base.apply(variables, x, train=False)
+    lf = fused.apply(variables, x, train=False)
+    np.testing.assert_array_equal(np.asarray(lb), np.asarray(lf))
+
+    def loss(model, params):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+        )
+        onehot = jax.nn.one_hot(y, 4)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
+
+    gb = jax.grad(lambda p: loss(base, p))(variables["params"])
+    gf = jax.grad(lambda p: loss(fused, p))(variables["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(gb),
+                    jax.tree_util.tree_leaves(gf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="maxpool"):
+        ResNetTiny(maxpool="nope", **kw).init(
+            jax.random.PRNGKey(0), x, train=True
+        )
+
+
 def test_resnet_dp_training_stateful(devices):
     comm = cmn.create_communicator("xla", devices=devices)
     model = ResNetTiny(num_classes=4, width=8, axis_name=comm.axis_name)

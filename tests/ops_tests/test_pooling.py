@@ -1,0 +1,133 @@
+"""max_pool_fused: scatter-free maxpool backward vs the XLA oracle.
+
+The fused op must be forward-identical to ``nn.max_pool`` and
+gradient-identical to its AD (XLA select_and_scatter) — including on
+exact ties, where both pick the FIRST max in row-major window order."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from chainermn_tpu.ops import max_pool_fused
+
+
+CONFIGS = [
+    # (H, W, window, strides, padding) — the ResNet stem config first.
+    (112, 112, (3, 3), (2, 2), "SAME"),
+    (17, 23, (3, 3), (2, 2), "SAME"),
+    (16, 16, (2, 2), (2, 2), "VALID"),
+    (15, 11, (3, 2), (1, 2), "SAME"),
+    (9, 9, (3, 3), (3, 3), "VALID"),
+]
+
+
+def _oracle(x, window, strides, padding):
+    return nn.max_pool(x, window, strides=strides, padding=padding)
+
+
+@pytest.mark.parametrize("H,W,window,strides,padding", CONFIGS)
+def test_forward_matches_nn_max_pool(H, W, window, strides, padding):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, H, W, 5), jnp.float32)
+    got = max_pool_fused(x, window, strides, padding)
+    want = _oracle(x, window, strides, padding)
+    assert got.shape == want.shape
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("H,W,window,strides,padding", CONFIGS)
+def test_grad_matches_xla_select_and_scatter(H, W, window, strides, padding):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, H, W, 5), jnp.float32)
+    ct = jnp.asarray(
+        rng.randn(*_oracle(x, window, strides, padding).shape), jnp.float32
+    )
+
+    def f_fused(x):
+        return jnp.sum(max_pool_fused(x, window, strides, padding) * ct)
+
+    def f_xla(x):
+        return jnp.sum(_oracle(x, window, strides, padding) * ct)
+
+    gf = jax.grad(f_fused)(x)
+    gx = jax.grad(f_xla)(x)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_grad_tie_semantics_first_max_wins():
+    # Constant input: EVERY window position ties.  XLA's select_and_scatter
+    # (GE select) and our running strict-> chain must both credit the
+    # first window position in row-major order.
+    x = jnp.ones((1, 6, 6, 1), jnp.float32)
+    window, strides, padding = (3, 3), (2, 2), "SAME"
+    ct = jnp.asarray(
+        np.random.RandomState(2).randn(1, 3, 3, 1), jnp.float32
+    )
+
+    gf = jax.grad(
+        lambda x: jnp.sum(max_pool_fused(x, window, strides, padding) * ct)
+    )(x)
+    gx = jax.grad(
+        lambda x: jnp.sum(_oracle(x, window, strides, padding) * ct)
+    )(x)
+    np.testing.assert_array_equal(np.asarray(gf), np.asarray(gx))
+
+
+def test_bf16_forward_and_grad_dtype():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 14, 14, 8), jnp.bfloat16)
+    y = max_pool_fused(x)
+    assert y.dtype == jnp.bfloat16
+    g = jax.grad(lambda x: jnp.sum(max_pool_fused(x).astype(jnp.float32)))(x)
+    assert g.dtype == jnp.bfloat16
+    # bf16 values are exactly representable comparisons — forward must
+    # still bit-match the oracle.
+    np.testing.assert_array_equal(
+        np.asarray(y.astype(jnp.float32)),
+        np.asarray(_oracle(x, (3, 3), (2, 2), "SAME").astype(jnp.float32)),
+    )
+
+
+def test_nan_propagates_like_reduce_window():
+    # A NaN anywhere in a window must surface in that window's output
+    # (lax.max semantics) — regardless of its position in the scan order.
+    for pos in [(0, 0), (2, 3), (5, 5)]:
+        x = np.zeros((1, 6, 6, 1), np.float32)
+        x[(0, *pos, 0)] = np.nan
+        x = jnp.asarray(x)
+        got = np.asarray(max_pool_fused(x, (3, 3), (2, 2), "SAME"))
+        want = np.asarray(_oracle(x, (3, 3), (2, 2), "SAME"))
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+
+
+def test_window_larger_than_input_matches_empty_output():
+    x = jnp.ones((1, 2, 2, 1), jnp.float32)
+    got = max_pool_fused(x, (3, 3), (2, 2), "VALID")
+    want = _oracle(x, (3, 3), (2, 2), "VALID")
+    assert got.shape == want.shape == (1, 0, 0, 1)
+    g = jax.grad(
+        lambda x: jnp.sum(max_pool_fused(x, (3, 3), (2, 2), "VALID"))
+    )(x)
+    np.testing.assert_array_equal(np.asarray(g), np.zeros((1, 2, 2, 1)))
+
+
+def test_overlapping_windows_accumulate():
+    # stride < window: one input position can win several windows; its
+    # gradient is the SUM of their cotangents (here x[0,2,2,0] is the
+    # global max and wins all four 3x3/s1 windows covering it).
+    x = np.zeros((1, 5, 5, 1), np.float32)
+    x[0, 2, 2, 0] = 10.0
+    x = jnp.asarray(x)
+    ct = jnp.ones((1, 3, 3, 1), jnp.float32)
+    g = jax.grad(
+        lambda x: jnp.sum(max_pool_fused(x, (3, 3), (1, 1), "VALID") * ct)
+    )(x)
+    assert float(g[0, 2, 2, 0]) == 9.0  # center wins all 9 valid windows
+    gx = jax.grad(
+        lambda x: jnp.sum(_oracle(x, (3, 3), (1, 1), "VALID") * ct)
+    )(x)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(gx))
