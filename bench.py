@@ -484,8 +484,8 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
             f"meaning for CMN_BENCH_ARCH={arch!r} — unset one"
         )
     # CMN_BENCH_MAXPOOL=fused swaps the stem max-pool's backward from
-    # XLA's select_and_scatter (largest non-conv kernel in the headline
-    # trace, 10.6 ms) for the scatter-free ops.max_pool_fused.
+    # XLA's select_and_scatter (largest non-conv kernel in the b512
+    # trace, 10.6 of ~224 ms) for the scatter-free ops.max_pool_fused.
     maxpool = os.environ.get("CMN_BENCH_MAXPOOL", "xla")
     if maxpool not in ("xla", "fused"):
         _fail(f"CMN_BENCH_MAXPOOL={maxpool!r}: expected 'xla' or 'fused'")

@@ -146,8 +146,8 @@ class ResNet(nn.Module):
     and :func:`s2d_stem_kernel` converts trained conv7 weights exactly.
 
     ``maxpool="fused"`` swaps the stem max-pool's backward from XLA's
-    select-and-scatter (the largest non-conv kernel in the headline
-    trace: 10.6 ms of 109.15) for :func:`ops.max_pool_fused`'s
+    select-and-scatter (the largest non-conv kernel in the b512 trace:
+    10.6 ms of ~224, proportionally ~5 ms of the 109.15 ms headline) for :func:`ops.max_pool_fused`'s
     scatter-free shifted-window form — forward bit-identical, gradient
     oracle-identical incl. ties.  Default stays ``"xla"`` until the
     on-chip A/B lands (same measured-decision discipline as the stem).

@@ -1,15 +1,18 @@
 """Max pooling with a hand-written backward pass.
 
-Motivation (round 4): the xprof trace of the ResNet-50 headline step showed
-``select-and-scatter`` — XLA's lowering of max-pool's AD — as the single
-largest non-conv kernel (10.6 ms of the 109.15 ms step, ~10%;
-``BASELINE.md`` b512 row).  Its gather/scatter structure resists fusion.
-This implementation makes the backward pure shifted-window arithmetic:
+Motivation (round 4): the xprof trace captured alongside the ResNet-50
+b512 run showed ``select-and-scatter`` — XLA's lowering of max-pool's
+AD — as the single largest non-conv kernel: 10.6 ms of that trace's
+~224 ms step (~4.7%; proportionally ~5 ms of the 109.15 ms b256
+headline — ``BASELINE.md`` b512 row).  Its gather/scatter structure
+resists fusion.  This implementation makes the backward pure
+shifted-window arithmetic:
 
 - forward: one running max/argmax chain over the ``kh*kw`` shifted slices
   of the padded input (elementwise selects — no materialized
   ``(..., kh*kw)`` stack), saving the winning offset index per window
-  (uint8 residual, 1 byte per output element instead of the full input);
+  (uint8 residual — 1 byte per output element instead of the full
+  input — widened to int32 for windows past 256 offsets);
 - backward: for each window offset, the masked cotangent is placed back
   onto the input grid with an interior-dilated ``lax.pad`` (stride
   becomes dilation) and the ``kh*kw`` placements are summed — pads and
@@ -39,6 +42,13 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _idx_dtype(n_offsets: int):
+    """Smallest residual dtype that can hold every window-offset index —
+    a uint8 at kh*kw > 256 would WRAP and route gradient to two different
+    offsets (double-counted, misplaced) with no error."""
+    return jnp.uint8 if n_offsets <= 256 else jnp.int32
 
 
 def _same_pads(size: int, window: int, stride: int) -> Tuple[int, int]:
@@ -82,6 +92,7 @@ def _fwd_argmax(x, window, strides, pads):
         empty = jnp.zeros((B, Ho, Wo, C), x.dtype)
         return empty, jnp.zeros((B, Ho, Wo, C), jnp.uint8), (Ho, Wo, Hp, Wp)
     is_float = jnp.issubdtype(x.dtype, jnp.floating)
+    idx_dtype = _idx_dtype(kh * kw)
     best = None
     arg = None
     for a in range(kh):          # row-major window order = XLA's scan
@@ -93,7 +104,7 @@ def _fwd_argmax(x, window, strides, pads):
             )
             k = a * kw + b
             if best is None:
-                best, arg = sl, jnp.zeros(sl.shape, jnp.uint8)
+                best, arg = sl, jnp.zeros(sl.shape, idx_dtype)
             else:
                 # Strict > keeps the EARLIER offset on ties (XLA's GE
                 # select order).  NaNs must PROPAGATE like lax.max does
@@ -103,7 +114,7 @@ def _fwd_argmax(x, window, strides, pads):
                 if is_float:
                     take = take | jnp.isnan(sl)
                 best = jnp.where(take, sl, best)
-                arg = jnp.where(take, jnp.uint8(k), arg)
+                arg = jnp.where(take, idx_dtype(k), arg)
     return best, arg, (Ho, Wo, Hp, Wp)
 
 
@@ -149,12 +160,13 @@ def _mp_bwd(window, strides, pads, shape_dtype, arg, g):
     # input position at stride < window.
     acc = jnp.zeros((B, Hp, Wp, C), jnp.float32)
     g32 = g.astype(jnp.float32)
+    idx_dtype = _idx_dtype(kh * kw)
     dil_h = (Ho - 1) * sh + 1
     dil_w = (Wo - 1) * sw + 1
     for a in range(kh):
         for b in range(kw):
             k = a * kw + b
-            contrib = jnp.where(arg == jnp.uint8(k), g32, 0.0)
+            contrib = jnp.where(arg == idx_dtype(k), g32, 0.0)
             # Stride -> interior dilation, window offset -> edge padding:
             # the masked cotangent lands exactly on the input positions
             # this shifted slice read.  Pure pad + add, no scatter.

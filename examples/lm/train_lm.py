@@ -42,6 +42,11 @@ def main():
                     help="rematerialize decoder blocks (jax.checkpoint)")
     ap.add_argument("--zero", action="store_true",
                     help="ZeRO-shard params/grads/optimizer state 1/N")
+    ap.add_argument("--optimizer", default="adamw",
+                    choices=("adamw", "adafactor"),
+                    help="adafactor = factored second moments, the "
+                         "low-memory tier that put 1.5B-param training on "
+                         "one 16 GB chip (result/lm_tpu_1558m.json)")
     ap.add_argument("--warmup", type=int, default=0,
                     help="linear-warmup steps into a cosine decay schedule")
     ap.add_argument("--eval", action="store_true",
@@ -152,7 +157,11 @@ def main():
         if args.warmup
         else args.lr
     )
-    tx = optax.adamw(lr, weight_decay=0.01)
+    tx = (
+        optax.adafactor(lr)
+        if args.optimizer == "adafactor"
+        else optax.adamw(lr, weight_decay=0.01)
+    )
     # Schedules live INSIDE the optax chain (the jitted step), the TPU-native
     # form of the reference examples' ExponentialShift trainer extension.
     opt = (

@@ -246,7 +246,8 @@ print(float((x@x).sum()))
     fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/bench_tpu_maxpool.json ]; then
       # Scatter-free maxpool backward vs the 109.15 ms conv7 headline:
-      # the xprof trace put select_and_scatter at 10.6 ms — the fused
+      # the b512 xprof trace put select_and_scatter at 10.6 of ~224 ms
+      # (proportionally ~5 ms here) — the fused
       # form (pads+adds only, oracle-identical grads incl. ties) targets
       # most of that.  Positive or null, the delta gets a BASELINE row.
       echo "# running fused-maxpool bench at $(date +%H:%M:%S)" >&2

@@ -92,6 +92,25 @@ def test_bf16_forward_and_grad_dtype():
     )
 
 
+def test_wide_window_residual_does_not_wrap():
+    # kh*kw > 256 exceeds uint8: the residual must widen (a wrapped index
+    # would route gradient to TWO offsets).  17x17 = 289 offsets.
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(1, 40, 40, 2), jnp.float32)
+    window, strides, padding = (17, 17), (8, 8), "VALID"
+    ct = jnp.asarray(
+        rng.randn(*_oracle(x, window, strides, padding).shape), jnp.float32
+    )
+    gf = jax.grad(
+        lambda x: jnp.sum(max_pool_fused(x, window, strides, padding) * ct)
+    )(x)
+    gx = jax.grad(
+        lambda x: jnp.sum(_oracle(x, window, strides, padding) * ct)
+    )(x)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                               atol=1e-6, rtol=1e-6)
+
+
 def test_nan_propagates_like_reduce_window():
     # A NaN anywhere in a window must surface in that window's output
     # (lax.max semantics) — regardless of its position in the scan order.
