@@ -46,6 +46,13 @@ def main():
                    help="int8_ef = 4x-compressed gradient wire with error "
                         "feedback (beyond the bf16 --wire-dtype tier)")
     p.add_argument("--checkpoint", default=None)
+    p.add_argument("--stem", default="conv7", choices=("conv7", "s2d"),
+                   help="ResNet input stem: s2d = space-to-depth spelling "
+                        "(exact-equivalent, s2d_stem_kernel migrates "
+                        "conv7 checkpoints)")
+    p.add_argument("--maxpool", default="xla", choices=("xla", "fused"),
+                   help="ResNet stem max-pool backward: fused = the "
+                        "scatter-free ops.max_pool_fused form")
     p.add_argument("--arch", default="resnet50",
                    choices=["resnet50", "resnet18", "vit"])
     p.add_argument("--train-npz", default=None,
@@ -101,6 +108,11 @@ def main():
 
     x0 = np.zeros((8, args.image_size, args.image_size, 3), np.float32)
     if args.arch == "vit":
+        if args.stem != "conv7" or args.maxpool != "xla":
+            raise SystemExit(
+                f"--stem/--maxpool are ResNet knobs; they have no meaning "
+                f"for --arch {args.arch} — unset them"
+            )
         # Stateless (no BN): ViT-S/16 geometry at full size, patch 4 in
         # --smoke so a 32px image still yields an 8x8 token grid.
         model = ViT(num_classes=args.num_classes,
@@ -111,7 +123,8 @@ def main():
         stateful = False
     else:
         arch = ResNet50 if args.arch == "resnet50" else ResNet18
-        model = arch(num_classes=args.num_classes, axis_name=comm.axis_name)
+        model = arch(num_classes=args.num_classes, axis_name=comm.axis_name,
+                     stem=args.stem, maxpool=args.maxpool)
         variables = model.init(jax.random.PRNGKey(0), x0, train=True)
         model_state = variables["batch_stats"]
         loss_fn = resnet_loss(model)
