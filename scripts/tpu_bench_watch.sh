@@ -244,6 +244,16 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# lm 355M bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/decode_tpu_b256.json ]; then
+      # Batch-scaling curve third point (8 -> 64 -> 256): B=64 showed
+      # sublinear scaling (2.25x from 8x batch) — B=256 finds whether
+      # tokens/sec keeps climbing or the step saturates.
+      echo "# running decode B=256 bench at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/decode.py --batch 256 \
+        --out result/decode_tpu_b256.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# decode B=256 rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/bench_tpu_maxpool.json ]; then
       # Scatter-free maxpool backward vs the 109.15 ms conv7 headline:
       # the b512 xprof trace put select_and_scatter at 10.6 of ~224 ms
@@ -294,6 +304,7 @@ print(float((x@x).sum()))
        && [ -s result/bench_tpu_s2d.json ] \
        && [ -s result/seq2seq_tpu_encflash.json ] \
        && [ -s result/bench_tpu_maxpool.json ] \
+       && [ -s result/decode_tpu_b256.json ] \
        && [ -s result/bench_tpu_r04.json ]; then
       exit 0
     fi

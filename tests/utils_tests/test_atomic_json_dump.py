@@ -1,0 +1,46 @@
+"""atomic_json_dump: the artifact-publish primitive every benchmark's
+--out path rides (the watcher gates on file non-emptiness, so a partial
+write must never become a visible artifact)."""
+
+import json
+import os
+
+import pytest
+
+from chainermn_tpu.utils import atomic_json_dump
+
+
+def test_publishes_atomically(tmp_path):
+    path = tmp_path / "a.json"
+    atomic_json_dump({"x": 1}, str(path))
+    assert json.loads(path.read_text()) == {"x": 1}
+    assert not os.path.exists(str(path) + ".tmp")
+
+
+def test_overwrites_existing(tmp_path):
+    path = tmp_path / "a.json"
+    atomic_json_dump({"x": 1}, str(path))
+    atomic_json_dump({"x": 2}, str(path))
+    assert json.loads(path.read_text()) == {"x": 2}
+
+
+def test_failed_dump_leaves_no_artifact_and_no_tmp(tmp_path):
+    path = tmp_path / "a.json"
+
+    class Unserializable:
+        pass
+
+    with pytest.raises(TypeError):
+        atomic_json_dump({"x": Unserializable()}, str(path))
+    assert not path.exists()
+    assert not os.path.exists(str(path) + ".tmp")
+
+
+def test_failed_dump_preserves_prior_artifact(tmp_path):
+    path = tmp_path / "a.json"
+    atomic_json_dump({"good": True}, str(path))
+
+    with pytest.raises(TypeError):
+        atomic_json_dump({"bad": object()}, str(path))
+    # The previous GOOD artifact survives untouched.
+    assert json.loads(path.read_text()) == {"good": True}
