@@ -61,6 +61,10 @@ def main():
     ap.add_argument("--rope", action="store_true",
                     help="rotary positions (required to stream past "
                          "max_len; pairs naturally with --rolling)")
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention: kv heads (0 = classic "
+                         "MHA) — shrinks the KV cache, decode's dominant "
+                         "bandwidth term, by n_heads/kv_heads")
     ap.add_argument("--speculative", type=int, default=0, metavar="K",
                     help="also time speculative decoding with K proposals "
                          "per round from a shallow draft model")
@@ -124,6 +128,7 @@ def main():
         ),
         window=args.window,
         pos_enc="rope" if args.rope else "learned",
+        n_kv_heads=args.kv_heads,
     )
     params = jax.jit(
         lambda r: model.init(
@@ -171,7 +176,7 @@ def main():
         "n_new": args.new,
         "config": {"layers": args.layers, "d_model": args.d_model,
                    "heads": args.heads, "d_ff": args.d_ff,
-                   "vocab": args.vocab},
+                   "vocab": args.vocab, "kv_heads": args.kv_heads},
         "ms_per_gen_step": round(dt / args.iters / steps * 1000.0, 3),
         # Resolved impl tag (ADVICE r3): the model default is "auto" — the
         # PREFILL resolves per-shape; generation steps always run the
@@ -202,6 +207,7 @@ def main():
                 max_len=args.prompt + args.new + k + 1,
                 window=args.window,
                 pos_enc="rope" if args.rope else "learned",
+                n_kv_heads=args.kv_heads,
             )
             dparams = jax.jit(
                 lambda r: draft.init(

@@ -254,6 +254,17 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# decode B=256 rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/decode_tpu_gqa.json ]; then
+      # GQA decode at the B=64 point: kv-heads 2 shrinks the KV cache
+      # (decode's dominant bandwidth term at this batch) 6x vs the 12-head
+      # MHA capture (13,602 tok/s) — measures the inference value of the
+      # n_kv_heads tier on chip.
+      echo "# running decode GQA bench at $(date +%H:%M:%S)" >&2
+      timeout 1800 python benchmarks/decode.py --batch 64 --kv-heads 2 \
+        --out result/decode_tpu_gqa.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# decode GQA rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/bench_tpu_done.json ] && [ ! -s result/bench_tpu_maxpool.json ]; then
       # Scatter-free maxpool backward vs the 109.15 ms conv7 headline:
       # the b512 xprof trace put select_and_scatter at 10.6 of ~224 ms
@@ -305,6 +316,7 @@ print(float((x@x).sum()))
        && [ -s result/seq2seq_tpu_encflash.json ] \
        && [ -s result/bench_tpu_maxpool.json ] \
        && [ -s result/decode_tpu_b256.json ] \
+       && [ -s result/decode_tpu_gqa.json ] \
        && [ -s result/bench_tpu_r04.json ]; then
       exit 0
     fi
