@@ -76,9 +76,33 @@ def main():
                          "forwards cut a well-trained draft approaches — "
                          "rather than a random-weights draft whose "
                          "near-zero acceptance only shows overhead")
+    ap.add_argument("--spec-ks", default=None,
+                    help="comma list of K values to sweep (reuses the one "
+                         "plain-decode timing; e.g. --spec-ks 2,4,8); "
+                         "implies --speculative")
+    ap.add_argument("--draft-mode", default=None,
+                    choices=("self", "random", "distilled"),
+                    help="self = ideal acceptance at FULL draft cost; "
+                         "random = real small-draft cost at ~zero "
+                         "acceptance (overhead floor); distilled = the "
+                         "target's tail blocks are zeroed so its function "
+                         "collapses to its first draft-layers blocks, and "
+                         "exactly those blocks ARE the draft — realistic "
+                         "draft cost with near-ideal acceptance, i.e. the "
+                         "measured wall-clock bound a perfectly distilled "
+                         "draft can reach (VERDICT r4 missing #3)")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    spec_ks = (
+        [int(x) for x in args.spec_ks.split(",")] if args.spec_ks else None
+    )
+    if spec_ks:
+        # max over BOTH sources: model/draft max_len is sized from
+        # args.speculative, and a sweep entry larger than it would crash
+        # the verify-chunk bound mid-run after the plain baseline already
+        # burned chip time.
+        args.speculative = max(args.speculative, *spec_ks)
     if args.rolling and not args.window:
         # Fail at argparse time, not after the full-cache baseline has
         # burned minutes of chip time.
@@ -197,9 +221,10 @@ def main():
         # per sequential step; a k-round accepts 1..k+1 tokens for
         # k draft steps + ONE target forward.
         k = args.speculative
-        if args.draft_self:
+        mode = args.draft_mode or ("self" if args.draft_self else "random")
+        if mode == "self":
             draft, dparams = model, params
-        else:
+        elif mode == "random":
             draft = TransformerLM(
                 vocab=args.vocab,
                 n_layers=args.draft_layers or max(1, args.layers // 4),
@@ -214,41 +239,109 @@ def main():
                     r, jnp.zeros((1, args.prompt), jnp.int32)
                 )
             )(jax.random.PRNGKey(1))["params"]
-        spec = jax.jit(
-            lambda tp, dp, pr: lm_speculative_generate(
-                model, tp, draft, dp, pr, n_new=args.new, k=k
+        else:  # distilled
+            # Zero the residual write-backs (proj, ff2) of every block past
+            # the draft depth: those blocks become exact identities, so the
+            # TARGET's function equals its first `dl` blocks while still
+            # paying full 12-layer compute — and those `dl` blocks + head
+            # ARE the draft.  Greedy acceptance is then near-perfect (only
+            # bf16 verify-vs-step kernel tie-flips differ) at a REAL
+            # dl/layers draft cost: the measured upper bound for a
+            # perfectly distilled draft.  No training needed, nothing
+            # simulated — both programs run at full honest cost.
+            dl = args.draft_layers or max(1, args.layers // 6)
+            params = dict(params)
+            for i in range(dl, args.layers):
+                blk = dict(params[f"block_{i}"])
+                for nm in ("proj", "ff2"):
+                    blk[nm] = jax.tree.map(jnp.zeros_like, blk[nm])
+                params[f"block_{i}"] = blk
+            draft = TransformerLM(
+                vocab=args.vocab, n_layers=dl, d_model=args.d_model,
+                n_heads=args.heads, d_ff=args.d_ff,
+                max_len=args.prompt + args.new + k + 1,
+                window=args.window,
+                pos_enc="rope" if args.rope else "learned",
+                n_kv_heads=args.kv_heads,
             )
-        )
-        toks, fwds = spec(params, dparams, prompt)
-        toks = np.asarray(toks)  # compile + warm, value-synced
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            toks_i, fwds = spec(params, dparams, prompt)
-            _ = np.asarray(toks_i[:1, -1:])
-        spec_dt = time.perf_counter() - t0
-        payload["speculative"] = {
-            "k": k,
-            "draft_layers": draft.n_layers,
-            "draft": "self (ideal acceptance)" if args.draft_self
-                     else "random init (near-zero acceptance: overhead "
-                          "bound only — untrained drafts can't agree)",
-            "tokens_per_sec": round(
-                args.batch * args.new * args.iters / spec_dt, 1
-            ),
-            "speedup_vs_plain": round(dt / spec_dt, 3),
-            "target_forwards": int(fwds),
-            "plain_sequential_steps": args.new,
-            "matches_target_greedy": bool((toks == plain_toks).all()),
-            # Speculative equality with plain greedy holds in EXACT
-            # arithmetic (pinned bitwise by the CPU f32 oracle tests);
-            # on TPU bf16 the (k+1)-token verify chunk and the 1-token
-            # plain step are different XLA kernels whose logits differ by
-            # ~0.04 (measured, 2026-08-01), so near-argmax-ties can flip
-            # and everything after a flip diverges.  Divergence structure
-            # distinguishes that from a logic bug (which diverges
-            # immediately on every row):
-            "greedy_tie_divergence": _divergence_stats(toks, plain_toks),
+            dparams = {
+                f"block_{i}": params[f"block_{i}"] for i in range(dl)
+            }
+            for nm in ("embed", "ln_f", "lm_head"):
+                dparams[nm] = params[nm]
+            if not args.rope:
+                dparams["pos"] = params["pos"]
+            # The zero-tail target is a different function from the
+            # random-init one the plain timing ran (same cost, different
+            # values): regenerate the greedy reference for the equality
+            # check below.
+            plain_toks = np.asarray(jax.jit(
+                lambda p, pr: lm_generate(model, p, pr, args.new)
+            )(params, prompt))
+        draft_labels = {
+            "self": "self (ideal acceptance, full draft cost)",
+            "random": "random init (near-zero acceptance: overhead "
+                      "bound only — untrained drafts can't agree)",
+            "distilled": "zero-tail distillation (realistic "
+                         f"{draft.n_layers}/{args.layers}-layer draft "
+                         "cost, near-ideal acceptance: the bound a "
+                         "perfectly distilled draft reaches)",
         }
+        ks = spec_ks or [k]
+        spec_recs = []
+        for ki in ks:
+            spec = jax.jit(
+                lambda tp, dp, pr, _k=ki: lm_speculative_generate(
+                    model, tp, draft, dp, pr, n_new=args.new, k=_k
+                )
+            )
+            toks, fwds = spec(params, dparams, prompt)
+            toks = np.asarray(toks)  # compile + warm, value-synced
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                toks_i, fwds = spec(params, dparams, prompt)
+                _ = np.asarray(toks_i[:1, -1:])
+            spec_dt = time.perf_counter() - t0
+            spec_recs.append({
+                "k": ki,
+                "draft_layers": draft.n_layers,
+                "draft": draft_labels[mode],
+                # fwds includes the PREFILL forward, which emits 1 token
+                # outside any draft round (lm_speculative_generate doc);
+                # each of the fwds-1 rounds then emits accepted + 1 tokens
+                # (the verify step's own token is free).  Subtracting both
+                # makes the metric exact at every acceptance level: 0.0
+                # for a zero-acceptance draft, k for a perfect one.
+                "tokens_per_target_forward": round(
+                    args.new / int(fwds), 3
+                ),
+                "mean_accepted_per_round": round(
+                    (args.new - 1) / max(int(fwds) - 1, 1) - 1.0, 3
+                ),
+                "tokens_per_sec": round(
+                    args.batch * args.new * args.iters / spec_dt, 1
+                ),
+                "speedup_vs_plain": round(dt / spec_dt, 3),
+                "target_forwards": int(fwds),
+                "plain_sequential_steps": args.new,
+                "matches_target_greedy": bool((toks == plain_toks).all()),
+                # Speculative equality with plain greedy holds in EXACT
+                # arithmetic (pinned bitwise by the CPU f32 oracle tests);
+                # on TPU bf16 the (k+1)-token verify chunk and the 1-token
+                # plain step are different XLA kernels whose logits differ
+                # by ~0.04 (measured, 2026-08-01), so near-argmax-ties can
+                # flip and everything after a flip diverges.  Divergence
+                # structure distinguishes that from a logic bug (which
+                # diverges immediately on every row):
+                "greedy_tie_divergence": _divergence_stats(toks, plain_toks),
+            })
+        # Monomorphic schema: "speculative" stays the single-run OBJECT the
+        # existing artifacts carry (result/decode_spec_tpu.json consumers
+        # keep working); a --spec-ks sweep lands under its own LIST key.
+        if spec_ks:
+            payload["speculative_sweep"] = spec_recs
+        else:
+            payload["speculative"] = spec_recs[0]
     if rolling_dt is not None:
         payload["rolling"] = {
             "tokens_per_sec": round(

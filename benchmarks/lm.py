@@ -85,6 +85,7 @@ def main():
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
         "n_devices": n_dev,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "config": {
             "batch": args.batch, "seq": args.seq, "layers": args.layers,
             "d_model": args.d_model, "heads": args.heads, "d_ff": args.d_ff,

@@ -61,6 +61,23 @@ class _DecoderBlock(nn.Module):
     #: "rope" (this block rotates q/k — the parent adds nothing to ``h``
     #: and passes shared per-step cos/sin ``rope`` tables instead).
     pos_enc: str = "learned"
+    #: number of FFN experts (0 → the classic dense 2-layer FFN).  The
+    #: single-chip counterpart of the EP tier (`parallel.moe.MoELayer` /
+    #: ParallelLM): same capacity-based top-k routing (`_topk_dispatch`),
+    #: but all experts live on this device as one stacked ``(E, ...)``
+    #: weight and the "exchange" is a pair of batched einsums — no
+    #: all_to_all.  ``d_ff`` becomes the PER-EXPERT hidden size (active
+    #: FLOPs per token ≈ a dense FFN of ``moe_k * d_ff``).
+    n_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    #: routing group size: tokens are routed in independent groups of this
+    #: many, each with its own capacity.  The dispatch/combine einsums cost
+    #: O(G²·k·cf·D) per group — per token that is G·cf/(2·d_ff) of the
+    #: expert matmul cost, so small groups keep routing overhead a few
+    #: percent while large groups would dominate (G=2048, d_ff=3072 →
+    #: 42%).  GShard's group dimension, same reasoning.
+    moe_group: int = 512
 
     @nn.compact
     def __call__(self, h, segment_ids=None, cache=None, decode_pos=None,
@@ -236,10 +253,97 @@ class _DecoderBlock(nn.Module):
         o = nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype, name="proj")(a)
         h = h + o
         x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
-        y = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(x)
-        y = nn.Dense(D, dtype=self.dtype, name="ff2")(nn.gelu(y))
+        if self.n_experts:
+            y = self._moe_ffn(x)
+        else:
+            y = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(x)
+            y = nn.Dense(D, dtype=self.dtype, name="ff2")(nn.gelu(y))
         h = h + y
         return (h, new_cache) if cache is not None else h
+
+    def _moe_ffn(self, x):
+        """Single-device mixture-of-experts FFN.
+
+        Routing reuses :func:`~chainermn_tpu.parallel.moe._topk_dispatch`
+        (identical capacity/renormalization semantics to the EP tier, so a
+        model measured here behaves the same routed over an ``expert`` mesh
+        axis), applied per group of ``moe_group`` tokens.  Expert compute is
+        two ``(E, ·, D)x(E, D, F)`` batched einsums — E MXU matmuls per
+        step, no gather/scatter, fully static shapes.
+
+        Sows (collected by ``lm_loss``/``lm_loss_chunked``):
+        ``moe_aux`` — the Switch load-balance loss;
+        ``moe_dropped`` — fraction of (token, choice) routings that lost
+        the capacity race and fell through on the residual.
+        """
+        from chainermn_tpu.parallel.moe import _topk_dispatch
+
+        D, E, F = self.d_model, self.n_experts, self.d_ff
+        B, T = x.shape[0], x.shape[1]
+        N = B * T
+        flat = x.reshape(N, D)
+        # Largest group <= moe_group that divides N keeps shapes static
+        # without padding (all production shapes are powers of two).
+        G = min(self.moe_group, N)
+        while N % G:
+            G -= 1
+        n_groups = N // G
+        C = max(1, math.ceil(
+            self.moe_k * self.moe_capacity_factor * G / E
+        ))
+        router = self.param(
+            "router", nn.initializers.normal(0.02), (D, E), jnp.float32
+        )
+        w1 = self.param(
+            "moe_w1", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, D, F), jnp.float32,
+        )
+        b1 = self.param("moe_b1", nn.initializers.zeros, (E, F), jnp.float32)
+        w2 = self.param(
+            "moe_w2", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, F, D), jnp.float32,
+        )
+        b2 = self.param("moe_b2", nn.initializers.zeros, (E, D), jnp.float32)
+
+        xg = flat.reshape(n_groups, G, D)
+        probs = jax.nn.softmax(
+            (xg.astype(jnp.float32) @ router), axis=-1
+        )  # (g, G, E)
+        dispatch, combine, first = jax.vmap(
+            lambda p: _topk_dispatch(p, C, self.moe_k)
+        )(probs)
+        # Switch load-balance loss, averaged over groups; dropped rate =
+        # routings that lost the capacity race (they fall through on the
+        # residual with weight 0 in `combine`).
+        f_e = jnp.mean(first, axis=1)  # (g, E)
+        p_e = jnp.mean(probs, axis=1)
+        aux = E * jnp.mean(jnp.sum(f_e * p_e, axis=-1))
+        dropped = 1.0 - jnp.sum(dispatch) / (N * self.moe_k)
+        self.sow("intermediates", "moe_aux", aux)
+        self.sow("intermediates", "moe_dropped", dropped)
+
+        # Dispatch einsum in the compute dtype: each (e, c) output slot has
+        # AT MOST ONE nonzero term over n (dispatch is one-hot in (e, c)
+        # per routing), so there is no accumulation to lose — unlike the
+        # EP wire in moe.py, no fp32 pass is needed for exactness.
+        send = jnp.einsum(
+            "gnec,gnd->egcd", dispatch.astype(self.dtype),
+            xg.astype(self.dtype),
+        ).reshape(E, n_groups * C, D)
+        hmid = nn.gelu(
+            jnp.einsum("exd,edf->exf", send, w1.astype(self.dtype))
+            + b1[:, None, :].astype(self.dtype)
+        )
+        out = (
+            jnp.einsum("exf,efd->exd", hmid, w2.astype(self.dtype))
+            + b2[:, None, :].astype(self.dtype)
+        ).reshape(E, n_groups, C, D)
+        # Combine accumulates k expert outputs per token — fp32, as the EP
+        # tier's combine einsum does.
+        y = jnp.einsum(
+            "gnec,egcd->gnd", combine, out.astype(jnp.float32)
+        )
+        return y.reshape(B, T, D).astype(self.dtype)
 
 
 class TransformerLM(nn.Module):
@@ -279,6 +383,17 @@ class TransformerLM(nn.Module):
     #: every block — no table, no length cap beyond memory; packed rows
     #: restart rotation per document exactly like the learned restart).
     pos_enc: str = "learned"
+    #: FFN experts per block (0 → dense FFN).  When set, ``d_ff`` is the
+    #: PER-EXPERT hidden size; active FLOPs per token match a dense FFN of
+    #: ``moe_k * d_ff``.  ``lm_loss``/``lm_loss_chunked`` collect the sown
+    #: load-balance aux loss (weighted ``moe_aux_weight``) and report the
+    #: dropped-routing rate in the step metrics.  See
+    #: :meth:`_DecoderBlock._moe_ffn`.
+    n_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_group: int = 512
+    moe_aux_weight: float = 0.01
 
     @nn.compact
     def __call__(self, tokens, segment_ids=None, return_hidden: bool = False,
@@ -371,7 +486,10 @@ class TransformerLM(nn.Module):
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
                 dtype=self.dtype, attention=self.attention,
                 n_kv_heads=self.n_kv_heads, window=self.window,
-                pos_enc=self.pos_enc, name=f"block_{i}",
+                pos_enc=self.pos_enc, n_experts=self.n_experts,
+                moe_k=self.moe_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_group=self.moe_group, name=f"block_{i}",
             )
             if cache is not None:
                 h, c = blk(h, None, cache[i], decode_pos, rope=rope,
@@ -596,22 +714,50 @@ def lm_generate(
     )
 
 
+def _moe_stats(mutables):
+    """Mean sown ``moe_aux`` / ``moe_dropped`` across blocks (sow stores
+    per-call tuples; one forward → one entry each)."""
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(mutables["intermediates"])
+    aux = [v for k, vs in flat.items() if k[-1] == "moe_aux" for v in vs]
+    drop = [v for k, vs in flat.items() if k[-1] == "moe_dropped" for v in vs]
+    return jnp.mean(jnp.stack(aux)), jnp.mean(jnp.stack(drop))
+
+
 def lm_loss(model: nn.Module):
     """``loss_fn(params, (tokens, targets)) -> (loss, aux)`` for the DP
     optimizer (targets = next tokens, -1 = padding/ignore).  A 3-tuple batch
     ``(tokens, targets, segment_ids)`` trains packed rows (see
-    :func:`~chainermn_tpu.datasets.pack_sequences`)."""
+    :func:`~chainermn_tpu.datasets.pack_sequences`).
+
+    MoE models (``model.n_experts > 0``) add the sown load-balance loss
+    (weighted ``model.moe_aux_weight``) and report ``moe_aux`` /
+    ``moe_dropped`` in the metrics; ``ppl_log`` stays CE-only."""
     import optax
 
     def loss_fn(params, batch):
         tokens, targets, *rest = batch
         seg = rest[0] if rest else None
-        logits = model.apply({"params": params}, tokens, segment_ids=seg)
+        moe = getattr(model, "n_experts", 0)
+        if moe:
+            logits, mut = model.apply(
+                {"params": params}, tokens, segment_ids=seg,
+                mutable=["intermediates"],
+            )
+        else:
+            logits = model.apply({"params": params}, tokens, segment_ids=seg)
         mask = (targets >= 0).astype(jnp.float32)
         safe = jnp.maximum(targets, 0)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
         loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        return loss, {"ppl_log": loss}
+        metrics = {"ppl_log": loss}
+        if moe:
+            aux, dropped = _moe_stats(mut)
+            metrics["moe_aux"] = aux
+            metrics["moe_dropped"] = dropped
+            loss = loss + model.moe_aux_weight * aux
+        return loss, metrics
 
     return loss_fn
 
@@ -627,9 +773,17 @@ def lm_loss_chunked(model: nn.Module, chunk_size: int = 4096):
     def loss_fn(params, batch):
         tokens, targets, *rest = batch
         seg = rest[0] if rest else None
-        hidden = model.apply(
-            {"params": params}, tokens, segment_ids=seg, return_hidden=True
-        )
+        moe = getattr(model, "n_experts", 0)
+        if moe:
+            hidden, mut = model.apply(
+                {"params": params}, tokens, segment_ids=seg,
+                return_hidden=True, mutable=["intermediates"],
+            )
+        else:
+            hidden = model.apply(
+                {"params": params}, tokens, segment_ids=seg,
+                return_hidden=True,
+            )
         head = params["lm_head"]
         # Match nn.Dense(dtype=fp32): inputs cast to fp32 before the matmul
         # (the chunk einsum accumulates fp32 regardless).
@@ -639,7 +793,13 @@ def lm_loss_chunked(model: nn.Module, chunk_size: int = 4096):
         )
         mask = (targets >= 0).astype(jnp.float32)
         loss = jnp.sum(ce) / jnp.maximum(jnp.sum(mask), 1.0)
-        return loss, {"ppl_log": loss}
+        metrics = {"ppl_log": loss}
+        if moe:
+            aux, dropped = _moe_stats(mut)
+            metrics["moe_aux"] = aux
+            metrics["moe_dropped"] = dropped
+            loss = loss + model.moe_aux_weight * aux
+        return loss, metrics
 
     return loss_fn
 

@@ -34,7 +34,13 @@ def _default_attention(q, k, v, causal, segment_ids=None, impl="auto"):
         resolve_attention,
     )
 
-    if resolve_attention(impl, q.shape[1], causal=causal) == "flash":
+    # Segment-masked non-causal rows are an unmeasured category for the
+    # T=196 non-causal crossover (the one related capture — T=512
+    # segment-masked seq2seq — had flash at 0.86x): resolve them with the
+    # conservative causal (T=1024) crossover instead.
+    if resolve_attention(
+        impl, q.shape[1], causal=(causal or segment_ids is not None)
+    ) == "flash":
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids
         )

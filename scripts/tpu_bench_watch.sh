@@ -279,23 +279,46 @@ print(float((x@x).sum()))
         && mv result/bench_tpu_maxpool.json.tmp result/bench_tpu_maxpool.json
       echo "# fused-maxpool bench rc=$? at $(date +%H:%M:%S)" >&2
     fi
-    # Fresh round-4 headline, LAST among the stanzas: never-measured
-    # artifacts get the scarce window first; this one re-captures the
-    # already-covered conv7 config so the round has its own dated
-    # headline and the cached fallback (bench_tpu_done.json) serves the
-    # newest measurement.  Guard rejects BOTH the unreachable and the
-    # deliberate zero-value "failed" payloads (bench.py exits 0 on them)
-    # so a failure record can never clobber the known-good done-artifact.
     if [ -s result/bench_tpu_done.json ] \
-       && [ -s result/seq2seq_tpu_encflash.json ] \
-       && [ ! -s result/bench_tpu_r04.json ]; then
-      echo "# running fresh r4 headline bench at $(date +%H:%M:%S)" >&2
+       && [ ! -s result/decode_spec_draft_tpu.json ]; then
+      # Small-draft speculative decoding (VERDICT r4 missing #3): 2-layer
+      # draft vs the 12-layer target via the zero-tail distillation
+      # construction — realistic 1/6 draft cost at near-ideal acceptance,
+      # k swept 2/4/8.  The wall-clock bound a trained draft can reach;
+      # the r4 self-draft capture (0.53x) was full-cost.
+      echo "# running small-draft speculative decode at $(date +%H:%M:%S)" >&2
+      timeout 2400 python benchmarks/decode.py --spec-ks 2,4,8 \
+        --draft-mode distilled --draft-layers 2 \
+        --out result/decode_spec_draft_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# small-draft spec rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/bench_tpu_done.json ] && [ ! -s result/moe_tpu.json ]; then
+      # MoE vs dense at matched active FLOPs (VERDICT r4 missing #2): the
+      # EP subsystem's first perf artifact — routing overhead + drop-rate
+      # across capacity factors, GPT-2-small trunk, adafactor both arms.
+      echo "# running moe bench at $(date +%H:%M:%S)" >&2
+      timeout 2400 python benchmarks/moe.py --out result/moe_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# moe bench rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    # Fresh round-5 dated headline.  Gated on bench_tpu_done.json ONLY
+    # (ADVICE r4: the old seq2seq_tpu_encflash.json prerequisite could
+    # block this forever if that run persistently fails); its "last
+    # among stanzas" file position already gives never-measured
+    # artifacts the scarce window first.  Guard rejects BOTH the
+    # unreachable and the deliberate zero-value "failed" payloads
+    # (bench.py exits 0 on them) so a failure record can never clobber
+    # the known-good done-artifact.
+    if [ -s result/bench_tpu_done.json ] \
+       && [ ! -s result/bench_tpu_r05.json ]; then
+      echo "# running fresh r5 headline bench at $(date +%H:%M:%S)" >&2
       CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH timeout 1800 python bench.py \
-        >result/bench_tpu_r04.json.tmp 2>>result/bench_watch_stderr.log \
-        && ! grep -qE 'unreachable|"failed"' result/bench_tpu_r04.json.tmp \
-        && mv result/bench_tpu_r04.json.tmp result/bench_tpu_r04.json \
-        && cp result/bench_tpu_r04.json result/bench_tpu_done.json
-      echo "# r4 headline rc=$? at $(date +%H:%M:%S)" >&2
+        >result/bench_tpu_r05.json.tmp 2>>result/bench_watch_stderr.log \
+        && ! grep -qE 'unreachable|"failed"' result/bench_tpu_r05.json.tmp \
+        && mv result/bench_tpu_r05.json.tmp result/bench_tpu_r05.json \
+        && cp result/bench_tpu_r05.json result/bench_tpu_done.json
+      echo "# r5 headline rc=$? at $(date +%H:%M:%S)" >&2
     fi
     if [ -s result/bench_tpu_done.json ] && [ -s result/flash_tpu.json ] \
        && [ -s result/flash_tests_tpu.txt ] \
@@ -317,7 +340,9 @@ print(float((x@x).sum()))
        && [ -s result/bench_tpu_maxpool.json ] \
        && [ -s result/decode_tpu_b256.json ] \
        && [ -s result/decode_tpu_gqa.json ] \
-       && [ -s result/bench_tpu_r04.json ]; then
+       && [ -s result/moe_tpu.json ] \
+       && [ -s result/decode_spec_draft_tpu.json ] \
+       && [ -s result/bench_tpu_r05.json ]; then
       exit 0
     fi
   else

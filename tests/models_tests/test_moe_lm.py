@@ -1,0 +1,132 @@
+"""Single-chip MoE FFN tier (``TransformerLM(n_experts=...)``).
+
+The EP building block's single-device counterpart (SURVEY.md §2.3 EP row —
+the reference shipped only the eager ``alltoall``; `parallel/moe.py` is the
+mesh tier, this is the same `_topk_dispatch` routing run as batched local
+einsums).  Oracle: with every expert holding IDENTICAL weights and ample
+capacity, top-k routing with renormalized gates is exactly the dense FFN —
+whatever the router does, the combine weights sum to 1 over copies of the
+same function.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models import (
+    TransformerLM,
+    lm_loss,
+    lm_loss_chunked,
+)
+
+
+def _toks(B=2, T=32, vocab=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randint(0, vocab, size=(B, T)).astype(np.int32))
+
+
+def _moe_model(E=4, cf=None, dff=48, **kw):
+    # cf=None → ample capacity (C >= G: no routing can ever drop).
+    return TransformerLM(
+        vocab=64, n_layers=2, d_model=32, n_heads=2, d_ff=dff, max_len=32,
+        dtype=jnp.float32, attention="xla", n_experts=E,
+        moe_capacity_factor=(E if cf is None else cf), **kw,
+    )
+
+
+def test_identical_experts_match_dense_ffn():
+    E, dff = 4, 48
+    dense = TransformerLM(vocab=64, n_layers=2, d_model=32, n_heads=2,
+                          d_ff=dff, max_len=32, dtype=jnp.float32,
+                          attention="xla")
+    moe = _moe_model(E=E, dff=dff)
+    toks = _toks()
+    dp = dense.init(jax.random.PRNGKey(0), toks)["params"]
+    mp = moe.init(jax.random.PRNGKey(0), toks)["params"]
+
+    # Same trunk everywhere; every expert := the dense FFN's weights.
+    mp = jax.tree.map(lambda x: x, mp)  # deep copy of the dict structure
+    for i in range(2):
+        blk, dblk = mp[f"block_{i}"], dp[f"block_{i}"]
+        for name in list(blk.keys()):
+            if name.startswith("moe_") or name == "router":
+                continue
+            blk[name] = dblk[name]
+        blk["moe_w1"] = jnp.tile(dblk["ff1"]["kernel"][None], (E, 1, 1))
+        blk["moe_b1"] = jnp.tile(dblk["ff1"]["bias"][None], (E, 1))
+        blk["moe_w2"] = jnp.tile(dblk["ff2"]["kernel"][None], (E, 1, 1))
+        blk["moe_b2"] = jnp.tile(dblk["ff2"]["bias"][None], (E, 1))
+    for name in ("embed", "pos", "ln_f", "lm_head"):
+        mp[name] = dp[name]
+
+    want = dense.apply({"params": dp}, toks)
+    got = moe.apply({"params": mp}, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ample_capacity_never_drops_and_scarce_capacity_drops():
+    toks = _toks()
+    for cf, check in ((None, lambda d: d == 0.0),
+                      (0.25, lambda d: 0.0 < d < 1.0)):
+        model = _moe_model(E=4, cf=cf)
+        params = model.init(jax.random.PRNGKey(1), toks)["params"]
+        loss_fn = lm_loss(model)
+        (loss, metrics) = loss_fn(params, (toks, toks))
+        assert np.isfinite(float(loss))
+        assert "moe_aux" in metrics and "moe_dropped" in metrics
+        dropped = float(metrics["moe_dropped"])
+        assert check(dropped), (cf, dropped)
+        # Switch aux loss is ~1 for balanced routing, >= 1 in general.
+        assert 0.5 < float(metrics["moe_aux"]) < 10.0
+
+
+def test_router_receives_gradient_and_aux_weight_applies():
+    model = _moe_model(E=4)
+    toks = _toks()
+    params = model.init(jax.random.PRNGKey(2), toks)["params"]
+    loss_fn = lm_loss(model)
+    grads = jax.grad(lambda p: loss_fn(p, (toks, toks))[0])(params)
+    gr = grads["block_0"]["router"]
+    assert float(jnp.sum(jnp.abs(gr))) > 0.0
+    ge = grads["block_0"]["moe_w1"]
+    assert float(jnp.sum(jnp.abs(ge))) > 0.0
+
+    # The CE part of the loss is aux-free; total loss = ce + w * aux.
+    loss, metrics = loss_fn(params, (toks, toks))
+    assert float(loss) == pytest.approx(
+        float(metrics["ppl_log"])
+        + model.moe_aux_weight * float(metrics["moe_aux"]),
+        rel=1e-6,
+    )
+
+
+def test_chunked_loss_matches_dense_head_path():
+    model = _moe_model(E=4)
+    toks = _toks()
+    params = model.init(jax.random.PRNGKey(3), toks)["params"]
+    full, mf = lm_loss(model)(params, (toks, toks))
+    chunked, mc = lm_loss_chunked(model, chunk_size=16)(params, (toks, toks))
+    assert float(full) == pytest.approx(float(chunked), rel=1e-5)
+    assert float(mf["moe_dropped"]) == pytest.approx(
+        float(mc["moe_dropped"]), abs=1e-7
+    )
+
+
+def test_moe_decode_prefill_matches_full_forward():
+    model = _moe_model(E=4)
+    toks = _toks(T=8)
+    params = model.init(jax.random.PRNGKey(4), toks)["params"]
+    full = model.apply({"params": params}, toks)
+    cache = model.init_cache(2, 8)
+    got = []
+    for i in range(8):
+        logits, cache = model.apply(
+            {"params": params}, toks[:, i:i + 1], cache=cache, decode_pos=i,
+        )
+        got.append(logits[:, 0])
+    got = jnp.stack(got, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                               atol=2e-5, rtol=2e-5)
