@@ -229,6 +229,12 @@ def _config_matches(prev: dict) -> bool:
             return False  # maxpool probes likewise
         if prev.get("maxpool") not in (None, "xla"):
             return False
+        if os.environ.get("CMN_BENCH_BN", "sync") != "sync" or \
+                os.environ.get("CMN_BENCH_CONV1", "none") != "none":
+            return False  # BN/conv1 roofline probes are their own question
+        if prev.get("bn") not in (None, "sync") or \
+                prev.get("conv1") not in (None, "none"):
+            return False
         arch = os.environ.get("CMN_BENCH_ARCH", "resnet50")
         opt_kind = os.environ.get("CMN_BENCH_OPT", "replicated")
         if arch not in ("resnet50", "vit") or \
@@ -546,6 +552,26 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
             f"CMN_BENCH_MAXPOOL={maxpool!r} is a ResNet knob; it has no "
             f"meaning for CMN_BENCH_ARCH={arch!r} — unset one"
         )
+    # CMN_BENCH_BN=frozen removes the training-BN batch-stats barrier
+    # (stored-stats affine; XLA can fuse the full conv->BN->ReLU chain) —
+    # the roofline-swing arm measuring what that barrier costs the 28.6%
+    # headline.  CMN_BENCH_CONV1=xla|pallas additionally runs the
+    # bottleneck 1x1 convs as fused conv+affine+ReLU passes (FusedConv1x1;
+    # pallas = the custom kernel, xla = its twin — the A/B isolates
+    # forward codegen).
+    bn_mode = os.environ.get("CMN_BENCH_BN", "sync")
+    if bn_mode not in ("sync", "frozen"):
+        _fail(f"CMN_BENCH_BN={bn_mode!r}: expected 'sync' or 'frozen'")
+    conv1 = os.environ.get("CMN_BENCH_CONV1", "none")
+    if conv1 not in ("none", "xla", "pallas"):
+        _fail(
+            f"CMN_BENCH_CONV1={conv1!r}: expected 'none', 'xla' or 'pallas'"
+        )
+    if (bn_mode, conv1) != ("sync", "none") and arch != "resnet50":
+        _fail("CMN_BENCH_BN/CONV1 are ResNet knobs — unset for vit")
+    if conv1 != "none" and bn_mode != "frozen":
+        _fail("CMN_BENCH_CONV1 fusion requires CMN_BENCH_BN=frozen "
+              "(BN folds into the epilogue only with stored stats)")
     if arch == "vit":
         from chainermn_tpu.models import ViT, vit_loss
 
@@ -553,7 +579,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     else:
         model = ResNet50(
             num_classes=1000, axis_name=comm.axis_name, stem=stem,
-            maxpool=maxpool,
+            maxpool=maxpool, bn=bn_mode, conv1=conv1,
         )
     # CMN_BENCH_OPT=zero benchmarks the sharded-state tier (reduce-scatter
     # grads + 1/N opt state + param all-gather) instead of the replicated
@@ -574,7 +600,8 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     # each a round trip over the axon tunnel (observed to stall the bench for
     # 10+ minutes before any compute started). One jitted program = one trip.
     init_model = (
-        model if arch == "vit" else ResNet50(num_classes=1000, stem=stem)
+        model if arch == "vit"
+        else ResNet50(num_classes=1000, stem=stem, bn=bn_mode, conv1=conv1)
     )
 
     @jax.jit
@@ -698,6 +725,15 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         "optimizer": opt_kind,
         "stem": stem if arch == "resnet50" else None,
         "maxpool": maxpool if arch == "resnet50" else None,
+        "bn": bn_mode if arch == "resnet50" else None,
+        "conv1": conv1 if arch == "resnet50" else None,
+        **({"bn_note": (
+            "frozen-BN arms measure STEP TIME only: stored-stats BN from "
+            "random init does not normalize, residual variance doubles "
+            "per block and the loss overflows bf16 (final_loss may be "
+            "non-finite) — IEEE inf/nan cost the same cycles, so the "
+            "throughput A/B vs the sync headline is unaffected"
+        )} if bn_mode == "frozen" else {}),
         "global_batch": global_batch,
         "image_size": image_size,
         "iters": iters,

@@ -40,6 +40,12 @@ def main():
                          "the 'xla' arm the encoder-flash hybrid) — probes "
                          "the segment-masked non-causal encoder category "
                          "separately from the decoder's causal/cross rows")
+    ap.add_argument("--packed", action="store_true",
+                    help="train PACKED rows (datasets.pack_pairs: several "
+                         "pairs per row, per-pair segment isolation) "
+                         "instead of the bucketed/padded tier — non-pad "
+                         "fraction rises from the bucketing 0.87 to the "
+                         "measured packing efficiency (~0.95+)")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
@@ -80,24 +86,54 @@ def main():
                    ("batch", "src_len", "tgt_len", "d_model", "heads",
                     "d_ff", "enc", "dec", "vocab")},
         "enc_attention_override": args.enc_attention,
-        "nonpad_fraction": args.nonpad,
+        "nonpad_fraction": None if args.packed else args.nonpad,
+        "packed": args.packed,
     }
 
     comm = cmn.create_communicator("xla", allreduce_grad_dtype=jnp.bfloat16)
 
-    # Bucketed/padded batch shape with the measured non-pad fraction: the
-    # tail of each row is PAD (id 0), exactly what bucket_batches emits.
     rng = np.random.RandomState(0)
-    def make(lenq):
-        toks = rng.randint(3, args.vocab,
-                           size=(args.batch, lenq)).astype(np.int32)
-        n_real = max(1, int(round(lenq * args.nonpad)))
-        toks[:, n_real:] = PAD
-        return toks
-    batch = comm.shard_batch((make(args.src_len), make(args.tgt_len)))
-    real_tgt_tokens = int(
-        (np.asarray(jax.device_get(batch[1])) != PAD).sum()
-    )
+    if args.packed:
+        # Packed rows: draw sentence pairs from a plausible NMT length
+        # distribution and best-fit pack them (datasets.pack_pairs) until
+        # `batch` rows exist.  Throughput is reported on non-pad target
+        # tokens, so the packing efficiency directly becomes tokens/sec.
+        from chainermn_tpu.datasets import pack_pairs, packing_efficiency
+
+        def draw(mean, cap):
+            L = int(np.clip(rng.normal(mean, 0.25 * mean), 4, cap))
+            return rng.randint(3, args.vocab, size=L).astype(np.int32)
+
+        pairs = []
+        while True:
+            pairs.extend(
+                (draw(0.4 * args.src_len, args.src_len),
+                 draw(0.4 * args.tgt_len, args.tgt_len))
+                for _ in range(args.batch * 2)
+            )
+            src, tgt, sseg, tseg = pack_pairs(
+                pairs, args.src_len, args.tgt_len
+            )
+            if src.shape[0] >= args.batch:
+                break
+        src, tgt = src[:args.batch], tgt[:args.batch]
+        sseg, tseg = sseg[:args.batch], tseg[:args.batch]
+        out["packing_efficiency"] = round(packing_efficiency(tseg), 4)
+        batch = comm.shard_batch((src, tgt, sseg, tseg))
+        real_tgt_tokens = int((tseg != 0).sum())
+    else:
+        # Bucketed/padded batch shape with the measured non-pad fraction:
+        # the tail of each row is PAD (id 0), what bucket_batches emits.
+        def make(lenq):
+            toks = rng.randint(3, args.vocab,
+                               size=(args.batch, lenq)).astype(np.int32)
+            n_real = max(1, int(round(lenq * args.nonpad)))
+            toks[:, n_real:] = PAD
+            return toks
+        batch = comm.shard_batch((make(args.src_len), make(args.tgt_len)))
+        real_tgt_tokens = int(
+            (np.asarray(jax.device_get(batch[1])) != PAD).sum()
+        )
 
     for impl in ("flash", "xla"):
         if args.enc_attention == impl:

@@ -208,6 +208,7 @@ def make_synthetic_classification(
     return ArrayDataset(x, y)
 
 from chainermn_tpu.datasets.packing import (  # noqa: E402
+    pack_pairs,
     pack_sequences,
     packing_efficiency,
 )

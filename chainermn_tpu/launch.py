@@ -141,6 +141,7 @@ def supervise(
     backoff_s: float = 1.0,
     grace_s: float = 10.0,
     env_extra: dict = None,
+    restart_nproc: int = None,
 ) -> int:
     """Run the job, relaunching it up to ``restarts`` times on failure.
 
@@ -149,16 +150,28 @@ def supervise(
     ``maybe_load`` the latest complete checkpoint and continue.  With a
     checkpointing training script this turns a transient failure into a
     self-healing run without an external scheduler.  Each attempt gets
-    fresh coordinator/object-plane ports (``launch`` allocates per call)."""
+    fresh coordinator/object-plane ports (``launch`` allocates per call).
+
+    ``restart_nproc`` makes the recovery ELASTIC — beyond the reference's
+    fixed-world restart: relaunch attempts run at a DIFFERENT world size
+    (fewer processes after losing hosts, more after regaining them), and
+    ranks resume through ``maybe_load_elastic``, which reshards the
+    checkpoint to whatever world answers.  Every attempt exports
+    ``CMN_LAUNCH_ATTEMPT`` so scripts can tell a fresh start from a
+    supervised relaunch."""
     attempt = 0
     while True:
-        rc = launch(nproc, argv, grace_s=grace_s, env_extra=env_extra)
+        n = nproc if attempt == 0 else (restart_nproc or nproc)
+        env = dict(env_extra or {})
+        env["CMN_LAUNCH_ATTEMPT"] = str(attempt)
+        rc = launch(n, argv, grace_s=grace_s, env_extra=env)
         if rc == 0 or attempt >= restarts:
             return rc
         attempt += 1
         sys.stderr.write(
             f"[chainermn_tpu.launch] job failed (rc={rc}); "
-            f"restart {attempt}/{restarts} in {backoff_s:.1f}s\n"
+            f"restart {attempt}/{restarts} "
+            f"(n={restart_nproc or nproc}) in {backoff_s:.1f}s\n"
         )
         time.sleep(backoff_s)
 
@@ -177,6 +190,10 @@ def main():
                          "checkpointer's latest complete snapshot)")
     ap.add_argument("--restart-backoff", type=float, default=1.0,
                     help="seconds to wait before a relaunch")
+    ap.add_argument("--restart-nproc", type=int, default=None,
+                    help="world size for RELAUNCH attempts (elastic "
+                         "restart: resume the checkpoint at a different "
+                         "process count via maybe_load_elastic)")
     ap.add_argument("script", help="python script to run on every rank")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args()
@@ -184,6 +201,7 @@ def main():
         supervise(
             ns.nproc, [ns.script] + ns.args, restarts=ns.restarts,
             backoff_s=ns.restart_backoff, grace_s=ns.grace,
+            restart_nproc=ns.restart_nproc,
         )
     )
 

@@ -27,15 +27,71 @@ import numpy as np
 from chainermn_tpu.links.batch_normalization import MultiNodeBatchNormalization
 
 
+class FusedConv1x1(nn.Module):
+    """1×1 conv + frozen-BN affine (+ ReLU) through
+    :func:`chainermn_tpu.ops.conv_fused.conv1x1_bn_relu` — one MXU pass,
+    fp32 accumulation, epilogue on the accumulator.  ``impl="pallas"`` is
+    the custom kernel, ``"xla"`` the twin with identical math and backward
+    (the roofline-swing A/B: forward codegen is the only delta)."""
+
+    features: int
+    relu: bool = True
+    strides: Tuple[int, int] = (1, 1)
+    impl: str = "xla"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from chainermn_tpu.ops.conv_fused import conv1x1_bn_relu
+
+        cin = x.shape[-1]
+        w = self.param(
+            "kernel", nn.initializers.he_normal(), (cin, self.features),
+            jnp.float32,
+        )
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        return conv1x1_bn_relu(
+            x.astype(self.dtype), w.astype(self.dtype), scale, bias,
+            relu=self.relu, strides=self.strides, impl=self.impl,
+        )
+
+
 class BottleneckBlock(nn.Module):
     features: int
     strides: Tuple[int, int] = (1, 1)
     dtype: Any = jnp.bfloat16
     axis_name: Any = None
     norm_momentum: float = 0.9
+    #: "sync" — training-mode (sync-)BN, the headline config.  "frozen" —
+    #: stored-stats BN even in training (a pure per-channel affine: no
+    #: batch-stats reduction barrier, so XLA can fuse the whole
+    #: conv→BN→ReLU chain; the roofline-swing arm measuring what that
+    #: barrier costs).
+    bn: str = "sync"
+    #: "none" — nn.Conv everywhere.  "xla"/"pallas" — the block's 1×1
+    #: convs (reduce, expand, projection) run as fused conv+affine+ReLU
+    #: passes (:class:`FusedConv1x1`, frozen-BN semantics; requires
+    #: ``bn="frozen"``), impl selecting the Pallas kernel or its XLA twin.
+    conv1: str = "none"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.bn not in ("sync", "frozen"):
+            raise ValueError(f"bn={self.bn!r}: expected 'sync' or 'frozen'")
+        if self.conv1 not in ("none", "xla", "pallas"):
+            raise ValueError(
+                f"conv1={self.conv1!r}: expected 'none', 'xla' or 'pallas'"
+            )
+        if self.conv1 != "none" and self.bn != "frozen":
+            raise ValueError(
+                "conv1 fusion folds BN into an affine epilogue — training-"
+                "mode batch stats cannot be fused across (set bn='frozen')"
+            )
         conv = partial(
             nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
             kernel_init=nn.initializers.he_normal(),
@@ -44,9 +100,21 @@ class BottleneckBlock(nn.Module):
             MultiNodeBatchNormalization,
             axis_name=self.axis_name,
             momentum=self.norm_momentum,
-            use_running_average=not train,
+            use_running_average=(not train) or self.bn == "frozen",
         )
         residual = x
+        if self.conv1 != "none":
+            fused = partial(FusedConv1x1, impl=self.conv1, dtype=self.dtype)
+            y = fused(self.features, relu=True, name="fc1")(x)
+            y = conv(self.features, (3, 3), strides=self.strides)(y)
+            y = nn.relu(norm(self.features)(y))
+            y = fused(self.features * 4, relu=False, name="fc3")(y)
+            if residual.shape != y.shape:
+                residual = fused(
+                    self.features * 4, relu=False, strides=self.strides,
+                    name="proj_f",
+                )(residual)
+            return nn.relu(y + residual.astype(y.dtype))
         y = conv(self.features, (1, 1))(x)
         y = nn.relu(norm(self.features)(y))
         y = conv(self.features, (3, 3), strides=self.strides)(y)
@@ -68,9 +136,16 @@ class BasicBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     axis_name: Any = None
     norm_momentum: float = 0.9
+    bn: str = "sync"  # see BottleneckBlock
+    conv1: str = "none"  # no 1x1 main-path convs here: must stay "none"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.conv1 != "none":
+            raise ValueError(
+                "conv1 fusion targets the bottleneck block's 1x1 convs; "
+                "BasicBlock has none"
+            )
         conv = partial(
             nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
             kernel_init=nn.initializers.he_normal(),
@@ -79,7 +154,7 @@ class BasicBlock(nn.Module):
             MultiNodeBatchNormalization,
             axis_name=self.axis_name,
             momentum=self.norm_momentum,
-            use_running_average=not train,
+            use_running_average=(not train) or self.bn == "frozen",
         )
         residual = x
         y = conv(self.features, (3, 3), strides=self.strides)(x)
@@ -161,6 +236,14 @@ class ResNet(nn.Module):
     block: Callable = BottleneckBlock
     stem: str = "conv7"
     maxpool: str = "xla"
+    #: BN mode for every block and the stem BN: "sync" (training-mode
+    #: batch stats — the headline) or "frozen" (stored-stats affine even
+    #: in training; the roofline-swing arm that removes the stats
+    #: barrier).  See :class:`BottleneckBlock`.
+    bn: str = "sync"
+    #: 1x1-conv fusion mode for bottleneck blocks ("none"/"xla"/"pallas";
+    #: non-none requires ``bn="frozen"``).  See :class:`FusedConv1x1`.
+    conv1: str = "none"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -185,7 +268,8 @@ class ResNet(nn.Module):
         x = nn.relu(
             MultiNodeBatchNormalization(
                 self.width, axis_name=self.axis_name,
-                use_running_average=not train, name="bn_init",
+                use_running_average=(not train) or self.bn == "frozen",
+                name="bn_init",
             )(x)
         )
         if self.maxpool == "fused":
@@ -206,6 +290,8 @@ class ResNet(nn.Module):
                     strides=strides,
                     dtype=self.dtype,
                     axis_name=self.axis_name,
+                    bn=self.bn,
+                    conv1=self.conv1,
                 )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,

@@ -125,32 +125,46 @@ class _DecBlock(nn.Module):
     attention: str
 
     @nn.compact
-    def __call__(self, h, enc, src_seg):
+    def __call__(self, h, enc, src_seg, tgt_seg=None):
         from chainermn_tpu.ops import flash_attention, reference_attention
 
         D, H = self.d_model, self.n_heads
         B, Tt = h.shape[:2]
-        # Causal self-attention (target padding sits at the tail, so causal
-        # masking already keeps real positions clean of it).
+        # Causal self-attention.  Unpacked rows (tgt_seg None): target
+        # padding sits at the tail, so causal masking already keeps real
+        # positions clean of it.  Packed rows: segment masking ADDITIONALLY
+        # isolates each target sentence (same causal+segment combination
+        # the LM's packed path runs).
         x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
         qkv = nn.DenseGeneral((3, H, D // H), dtype=self.dtype, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if _use_flash(self.attention, Tt):
             b = _pow2_block(Tt)
-            a = flash_attention(q, k, v, causal=True, block_q=b, block_k=b)
+            a = flash_attention(q, k, v, causal=True, segment_ids=tgt_seg,
+                                block_q=b, block_k=b)
         else:
-            a = reference_attention(q, k, v, True).astype(q.dtype)
+            a = reference_attention(
+                q, k, v, True, segment_ids=tgt_seg
+            ).astype(q.dtype)
         h = h + nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype,
                                 name="self_proj")(a)
-        # Cross-attention over the encoder memory: every target position
-        # (segment 1) attends exactly the REAL source keys (src_seg == 1;
-        # pads carry 0) — the kernel's q-len != kv-len path.
+        # Cross-attention over the encoder memory: unpacked, every target
+        # position (segment 1) attends exactly the REAL source keys
+        # (src_seg == 1; pads carry 0) — the kernel's q-len != kv-len
+        # path.  Packed, target pair j attends exactly source pair j
+        # (segment-id equality); pad queries either match the source pad
+        # tail (harmless: only pad queries ever attend those outputs) or
+        # match nothing, where the kernel's fully-masked-row contract
+        # emits zeros.
         x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
         cq = nn.DenseGeneral((H, D // H), dtype=self.dtype, name="cross_q")(x)
         ckv = nn.DenseGeneral((2, H, D // H), dtype=self.dtype,
                               name="cross_kv")(enc)
         ck, cv = ckv[:, :, 0], ckv[:, :, 1]
-        q_seg = jnp.ones((B, Tt), jnp.int32)
+        q_seg = (
+            tgt_seg if tgt_seg is not None
+            else jnp.ones((B, Tt), jnp.int32)
+        )
         if _use_flash(self.attention, Tt, enc.shape[1]):
             a = flash_attention(
                 cq, ck, cv, segment_ids=q_seg, kv_segment_ids=src_seg,
@@ -195,8 +209,19 @@ class TransformerSeq2Seq(nn.Module):
     enc_attention: Optional[str] = None
 
     @nn.compact
-    def __call__(self, src, tgt_in):
+    def __call__(self, src, tgt_in, src_seg=None, tgt_seg=None):
+        """Unpacked (default): one pair per row, ``src_seg`` derived from
+        PAD, positions ``0..T``.  Packed (:func:`~chainermn_tpu.datasets.
+        pack_pairs` — pass BOTH ``src_seg`` and ``tgt_seg``): several pairs
+        per row, attention isolated per pair on every path (encoder self,
+        decoder causal self, cross by segment equality) and positions
+        restarting per pair — a packed pair computes exactly what it would
+        alone (oracle-pinned)."""
         D = self.d_model
+        if (src_seg is None) != (tgt_seg is None):
+            raise ValueError(
+                "packed rows need BOTH src_seg and tgt_seg (got one)"
+            )
         if self.attention not in ("flash", "xla", "auto"):
             raise ValueError(
                 f"attention={self.attention!r}: expected 'flash', 'xla' "
@@ -223,9 +248,18 @@ class TransformerSeq2Seq(nn.Module):
             "pos", nn.initializers.normal(0.02), (self.max_len, D),
             jnp.float32,
         )
-        src_seg = (src != PAD).astype(jnp.int32)  # real=1, pad=0
+        packed = src_seg is not None
+        if not packed:
+            src_seg = (src != PAD).astype(jnp.int32)  # real=1, pad=0
         h = nn.Embed(self.vocab_src, D, dtype=self.dtype, name="embed_src")(src)
-        h = h + pos[None, :Ts].astype(self.dtype)
+        if packed:
+            # Per-pair position restart on both sides, so a packed pair
+            # sees the same positional signal it would alone.
+            from chainermn_tpu.models.transformer import segment_positions
+
+            h = h + pos[segment_positions(src_seg)].astype(self.dtype)
+        else:
+            h = h + pos[None, :Ts].astype(self.dtype)
         for i in range(self.n_enc):
             h = _EncBlock(
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
@@ -237,27 +271,52 @@ class TransformerSeq2Seq(nn.Module):
 
         t = nn.Embed(self.vocab_tgt, D, dtype=self.dtype,
                      name="embed_tgt")(tgt_in)
-        t = t + pos[None, :Tt].astype(self.dtype)
+        if packed:
+            t = t + pos[segment_positions(tgt_seg)].astype(self.dtype)
+        else:
+            t = t + pos[None, :Tt].astype(self.dtype)
         for i in range(self.n_dec):
             t = _DecBlock(
                 d_model=D, n_heads=self.n_heads, d_ff=self.d_ff,
                 dtype=self.dtype, attention=self.attention,
                 name=f"dec_{i}",
-            )(t, enc, src_seg)
+            )(t, enc, src_seg, tgt_seg)
         t = nn.LayerNorm(dtype=self.dtype, name="ln_dec")(t)
         return nn.Dense(self.vocab_tgt, dtype=jnp.float32, name="proj")(t)
 
 
 def seq2seq_loss(model: nn.Module):
     """Masked token-level cross entropy.  ``batch = (src, tgt)``, both
-    PAD-padded; decoder input is BOS + tgt[:-1]."""
+    PAD-padded; decoder input is BOS + tgt[:-1].  A 4-tuple batch
+    ``(src, tgt, src_seg, tgt_seg)`` (from :func:`~chainermn_tpu.datasets.
+    pack_pairs`) trains PACKED rows: each pair's first decoder input is
+    BOS (not the previous pair's last token), the mask is segment-derived,
+    and the model isolates attention per pair."""
 
     def loss_fn(params, batch):
-        src, tgt = batch
+        src, tgt, *segs = batch
         bos = jnp.full((tgt.shape[0], 1), BOS, tgt.dtype)
-        tgt_in = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
-        logits = model.apply({"params": params}, src, tgt_in)
-        mask = (tgt != PAD).astype(jnp.float32)
+        shifted = jnp.concatenate([bos, tgt[:, :-1]], axis=1)
+        if segs:
+            src_seg, tgt_seg = segs
+            # Segment starts (incl. position 0) get BOS: pair j's decoder
+            # never sees pair j-1's final token.
+            is_start = jnp.concatenate(
+                [
+                    jnp.ones((tgt.shape[0], 1), bool),
+                    tgt_seg[:, 1:] != tgt_seg[:, :-1],
+                ],
+                axis=1,
+            )
+            tgt_in = jnp.where(is_start, BOS, shifted)
+            logits = model.apply(
+                {"params": params}, src, tgt_in, src_seg, tgt_seg
+            )
+            mask = (tgt_seg != 0).astype(jnp.float32)
+        else:
+            tgt_in = shifted
+            logits = model.apply({"params": params}, src, tgt_in)
+            mask = (tgt != PAD).astype(jnp.float32)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
         loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
         correct = ((jnp.argmax(logits, -1) == tgt) * mask).sum()

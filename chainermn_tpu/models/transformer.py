@@ -36,6 +36,24 @@ from chainermn_tpu.parallel.moe import MoELayer
 from chainermn_tpu.parallel.ring_attention import ring_self_attention
 
 
+def segment_positions(segment_ids: jax.Array) -> jax.Array:
+    """Per-document position restart for packed rows: contiguous segments,
+    so each token's offset is its index minus its segment's start (cummax
+    of boundary indices).  Shared by the LM (learned table gather / RoPE
+    rotation) and the seq2seq family's packed-pair path."""
+    B, T = segment_ids.shape
+    idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    is_new = jnp.concatenate(
+        [
+            jnp.ones((B, 1), bool),
+            segment_ids[:, 1:] != segment_ids[:, :-1],
+        ],
+        axis=1,
+    )
+    starts = lax.cummax(jnp.where(is_new, idx, 0), axis=1)
+    return idx - starts  # (B, T)
+
+
 # =====================================================================
 # Flax tier (single-chip / DP)
 # =====================================================================
@@ -420,20 +438,10 @@ class TransformerLM(nn.Module):
         h = nn.Embed(self.vocab, D, dtype=self.dtype, name="embed")(tokens)
         positions = None
         if segment_ids is not None and cache is None:
-            # Per-document position restart: contiguous segments, so each
-            # token's offset is its index minus its segment's start (cummax
-            # of boundary indices).  Shared by both schemes: the learned
-            # table gathers at these positions, RoPE rotates by them.
-            idx = jnp.arange(T, dtype=jnp.int32)[None, :]
-            is_new = jnp.concatenate(
-                [
-                    jnp.ones((B, 1), bool),
-                    segment_ids[:, 1:] != segment_ids[:, :-1],
-                ],
-                axis=1,
-            )
-            starts = lax.cummax(jnp.where(is_new, idx, 0), axis=1)
-            positions = idx - starts  # (B, T)
+            # Per-document position restart (shared helper; both schemes:
+            # the learned table gathers at these positions, RoPE rotates
+            # by them).
+            positions = segment_positions(segment_ids)
         if self.pos_enc == "learned":
             pos = self.param(
                 "pos", nn.initializers.normal(0.02), (self.max_len, D),

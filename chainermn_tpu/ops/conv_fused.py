@@ -1,0 +1,149 @@
+"""Fused 1×1-conv + per-channel affine (+ ReLU) — the ResNet roofline swing.
+
+The roofline (``result/roofline_resnet50.json``) puts the 56² stage's 1×1
+convs bandwidth-bound: each conv → BN → ReLU chain re-touches the big
+``(B, 56, 56, C)`` activation in HBM wherever XLA's fusion stops.  A 1×1
+conv over NHWC is exactly a ``(B·H·W, Cin) @ (Cin, Cout)`` matmul, so the
+whole chain is one MXU pass with an epilogue — this module is that pass as
+a Pallas kernel (fp32 accumulation, affine + ReLU applied on the
+accumulator before the single bf16 writeback), plus an XLA twin with the
+SAME custom-VJP backward so an A/B between the two isolates forward
+codegen only.
+
+The affine is frozen-BN semantics: training-mode sync-BN needs batch
+statistics of the conv output before it can normalize (a reduction barrier
+no kernel fusion can cross), so the fused form exists for the
+``bn="frozen"`` experiment arm (BN as stored-stats affine — what the
+``CMN_BENCH_BN=frozen`` capture measures the headline against).
+
+Reference anchor: SURVEY.md §6 (ResNet-50 is the reference's headline
+benchmark; its CUDA stack leaned on cuDNN's fused conv+BN+ReLU inference
+paths the same way).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from chainermn_tpu.ops.flash_attention import _use_interpret, _vma_union
+
+
+def _pick_block(n: int, cap: int) -> int:
+    b = cap
+    while b > 1 and n % b:
+        b //= 2
+    return b
+
+
+def _fused_kernel(x_ref, w_ref, s_ref, b_ref, o_ref, *, relu):
+    acc = jnp.dot(
+        x_ref[...], w_ref[...], preferred_element_type=jnp.float32
+    )
+    y = acc * s_ref[...] + b_ref[...]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _matmul_affine_fwd_pallas(x2d, w, scale, bias, relu):
+    if _use_interpret() and _vma_union(x2d, w, scale, bias):
+        # Interpret-mode Pallas cannot be traced through shard_map's vma
+        # checker (its grid loop types block-buffer carries per operand
+        # and rejects the mix of varying activations with an invariant
+        # output init — same JAX interpreter limitation flash_attention
+        # documents).  Off-TPU inside a checked shard_map, compute the
+        # mathematically identical XLA form; the compiled TPU kernel is
+        # unaffected (opaque to the checker).
+        return _matmul_affine_fwd_xla(x2d, w, scale, bias, relu)
+    N, K = x2d.shape
+    Cout = w.shape[1]
+    bm = _pick_block(N, 512)
+    bn = _pick_block(Cout, 256)
+    return pl.pallas_call(
+        partial(_fused_kernel, relu=relu),
+        grid=(N // bm, Cout // bn),
+        in_specs=[
+            pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        # Inside a check_vma=True shard_map (the bench's SPMD step) the
+        # output must declare how it varies over the mesh — the union of
+        # the inputs' vma types, same contract as the flash kernels.
+        out_shape=jax.ShapeDtypeStruct(
+            (N, Cout), x2d.dtype, vma=_vma_union(x2d, w, scale, bias)
+        ),
+        interpret=_use_interpret(),
+    )(x2d, w, scale[None], bias[None])
+
+
+def _matmul_affine_fwd_xla(x2d, w, scale, bias, relu):
+    acc = jnp.dot(x2d, w, preferred_element_type=jnp.float32)
+    y = acc * scale[None] + bias[None]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x2d.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def matmul_affine(x2d, w, scale, bias, relu: bool = True,
+                  impl: str = "pallas"):
+    """``relu?((x2d @ w) * scale + bias)`` with fp32 accumulation.
+
+    ``x2d`` (N, Cin) in the compute dtype, ``w`` (Cin, Cout) same,
+    ``scale``/``bias`` (Cout,) fp32.  ``impl``: "pallas" (one fused MXU
+    pass) or "xla" (the twin — identical math and backward, XLA codegen).
+    """
+    fwd = (_matmul_affine_fwd_pallas if impl == "pallas"
+           else _matmul_affine_fwd_xla)
+    return fwd(x2d, w, scale, bias, relu)
+
+
+def _ma_fwd(x2d, w, scale, bias, relu, impl):
+    fwd = (_matmul_affine_fwd_pallas if impl == "pallas"
+           else _matmul_affine_fwd_xla)
+    out = fwd(x2d, w, scale, bias, relu)
+    return out, (x2d, w, scale, out)
+
+
+def _ma_bwd(relu, impl, res, g):
+    # Shared backward for BOTH impls (the A/B isolates forward codegen):
+    # plain XLA matmuls; `acc` rematerialized for dscale rather than saved
+    # (saving the fp32 (N, Cout) accumulator would defeat the memory point).
+    x2d, w, scale, out = res
+    g = g.astype(jnp.float32)
+    if relu:
+        g = g * (out > 0)
+    dacc = (g * scale[None]).astype(x2d.dtype)
+    dx = jnp.dot(dacc, w.T)
+    dw = jnp.dot(x2d.T, dacc)
+    acc = jnp.dot(x2d, w, preferred_element_type=jnp.float32)
+    dscale = jnp.sum(g * acc, axis=0)
+    dbias = jnp.sum(g, axis=0)
+    return dx, dw.astype(w.dtype), dscale, dbias
+
+
+matmul_affine.defvjp(_ma_fwd, _ma_bwd)
+
+
+def conv1x1_bn_relu(x, w, scale, bias, *, relu=True, strides=(1, 1),
+                    impl="pallas"):
+    """NHWC 1×1 conv + frozen-BN affine (+ ReLU) as one fused pass.
+
+    ``x`` (B, H, W, Cin); ``w`` (Cin, Cout); ``scale``/``bias`` (Cout,).
+    A strided 1×1 conv reads only the kept pixels, so ``strides`` is a
+    subsample BEFORE the matmul (bytes drop with it, exactly like the
+    conv)."""
+    if strides != (1, 1):
+        x = x[:, ::strides[0], ::strides[1], :]
+    B, H, W, Cin = x.shape
+    out = matmul_affine(
+        x.reshape(B * H * W, Cin), w, scale, bias, relu, impl
+    )
+    return out.reshape(B, H, W, -1)

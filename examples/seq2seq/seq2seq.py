@@ -26,6 +26,13 @@ def main():
     p.add_argument("--arch", default="lstm", choices=["lstm", "transformer"],
                    help="lstm = reference-parity encoder-decoder; "
                         "transformer = flash cross-attention tier")
+    p.add_argument("--packed", action="store_true",
+                   help="pack several pairs per fixed-shape row "
+                        "(datasets.pack_pairs; transformer arch only) "
+                        "instead of bucketing — trades the bucketed tier's "
+                        "pad waste for per-pair segment isolation")
+    p.add_argument("--pack-len", type=int, default=64,
+                   help="row width (both sides) for --packed")
     p.add_argument("--data-npz", default=None,
                    help="on-disk corpus in save_translation_npz's offsets "
                         "format (the reference's WMT file role); the last "
@@ -80,14 +87,43 @@ def main():
         pairs = make_synthetic_translation(4096, vocab=args.vocab, min_len=4,
                                            max_len=16)
         val_pairs = None
-    batches = bucket_batches(pairs, args.batchsize,
-                             bucket_width=args.bucket_width)
-    if jax.process_index() == 0:
-        nonpad = float(np.mean([(b[0] != 0).mean() for b in batches]))
-        print(f"devices: {comm.size}  buckets: {len(batches)} batches  "
-              f"non-pad fraction: {nonpad:.2f}")
+    if args.packed:
+        if args.arch != "transformer":
+            raise SystemExit("--packed needs --arch transformer (the LSTM "
+                             "tier has no segment-isolated attention)")
+        from chainermn_tpu.datasets import pack_pairs, packing_efficiency
 
-    src0, tgt0 = batches[0]
+        src, tgt, sseg, tseg = pack_pairs(pairs, args.pack_len,
+                                          args.pack_len)
+        # Efficiency BEFORE the batch-rounding pad rows below — those are
+        # a row-count artifact, not pack_pairs quality.
+        eff = packing_efficiency(tseg)
+        # Pad the ROW count to full batches (zero rows are all-pad: seg 0,
+        # masked out of the loss) so every pair trains under ONE compiled
+        # shape — the packing analog of bucket_batches' keep_tail.
+        B = args.batchsize
+        n_rows = ((len(src) + B - 1) // B) * B
+        pad_rows = n_rows - len(src)
+        src, tgt, sseg, tseg = (
+            np.concatenate([a, np.zeros((pad_rows, a.shape[1]), a.dtype)])
+            for a in (src, tgt, sseg, tseg)
+        )
+        batches = [
+            (src[i:i + B], tgt[i:i + B], sseg[i:i + B], tseg[i:i + B])
+            for i in range(0, n_rows, B)
+        ]
+        if jax.process_index() == 0:
+            print(f"devices: {comm.size}  packed: {len(batches)} batches  "
+                  f"packing efficiency: {eff:.2f}")
+    else:
+        batches = bucket_batches(pairs, args.batchsize,
+                                 bucket_width=args.bucket_width)
+        if jax.process_index() == 0:
+            nonpad = float(np.mean([(b[0] != 0).mean() for b in batches]))
+            print(f"devices: {comm.size}  buckets: {len(batches)} batches  "
+                  f"non-pad fraction: {nonpad:.2f}")
+
+    src0, tgt0 = batches[0][:2]
     params = model.init(jax.random.PRNGKey(0), src0[:2], tgt0[:2])["params"]
     opt = cmn.create_multi_node_optimizer(optax.adam(3e-3), comm)
     state = opt.init(params)

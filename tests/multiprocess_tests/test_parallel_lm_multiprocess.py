@@ -19,7 +19,7 @@ WORKER = os.path.join(
 )
 
 
-def test_eight_process_parallel_lm_real_geometry(tmp_path):
+def _run(tmp_path, nproc, small=False, timeout=900):
     env = {
         k: v
         for k, v in os.environ.items()
@@ -30,27 +30,41 @@ def test_eight_process_parallel_lm_real_geometry(tmp_path):
             "PYTHONPATH": REPO,
             "JAX_PLATFORMS": "cpu",
             "CMN_TEST_TMP": str(tmp_path),
+            "CMN_WORKER_NPROC": str(nproc),
         }
     )
+    if small:
+        env["CMN_WORKER_SMALL"] = "1"
     res = subprocess.run(
-        [sys.executable, "-m", "chainermn_tpu.launch", "-n", "8",
+        [sys.executable, "-m", "chainermn_tpu.launch", "-n", str(nproc),
          "--grace", "5", WORKER],
-        env=env, cwd=REPO, capture_output=True, timeout=900,
+        env=env, cwd=REPO, capture_output=True, timeout=timeout,
     )
     log = res.stderr.decode(errors="replace") + res.stdout.decode(
         errors="replace"
     )
     assert res.returncode == 0, log[-4000:]
     losses = None
-    for pid in range(8):
+    for pid in range(nproc):
         out = tmp_path / f"verdict_{pid}.json"
         assert out.exists(), f"rank {pid} wrote no verdict:\n{log[-4000:]}"
         v = json.loads(out.read_text())
         assert v.get("status") == "ok", v.get("traceback", v)
-        assert v.get("param_count", 0) > 5_000_000, v
+        if not small:
+            assert v.get("param_count", 0) > 5_000_000, v
         # Every process must see the SAME (psum-replicated) loss curve.
         if losses is None:
             losses = v["losses"]
         else:
             assert v["losses"] == losses, (pid, v["losses"], losses)
     assert losses[-1] < losses[0], losses
+
+
+def test_eight_process_parallel_lm_real_geometry(tmp_path):
+    _run(tmp_path, 8)
+
+
+def test_sixteen_process_parallel_lm(tmp_path):
+    """16 gloo processes, data axis widened to 2 (VERDICT r4 item 9): all
+    FOUR mesh axes now cross OS-process boundaries in one program."""
+    _run(tmp_path, 16, small=True, timeout=1500)

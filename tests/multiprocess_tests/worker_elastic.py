@@ -1,15 +1,27 @@
-"""Worker for the cross-process elastic-restart integration test.
+"""Worker for the cross-process elastic-restart integration tests.
 
 Phase 1 (``CMN_PHASE=1``, run under ``launch -n 2``): ZeRO-adam DP training
 across 2 OS processes (2 devices), synchronous checkpoint at step 3;
-process 0 also writes the materialized logical params for phase 2's
-bit-exactness check.
+process 0 also writes the materialized logical params for the later
+phases' bit-exactness checks.  Also records this world's
+``scatter_dataset`` slice for the resize coverage assertion.
 
 Phase 2 (``CMN_PHASE=2``, run under ``launch -n 1``): a SINGLE process —
 half the world gone — resumes the same checkpoint directory through
 ``maybe_load_elastic``, asserts the restore is bit-exact, and trains on.
 The reference's checkpointer required the SAME world size on restart
 (SURVEY §2.8); this is the capability it lacked.
+
+Phase 3 (``CMN_PHASE=3``, run under ``launch -n 4``): resize UP — twice
+the world the checkpoint was written by — bit-exact resume, train on,
+and record the resized ``scatter_dataset`` slice (the test asserts both
+worlds' slices partition the dataset exactly).
+
+Phase 4 (``CMN_PHASE=4``, run under ``launch -n 2 --restarts 1
+--restart-nproc 4``): the SUPERVISOR-integrated elastic flow.  Attempt 0
+(``CMN_LAUNCH_ATTEMPT=0``) trains, checkpoints, then deliberately
+crashes; the supervisor relaunches at the new world size and attempt 1
+resumes elastically and finishes.
 """
 
 import json
@@ -64,9 +76,15 @@ def main() -> dict:
             state, metrics = opt.update(state, b, loss_fn, has_aux=True)
         return state, metrics
 
-    if phase == 1:
-        state = opt.init(params)
-        state, metrics = run(state, batches[:3])
+    from chainermn_tpu.datasets import scatter_dataset
+
+    def my_scatter_slice():
+        # Deterministic permutation (fixed seed): the per-process slices
+        # must partition the dataset exactly at ANY world size.
+        sub = scatter_dataset(list(range(32)), comm, shuffle=True, seed=5)
+        return sorted(int(x) for x in sub)
+
+    def save_phase1(state, metrics):
         ckpt.save(state)
         ckpt.finalize()
         out["step"] = int(state.step)
@@ -81,7 +99,8 @@ def main() -> dict:
         }
         if pid == 0:
             np.savez(os.path.join(tmp, "params_phase1.npz"), **flat)
-    else:
+
+    def resume_and_finish():
         state, resumed = ckpt.maybe_load_elastic(opt, params)
         out["resumed_step"] = int(state.step)
         saved = np.load(os.path.join(tmp, "params_phase1.npz"))
@@ -97,6 +116,38 @@ def main() -> dict:
         out["loss"] = float(metrics["loss"])
         if not np.isfinite(out["loss"]):
             raise AssertionError(f"non-finite loss {out['loss']}")
+
+    if phase == 1:
+        state = opt.init(params)
+        state, metrics = run(state, batches[:3])
+        save_phase1(state, metrics)
+        out["scatter_indices"] = my_scatter_slice()
+    elif phase in (2, 3):
+        resume_and_finish()
+        out["scatter_indices"] = my_scatter_slice()
+    elif phase == 4:
+        attempt = int(os.environ.get("CMN_LAUNCH_ATTEMPT", "0"))
+        out["attempt"] = attempt
+        if attempt == 0:
+            state = opt.init(params)
+            state, metrics = run(state, batches[:3])
+            save_phase1(state, metrics)
+            # Emit this attempt's result BEFORE the deliberate crash, then
+            # fail rank 0: the supervisor must tear the job down and
+            # relaunch it at --restart-nproc.
+            print("WORKER_RESULT " + json.dumps(out), flush=True)
+            if pid == 0:
+                raise RuntimeError("deliberate phase-4 crash after save")
+            # Surviving ranks park until the launcher SIGTERMs them —
+            # returning 0 here could race the supervisor into treating
+            # the attempt as a success.
+            import time
+
+            time.sleep(60)
+        else:
+            resume_and_finish()
+    else:
+        raise AssertionError(f"unknown CMN_PHASE {phase}")
     return out
 
 

@@ -17,7 +17,14 @@ import traceback
 
 import numpy as np
 
-N = 8
+#: World size (the 16-process tier sets CMN_WORKER_NPROC=16 and
+#: CMN_WORKER_SMALL=1: same 5-way program, data axis widened to 2 so ALL
+#: four axes cross OS-process boundaries, width reduced because this tier's
+#: point is the 16-process gloo mesh, not model width — the host is
+#: 1-core and real geometry at 16-way oversubscription would take tens of
+#: minutes).
+N = int(os.environ.get("CMN_WORKER_NPROC", "8"))
+SMALL = os.environ.get("CMN_WORKER_SMALL") == "1"
 
 
 def main() -> dict:
@@ -42,20 +49,26 @@ def main() -> dict:
     assert len(jax.devices()) == N, len(jax.devices())
 
     mesh = cmn.hybrid_mesh(
-        {"data": 1, "stage": 2, "model": 2, "seq": 2}
+        {"data": N // 8, "stage": 2, "model": 2, "seq": 2}
     )
     comm = cmn.XlaCommunicator(mesh)
 
-    cfg = ParallelLMConfig(
-        vocab=4096, n_stages=2, d_model=512, n_heads=8, d_ff=2048,
-        max_len=128, n_experts=2, moe_k=1, pos_enc="rope",
-    )
+    if SMALL:
+        cfg = ParallelLMConfig(
+            vocab=512, n_stages=2, d_model=128, n_heads=8, d_ff=512,
+            max_len=64, n_experts=2, moe_k=1, pos_enc="rope",
+        )
+    else:
+        cfg = ParallelLMConfig(
+            vocab=4096, n_stages=2, d_model=512, n_heads=8, d_ff=2048,
+            max_len=128, n_experts=2, moe_k=1, pos_enc="rope",
+        )
     lm = ParallelLM(cfg, comm.sub("stage"), n_microbatches=2)
     specs = parallel_lm_specs(cfg)
 
     rng = np.random.RandomState(0)  # same seed every process: replicated init
     params = init_parallel_lm(rng, cfg)
-    B, T = 2, cfg.max_len
+    B, T = 2 * (N // 8), cfg.max_len
     tokens = rng.randint(0, cfg.vocab, size=(B, T)).astype(np.int32)
     targets = np.concatenate(
         [tokens[:, 1:], np.full((B, 1), -1, np.int32)], axis=1
@@ -119,7 +132,9 @@ def main() -> dict:
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
     )
     out["param_count"] = param_count
-    assert param_count > 5_000_000, param_count  # real geometry, not a toy
+    if not SMALL:
+        # real geometry, not a toy
+        assert param_count > 5_000_000, param_count
 
     comm.barrier()
     cmn.shutdown_distributed()

@@ -375,3 +375,35 @@ def test_ulysses_packed_segments(seq_comm, causal):
         )
     )
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+
+def test_ulysses_segment_masked_rows_use_causal_crossover(monkeypatch):
+    """ADVICE r4: segment-masked non-causal rows are an unmeasured
+    category for the T=196 non-causal flash crossover (the one related
+    capture had flash at 0.86x) — `_default_attention` must resolve them
+    with the CONSERVATIVE causal crossover, i.e. record causal=True in
+    the resolve call whenever segment_ids is present."""
+    from chainermn_tpu.parallel import ulysses as uly
+
+    calls = []
+    real = None
+    import chainermn_tpu.ops as ops
+
+    real = ops.resolve_attention
+
+    def spy(impl, T, causal=False):
+        calls.append({"T": T, "causal": causal})
+        return real(impl, T, causal=causal)
+
+    monkeypatch.setattr(ops, "resolve_attention", spy)
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(1, 256, 2, 4).astype(np.float32))
+        for _ in range(3)
+    )
+    seg = jnp.ones((1, 256), jnp.int32)
+    uly._default_attention(q, k, v, causal=False, segment_ids=seg)
+    assert calls and calls[-1]["causal"] is True, calls
+    calls.clear()
+    uly._default_attention(q, k, v, causal=False, segment_ids=None)
+    assert calls and calls[-1]["causal"] is False, calls
