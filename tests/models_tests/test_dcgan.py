@@ -67,6 +67,7 @@ def _oracle(gen, disc, g_tx, d_tx, batches, rng):
     return g_params, d_params
 
 
+@pytest.mark.slow
 def test_gan_dp_matches_single_device_oracle(devices):
     gen, disc = _models()
     # SGD, deliberately: scale-invariant optimizers (adam) mask wrong-by-

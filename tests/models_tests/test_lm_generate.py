@@ -45,6 +45,7 @@ def test_decode_prefill_matches_full_forward():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_naive_rollout():
     T = 24
     model = _model(T)

@@ -11,6 +11,7 @@ import chainermn_tpu as cmn
 from chainermn_tpu.models import ResNetTiny, resnet_loss
 
 
+@pytest.mark.slow
 def test_resnet_forward_shapes(devices):
     comm = cmn.create_communicator("xla", devices=devices)
     model = ResNetTiny(num_classes=10, width=8, axis_name=comm.axis_name)
@@ -21,6 +22,7 @@ def test_resnet_forward_shapes(devices):
     assert logits.dtype == jnp.float32  # head in fp32
 
 
+@pytest.mark.slow
 def test_resnet_fused_maxpool_matches_xla(devices):
     # maxpool="fused" (scatter-free backward, the select_and_scatter
     # replacement) must be forward-IDENTICAL and gradient-equal to the
@@ -60,6 +62,7 @@ def test_resnet_fused_maxpool_matches_xla(devices):
         )
 
 
+@pytest.mark.slow
 def test_resnet_dp_training_stateful(devices):
     comm = cmn.create_communicator("xla", devices=devices)
     model = ResNetTiny(num_classes=4, width=8, axis_name=comm.axis_name)

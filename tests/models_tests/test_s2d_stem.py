@@ -72,6 +72,7 @@ def test_s2d_matches_flax_same_padding():
         )
 
 
+@pytest.mark.slow
 def test_s2d_resnet_forward_and_grads(devices):
     """End-to-end: the s2d model trains (shapes right, grads finite) and
     its stem param is the (4, 4, 12, width) kernel."""

@@ -3,6 +3,7 @@
 attention ablation staying within bf16 tolerance."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -97,6 +98,7 @@ def test_gqa_lm_trains_and_shrinks_kv():
     assert cache[0]["k"].shape == (2, 48, 2, 16)
 
 
+@pytest.mark.slow
 def test_gqa_greedy_generate_matches_rollout():
     """KV-cache decode through the grouped einsum must bit-match the naive
     full-recompute rollout (same contract as the MHA test above)."""
@@ -121,6 +123,7 @@ def test_gqa_greedy_generate_matches_rollout():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur[:, 8:]))
 
 
+@pytest.mark.slow
 def test_windowed_lm_flash_matches_xla_and_decode():
     """TransformerLM(window=W): flash and XLA paths agree, the window
     actually masks (differs from full attention), and windowed KV-cache

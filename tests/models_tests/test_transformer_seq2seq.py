@@ -110,6 +110,7 @@ def test_enc_attention_override_matches():
         bad.init(jax.random.PRNGKey(0), src, _tgt_in(tgt))
 
 
+@pytest.mark.slow
 def test_trains_on_copy_task(devices):
     """DP training on 'copy the source': loss must fall decisively."""
     import optax

@@ -60,6 +60,7 @@ def test_vgg_chain_matches_sequential(comm):
     np.testing.assert_allclose(out, oracle, atol=2e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_vgg_chain_gradients_match(comm):
     modules, params, x = _setup(n_stages=3)
     S = len(modules)

@@ -44,6 +44,7 @@ def test_remat_is_identity():
             base = float(loss)
 
 
+@pytest.mark.slow
 def test_dp_training_step(devices):
     comm = cmn.create_communicator("xla", devices=devices)
     model = _tiny()

@@ -73,6 +73,7 @@ def test_strided_conv1x1_matches_lax_conv():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_resnet_conv1_impls_agree_and_frozen_bn_runs():
     from chainermn_tpu.models.resnet import ResNetTiny, resnet_loss
 

@@ -92,6 +92,7 @@ def test_bf16_forward_and_grad_dtype():
     )
 
 
+@pytest.mark.slow
 def test_wide_window_residual_does_not_wrap():
     # kh*kw > 256 exceeds uint8: the residual must widen (a wrapped index
     # would route gradient to TWO offsets).  17x17 = 289 offsets.
