@@ -235,6 +235,10 @@ def _config_matches(prev: dict) -> bool:
         if prev.get("bn") not in (None, "sync") or \
                 prev.get("conv1") not in (None, "none"):
             return False
+        if os.environ.get("CMN_BENCH_VIT", "s16") != "s16":
+            return False  # ViT geometry probes are their own question
+        if prev.get("vit_variant") not in (None, "s16"):
+            return False
         arch = os.environ.get("CMN_BENCH_ARCH", "resnet50")
         opt_kind = os.environ.get("CMN_BENCH_OPT", "replicated")
         if arch not in ("resnet50", "vit") or \
@@ -572,10 +576,33 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
     if conv1 != "none" and bn_mode != "frozen":
         _fail("CMN_BENCH_CONV1 fusion requires CMN_BENCH_BN=frozen "
               "(BN folds into the epilogue only with stored stats)")
+    # CMN_BENCH_VIT picks the ViT geometry (VERDICT r4 weak #3 — the 26.0%
+    # ViT-S/16 MFU had no attempted lever).  Two hypotheses, one knob each:
+    #   s14 — patch 14 ⇒ T = (224/14)² = 256: every attention matmul and
+    #         flash block lands exactly on the 128-lane MXU tiles that
+    #         T=196 pads to 256 (~23% wasted attention FLOPs);
+    #   b16 — ViT-B/16 (d=768): tests whether the vision-attention family
+    #         follows the LM family's measured d_model MFU ladder
+    #         (29.0% @ 768 → 42.8% @ 1280) or is stuck for another reason.
+    vit_variant = os.environ.get("CMN_BENCH_VIT", "s16")
+    if vit_variant not in ("s16", "s14", "b16"):
+        _fail(f"CMN_BENCH_VIT={vit_variant!r}: expected 's16', 's14' "
+              f"or 'b16'")
+    if vit_variant != "s16" and arch != "vit":
+        _fail("CMN_BENCH_VIT is a ViT knob — unset for resnet50")
     if arch == "vit":
         from chainermn_tpu.models import ViT, vit_loss
 
-        model = ViT(num_classes=1000)
+        if vit_variant == "s14":
+            if on_cpu:
+                image_size = 56  # 4·14: the CPU sanity tier's 64 isn't
+                # divisible by patch 14 (ViT raises); on TPU it's 224=16·14
+            model = ViT(num_classes=1000, patch=14)
+        elif vit_variant == "b16":
+            model = ViT(num_classes=1000, d_model=768, n_heads=12,
+                        d_ff=3072)
+        else:
+            model = ViT(num_classes=1000)
     else:
         model = ResNet50(
             num_classes=1000, axis_name=comm.axis_name, stem=stem,
@@ -724,6 +751,7 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         "accum_steps": accum,
         "optimizer": opt_kind,
         "stem": stem if arch == "resnet50" else None,
+        "vit_variant": vit_variant if arch == "vit" else None,
         "maxpool": maxpool if arch == "resnet50" else None,
         "bn": bn_mode if arch == "resnet50" else None,
         "conv1": conv1 if arch == "resnet50" else None,
@@ -771,8 +799,11 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
 
         tokens = (image_size // model.patch) ** 2
         payload["attention_requested"] = model.attention
+        # causal=False mirrors the model's own resolution (ViT rows are
+        # unmasked non-causal): without it the tag would use the causal
+        # crossover (1024) and record "xla" while the step runs flash.
         payload["attention_resolved"] = resolve_attention(
-            model.attention, tokens
+            model.attention, tokens, causal=False
         )
     if flops_per_step is not None:
         payload["tflops_per_step"] = round(flops_per_step / 1e12, 3)
