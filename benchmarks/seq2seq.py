@@ -135,12 +135,28 @@ def main():
             (np.asarray(jax.device_get(batch[1])) != PAD).sum()
         )
 
+    def _transient(e):
+        # Same classifier as bench.py._is_transient (not imported: bench's
+        # module level probes the device).  Transient tunnel errors must
+        # ABORT the run with no artifact so the watcher's missing-file gate
+        # retries on the next window — recording one would freeze a
+        # recoverable outage in as a permanent "measurement".
+        return any(t in str(e) for t in ("UNAVAILABLE", "DEADLINE_EXCEEDED"))
+
     for impl in ("flash", "xla"):
         if args.enc_attention == impl:
             # The override makes this arm identical to the uniform
             # configuration already captured elsewhere — don't spend half
             # a scarce tunnel window re-measuring known data.
             continue
+        # Resolved arm name, shared by success AND failure records — a bare
+        # 'xla_error' under --enc-attention flash would misattribute the
+        # hybrid arm's failure to the pure-XLA configuration.
+        key = (
+            f"enc_{args.enc_attention}_dec_{impl}"
+            if args.enc_attention and args.enc_attention != impl
+            else impl
+        )
         model = TransformerSeq2Seq(
             vocab_src=args.vocab, vocab_tgt=args.vocab,
             d_model=args.d_model, n_heads=args.heads, d_ff=args.d_ff,
@@ -173,17 +189,42 @@ def main():
             compiled = step.lower(state, batch).compile()
             step = compiled
         except Exception as e:
-            out[f"{impl}_compile_note"] = f"{type(e).__name__}: {str(e)[:150]}"
+            if _transient(e):
+                raise
+            out[f"{key}_compile_note"] = f"{type(e).__name__}: {str(e)[:150]}"
         flops = compiled_flops(compiled) if compiled is not None else None
+        if compiled is None and any(
+            s in out.get(f"{key}_compile_note", "")
+            for s in ("Ran out of memory", "RESOURCE_EXHAUSTED")
+        ):
+            # Permanent compile OOM: the eager-jit fallback would recompile
+            # for minutes over the tunnel and fail identically — the note
+            # IS this arm's result.
+            out[f"{key}_error"] = out[f"{key}_compile_note"]
+            continue
 
-        for _ in range(2):
-            state, metrics = step(state, batch)
-            _ = float(metrics["loss"])  # device->host sync (tunnel-safe)
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            state, metrics = step(state, batch)
-            _ = float(metrics["loss"])
-        dt = time.perf_counter() - t0
+        # A deterministic arm failure (e.g. the materialized-scores XLA arm
+        # OOMs at T=2048 — 26.2G for B=16·H=8·T² decoder score tensors)
+        # must not take the OTHER arm's finished measurement down with it:
+        # record the failure as this arm's result and keep going.  Same
+        # story the longcontext sweep tells — flash proceeding where XLA
+        # cannot run at all IS the measurement.
+        try:
+            for _ in range(2):
+                state, metrics = step(state, batch)
+                _ = float(metrics["loss"])  # device->host sync (tunnel-safe)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                state, metrics = step(state, batch)
+                _ = float(metrics["loss"])
+            dt = time.perf_counter() - t0
+        except Exception as e:
+            if _transient(e):
+                raise
+            out[f"{key}_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+            print(json.dumps({f"{key}_error": out[f"{key}_error"]}),
+                  flush=True)
+            continue
 
         rec = {
             "step_ms": round(dt / args.iters * 1000.0, 2),
@@ -196,14 +237,6 @@ def main():
             m = mfu(compiled, dt / args.iters, n_dev, out["device_kind"])
             if m is not None:
                 rec["mfu_pct"] = round(m, 2)
-        # With an encoder override, name the record by its RESOLVED
-        # config — the bare 'xla' key would silently mean "enc-flash
-        # hybrid" and invite misreads against earlier pure-arm captures.
-        key = (
-            f"enc_{args.enc_attention}_dec_{impl}"
-            if args.enc_attention and args.enc_attention != impl
-            else impl
-        )
         out[key] = rec
         print(json.dumps({key: rec}), flush=True)
 
