@@ -813,6 +813,24 @@ def _run(per_chip_batch, n_dev, platform, on_cpu):
         if peak is not None:
             achieved = flops_per_step * (iters / dt) / n_dev
             payload["mfu_pct"] = round(100.0 * achieved / peak, 2)
+            if arch == "vit" and payload.get("attention_resolved") == \
+                    "flash":
+                # Pallas flash kernels are opaque to XLA's FLOP counter:
+                # mfu_pct above is a lower bound — emit the inclusive
+                # number with the analytic attention-core term alongside.
+                from chainermn_tpu.utils import (
+                    attention_core_flops,
+                    flash_mfu_fields,
+                )
+
+                tokens = (image_size // model.patch) ** 2
+                extra = model.n_layers * attention_core_flops(
+                    global_batch, model.n_heads, tokens,
+                    model.d_model // model.n_heads, causal=False,
+                )
+                payload.update(flash_mfu_fields(
+                    flops_per_step, extra, dt / iters, n_dev, device_kind,
+                ))
     _emit(payload)
 
 

@@ -39,6 +39,13 @@ def main():
     ap.add_argument("--pos-enc", default="learned",
                     choices=("learned", "rope"),
                     help="positional scheme (rope = rotary q/k, no table)")
+    ap.add_argument("--arms", default="flash,xla",
+                    help="comma-joined subset of flash,xla to measure — "
+                         "e.g. --arms flash for geometries where the "
+                         "materialized-scores arm is a known OOM "
+                         "(longcontext_tpu.json: XLA cannot run T>=8192; "
+                         "the T=4096 1.5B tier is borderline) so a doomed "
+                         "arm never costs the measured one its artifact")
     ap.add_argument("--optimizer", default="adamw",
                     choices=("adamw", "adafactor"),
                     help="adafactor = factored second moments, no fp32 "
@@ -91,6 +98,10 @@ def main():
             "d_model": args.d_model, "heads": args.heads, "d_ff": args.d_ff,
             "vocab": args.vocab, "accum": args.accum, "remat": args.remat,
             "ce_chunk": args.ce_chunk, "optimizer": args.optimizer,
+            # Recorded so a deliberately single-arm artifact (--arms
+            # flash at a known-XLA-OOM geometry) is distinguishable from
+            # a full run whose other arm was lost.
+            "arms": args.arms,
         },
     }
 
@@ -125,6 +136,20 @@ def main():
             state = opt.init(params)
         else:
             state = jax.block_until_ready(jax.jit(opt.init)(params))
+            # The jitted init's outputs are FRESH buffers: the standalone
+            # params tree is now a dead copy the step never reads (the
+            # state carries its own), yet it would stay resident all run —
+            # 6.05 GB at 1.5B, the margin between fitting and
+            # ResourceExhausted at T=4096 (compile fits at ~11.3 GB,
+            # result/memory_autopsy_tpu.json; the live run OOM'd only with
+            # this copy alive).  Not done on the multi-host path, where
+            # opt.init may alias the caller's arrays into the state.
+            for a in jax.tree.leaves(params):
+                try:
+                    a.delete()
+                except Exception:
+                    pass
+            params = None
         loss_fn = (
             lm_loss_chunked(model, chunk_size=args.ce_chunk)
             if args.ce_chunk
@@ -147,7 +172,8 @@ def main():
             # (note it, fall through to the per-call jit); anything else is
             # transient — re-raise so the outer handler withholds the
             # artifact and the watcher retries.
-            if "RESOURCE_EXHAUSTED" not in str(e):
+            if not any(s in str(e) for s in (
+                    "RESOURCE_EXHAUSTED", "Ran out of memory")):
                 raise
             out[f"{impl}_compile_note"] = f"{type(e).__name__}: {str(e)[:150]}"
         flops = compiled_flops(compiled) if compiled is not None else None
@@ -170,6 +196,26 @@ def main():
             m = mfu(compiled, dt / args.iters, n_dev, out["device_kind"])
             if m is not None:
                 rec["mfu_pct"] = round(m, 2)
+            if impl == "flash" and m is not None:
+                # XLA's cost analysis cannot see inside Pallas custom
+                # calls, so the flash arm's attention-core FLOPs are
+                # missing from mfu_pct (a lower bound).  Add the analytic
+                # core count (utils.attention_core_flops) and emit the
+                # inclusive number alongside, clearly labeled.
+                from chainermn_tpu.utils import (
+                    attention_core_flops,
+                    flash_mfu_fields,
+                )
+
+                extra = args.layers * attention_core_flops(
+                    args.batch, args.heads, args.seq,
+                    args.d_model // args.heads, causal=True,
+                    n_forward=2 if args.remat else 1,
+                )
+                rec.update(flash_mfu_fields(
+                    flops, extra, dt / args.iters, n_dev,
+                    out["device_kind"],
+                ))
         # Free this arm's HBM before the next arm compiles: at 774M the
         # fp32 params + adamw moments are ~9 GB — two arms alive at once
         # exceeded the 15.75 GB chip (RESOURCE_EXHAUSTED at the second
@@ -185,8 +231,11 @@ def main():
         jax.clear_caches()
         return rec
 
+    arms = tuple(a for a in args.arms.split(",") if a)
+    if not arms or any(a not in ("flash", "xla") for a in arms):
+        raise SystemExit(f"--arms {args.arms!r}: subset of flash,xla")
     retryable = False
-    for impl in ("flash", "xla"):
+    for impl in arms:
         try:
             out[impl] = run_arm(impl)
         except Exception as e:
@@ -198,7 +247,11 @@ def main():
             # artifact the watcher's file-existence gate would then treat as
             # done forever.
             out[impl] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
-            if "RESOURCE_EXHAUSTED" not in str(e):
+            if not any(s in str(e) for s in (
+                    "RESOURCE_EXHAUSTED", "Ran out of memory")):
+                # "Ran out of memory": the tunnel's remote-compile helper
+                # wraps compile OOMs in a generic INTERNAL error whose text
+                # (when detailed) says this instead of RESOURCE_EXHAUSTED.
                 retryable = True
             jax.clear_caches()
         print(json.dumps({impl: out[impl]}), flush=True)

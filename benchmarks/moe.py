@@ -128,6 +128,26 @@ def main():
             m = mfu(compiled, dt / args.iters, n_dev, out["device_kind"])
             if m is not None:
                 rec["mfu_pct"] = round(m, 2)
+            from chainermn_tpu.ops import resolve_attention
+            from chainermn_tpu.utils import (
+                attention_core_flops,
+                flash_mfu_fields,
+            )
+
+            if m is not None and resolve_attention(
+                    "auto", args.seq, causal=True) == "flash":
+                # The trunk's auto-attention resolves to the Pallas flash
+                # kernel at this T, which XLA's FLOP counter can't see —
+                # mfu_pct is a lower bound; emit the inclusive number too.
+                extra = args.layers * attention_core_flops(
+                    args.batch, args.heads, args.seq,
+                    args.d_model // args.heads, causal=True,
+                    n_forward=2,  # remat=True re-runs the forward kernel
+                )
+                rec.update(flash_mfu_fields(
+                    flops, extra, dt / args.iters, n_dev,
+                    out["device_kind"],
+                ))
         for key in ("moe_aux", "moe_dropped"):
             if key in metrics:
                 rec[key] = round(float(metrics[key]), 4)

@@ -237,6 +237,47 @@ def main():
             m = mfu(compiled, dt / args.iters, n_dev, out["device_kind"])
             if m is not None:
                 rec["mfu_pct"] = round(m, 2)
+                # Pallas flash kernels are invisible to XLA's FLOP
+                # counter (mfu_pct is a lower bound for flash arms): add
+                # the analytic attention-core count per component that
+                # RESOLVES to flash.  'auto' components resolve the same
+                # way the model does (segment-masked/causal rows use the
+                # causal crossover — the packed tiers always carry
+                # segment ids).
+                from chainermn_tpu.ops import resolve_attention
+                from chainermn_tpu.utils import (
+                    attention_core_flops,
+                    flash_mfu_fields,
+                )
+
+                dh = args.d_model // args.heads
+                enc_impl = resolve_attention(
+                    args.enc_attention or impl, args.src_len
+                )
+                dec_impl = resolve_attention(impl, args.tgt_len)
+                cross_impl = resolve_attention(
+                    impl, args.tgt_len, args.src_len
+                )
+                extra = 0.0
+                if enc_impl == "flash":
+                    extra += args.enc * attention_core_flops(
+                        args.batch, args.heads, args.src_len, dh,
+                        causal=False
+                    )
+                if dec_impl == "flash":
+                    extra += args.dec * attention_core_flops(
+                        args.batch, args.heads, args.tgt_len, dh,
+                        causal=True
+                    )
+                if cross_impl == "flash":
+                    extra += args.dec * attention_core_flops(
+                        args.batch, args.heads, args.tgt_len, dh,
+                        kv_len=args.src_len, causal=False
+                    )
+                rec.update(flash_mfu_fields(
+                    flops, extra, dt / args.iters, n_dev,
+                    out["device_kind"],
+                ))
         out[key] = rec
         print(json.dumps({key: rec}), flush=True)
 

@@ -69,3 +69,48 @@ def test_mfu_from_compiled_step():
               device_kind="TPU v5 lite")
     assert got is not None and abs(got - 100.0) < 1e-6
     assert mfu(compiled, 1.0, device_kind="made-up-chip") is None
+
+
+def test_attention_core_flops():
+    from chainermn_tpu.utils import attention_core_flops, mfu
+
+    # Two matmuls forward (QK^T, AV) at 2 FLOPs/MAC: 4*B*H*Tq*Tkv*Dh.
+    assert attention_core_flops(1, 1, 2, 1, n_backward=0) == 16.0
+    # Backward = 2.5x forward (5 matmuls incl. in-kernel score recompute).
+    assert attention_core_flops(1, 1, 2, 1) == 16.0 + 40.0
+    # Causal halves the attended area; remat re-runs the forward once.
+    assert attention_core_flops(1, 1, 2, 1, causal=True) == 28.0
+    assert attention_core_flops(1, 1, 2, 1, n_forward=2) == 72.0
+    # Cross-attention area is Tq*Tkv.
+    assert attention_core_flops(2, 3, 4, 5, kv_len=8, n_backward=0) == (
+        4.0 * 2 * 3 * 4 * 8 * 5
+    )
+    # Consistency with the measured flash-vs-XLA tflops_per_step gap at
+    # the seq2seq T=512 geometry (result/seq2seq_tpu_packed.json:
+    # 14.043 - 12.110 = 1.933 TF): analytic core count must land within
+    # 15% below it (the XLA arm additionally counts softmax/mask work).
+    dh = 512 // 8
+    analytic = (
+        6 * attention_core_flops(64, 8, 512, dh, causal=False)
+        + 6 * attention_core_flops(64, 8, 512, dh, causal=True)
+        + 6 * attention_core_flops(64, 8, 512, dh, kv_len=512, causal=False)
+    )
+    gap = (14.043 - 12.110) * 1e12
+    assert analytic <= gap * 1.001
+    assert analytic >= gap * 0.85
+
+    # mfu(extra_flops=) adds the uncounted work to the numerator.
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    f = _jax.jit(lambda a, b: a @ b)
+    x = _jnp.ones((256, 256), _jnp.float32)
+    compiled = f.lower(x, x).compile()
+    from chainermn_tpu.utils import compiled_flops
+
+    flops = compiled_flops(compiled)
+    base = mfu(compiled, step_time_s=flops / 197e12,
+               device_kind="TPU v5 lite")
+    incl = mfu(compiled, step_time_s=flops / 197e12,
+               device_kind="TPU v5 lite", extra_flops=flops)
+    assert abs(base - 100.0) < 1e-6 and abs(incl - 200.0) < 1e-6
