@@ -57,10 +57,14 @@ BASELINE_PROVENANCE = {
 def _lm_headline() -> dict | None:
     """The LM family's strongest on-chip capture, embedded in every payload.
 
-    The repo's best measured number is LM training MFU (45.0% at 1.558B on
-    one chip), but the driver's mechanical capture only ever saw the ResNet
-    top-level value (VERDICT r4 weak #8) — so the composite payload carries
-    the best ``result/lm_tpu*.json`` arm with full provenance.  Cached by
+    The repo's best measured number is LM training MFU (50.59% incl. flash
+    at 1.558B on one chip), but the driver's mechanical capture only ever
+    saw the ResNet top-level value (VERDICT r4 weak #8) — so the composite
+    payload carries the best ``result/lm_tpu*.json`` arm with full
+    provenance.  Selection key is ``mfu_pct_incl_flash`` when the artifact
+    carries it (flash-core FLOPs are invisible to XLA's ``cost_analysis``;
+    artifacts predating the corrected accounting only have the XLA-counted
+    lower bound ``mfu_pct``, which stays comparable).  Cached by
     construction (these captures come from the watcher's tunnel windows,
     not this process); ``artifact`` + ``cached`` say so explicitly.
     """
@@ -68,6 +72,7 @@ def _lm_headline() -> dict | None:
 
     here = os.path.dirname(os.path.abspath(__file__))
     best = None
+    best_key = None
     for path in sorted(glob.glob(os.path.join(here, "result/lm_tpu*.json"))):
         try:
             with open(path) as f:
@@ -76,13 +81,15 @@ def _lm_headline() -> dict | None:
                 continue
             for impl in ("flash", "xla"):
                 arm = rec.get(impl, {})
-                mfu = arm.get("mfu_pct")
+                mfu = arm.get("mfu_pct_incl_flash", arm.get("mfu_pct"))
                 if mfu is None:
                     continue
-                if best is None or mfu > best["mfu_pct"]:
+                if best is None or mfu > best_key:
+                    best_key = mfu
                     best = {
                         "metric": "lm_train_mfu_pct",
-                        "mfu_pct": mfu,
+                        "mfu_pct": arm.get("mfu_pct"),
+                        "mfu_pct_incl_flash": arm.get("mfu_pct_incl_flash"),
                         "tokens_per_sec_per_chip": arm.get(
                             "tokens_per_sec_per_chip"
                         ),

@@ -52,6 +52,18 @@ def main():
                          "momentum tensors — the low-memory tier that fits "
                          "GPT-2-XL-scale (1.5B) training on one 16 GB chip "
                          "where adamw's moments alone need ~12 GB")
+    ap.add_argument("--param-dtype", default="float32",
+                    choices=("float32", "bfloat16"),
+                    help="parameter STORAGE dtype. bfloat16 halves the "
+                         "persistent params+grads bytes (adafactor stats "
+                         "follow) — the storage lever for >2B configs, "
+                         "where fp32 params OOM on the 15.75 GB chip")
+    ap.add_argument("--accept-oom", action="store_true",
+                    help="an all-arms-OOM run still writes --out (the OOM "
+                         "is the answer for a does-this-geometry-fit "
+                         "stanza). Off by default so a mis-wrapped "
+                         "transient at a known-good geometry can never "
+                         "land a permanent error-only artifact")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config for CPU plumbing checks")
     ap.add_argument("--out", default=None)
@@ -98,6 +110,7 @@ def main():
             "d_model": args.d_model, "heads": args.heads, "d_ff": args.d_ff,
             "vocab": args.vocab, "accum": args.accum, "remat": args.remat,
             "ce_chunk": args.ce_chunk, "optimizer": args.optimizer,
+            "param_dtype": args.param_dtype,
             # Recorded so a deliberately single-arm artifact (--arms
             # flash at a known-XLA-OOM geometry) is distinguishable from
             # a full run whose other arm was lost.
@@ -117,6 +130,7 @@ def main():
             vocab=args.vocab, n_layers=args.layers, d_model=args.d_model,
             n_heads=args.heads, d_ff=args.d_ff, max_len=args.seq,
             attention=impl, remat=args.remat, pos_enc=args.pos_enc,
+            param_dtype=getattr(jnp, args.param_dtype),
         )
         base_opt = (
             optax.adafactor(3e-4)
@@ -135,20 +149,20 @@ def main():
             # which cannot run under a trace.
             state = opt.init(params)
         else:
-            state = jax.block_until_ready(jax.jit(opt.init)(params))
-            # The jitted init's outputs are FRESH buffers: the standalone
-            # params tree is now a dead copy the step never reads (the
-            # state carries its own), yet it would stay resident all run —
-            # 6.05 GB at 1.5B, the margin between fitting and
-            # ResourceExhausted at T=4096 (compile fits at ~11.3 GB,
-            # result/memory_autopsy_tpu.json; the live run OOM'd only with
-            # this copy alive).  Not done on the multi-host path, where
-            # opt.init may alias the caller's arrays into the state.
-            for a in jax.tree.leaves(params):
-                try:
-                    a.delete()
-                except Exception:
-                    pass
+            # DONATE the params into the jitted init: without donation the
+            # init peak holds params TWICE (argument + the state's own copy
+            # of them) plus the optimizer stats — params (fp32) + params +
+            # stats ≈ 19.3 GB at 2.08B, an OOM before the first step even
+            # though the steady-state step fits (the r5 fp32-2.08B attempt,
+            # result/lm_2085m_stdout.log).  With donation XLA aliases the
+            # argument buffers into the state and the peak is one params
+            # copy + stats.  The params binding is dead afterwards either
+            # way (donated; the state carries its own buffers) — dropping
+            # it is the r4 dead-copy fix.  Not done on the multi-host path,
+            # where opt.init may alias the caller's arrays into the state.
+            state = jax.block_until_ready(
+                jax.jit(opt.init, donate_argnums=0)(params)
+            )
             params = None
         loss_fn = (
             lm_loss_chunked(model, chunk_size=args.ce_chunk)
@@ -266,17 +280,31 @@ def main():
         )
     print(json.dumps({k: v for k, v in out.items() if k != "config"}))
     measured = [k for k in ("flash", "xla") if "step_ms" in out.get(k, {})]
-    complete = bool(measured) and not retryable
+    oom_recorded = [
+        k for k in ("flash", "xla") if "error" in out.get(k, {})
+    ]
+    # A run is COMPLETE when every attempted arm reached a deterministic
+    # outcome: a measurement, or — under --accept-oom only — a recorded
+    # OOM (only ResourceExhausted reaches here without setting
+    # `retryable`).  For a fit-probe stanza the OOM IS the measurement,
+    # and withholding it would wedge the watcher's file-existence gate
+    # into re-running a doomed ~1-h bench every window, forever; for
+    # every other stanza a zero-measurement run stays withheld, so a
+    # mis-wrapped transient at a known-good geometry can't freeze in as
+    # a permanent error-only artifact.
+    complete = bool(
+        measured or (oom_recorded and args.accept_oom)
+    ) and not retryable
     if args.out:
         if complete:
             from chainermn_tpu.utils import atomic_json_dump
 
             atomic_json_dump(out, args.out)
         else:
-            # Withheld: either zero arms measured, or an arm died to a
-            # transient (non-OOM) error — leave --out unwritten so the
-            # watcher's file-existence gate retries on the next tunnel
-            # window instead of permanently accepting a degraded artifact.
+            # Withheld: an arm died to a transient (non-OOM) error — leave
+            # --out unwritten so the watcher's file-existence gate retries
+            # on the next tunnel window instead of permanently accepting a
+            # degraded artifact.
             print(json.dumps({"error": "incomplete run; artifact withheld"}))
     if not complete:
         raise SystemExit(1)

@@ -65,6 +65,14 @@ class _DecoderBlock(nn.Module):
     d_ff: int
     dtype: Any
     attention: str
+    #: parameter STORAGE dtype (flax convention).  ``float32`` (default)
+    #: is the classic master-weights layout; ``bfloat16`` halves the
+    #: persistent params+grads bytes (T5-style: adafactor's factored stats
+    #: follow the param dtype) — the storage lever for >2B-param configs
+    #: on the 15.75 GB chip, where even 2.08B with fp32 params OOMs
+    #: (result/lm_2085m_stdout.log).  The router stays fp32
+    #: regardless — routing softmax numerics, GShard/Switch convention.
+    param_dtype: Any = jnp.float32
     #: kv heads (grouped-query attention).  Equal to ``n_heads`` (the
     #: default, and the classic multi-head layout) keeps the fused ``qkv``
     #: projection and its parameter names; fewer kv heads split the
@@ -126,16 +134,21 @@ class _DecoderBlock(nn.Module):
             # paths — softmax over all-NEG_INF rows degenerates to uniform
             # (causality-violating) weights with no error.
             raise ValueError(f"window must be >= 0, got {self.window}")
-        x = nn.LayerNorm(dtype=self.dtype, name="ln1")(h)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(h)
         if KH == H:
             qkv = nn.DenseGeneral(
-                (3, H, D // H), dtype=self.dtype, name="qkv"
+                (3, H, D // H), dtype=self.dtype, param_dtype=self.param_dtype,
+                name="qkv"
             )(x)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         else:
-            q = nn.DenseGeneral((H, D // H), dtype=self.dtype, name="q")(x)
+            q = nn.DenseGeneral(
+                (H, D // H), dtype=self.dtype,
+                param_dtype=self.param_dtype, name="q",
+            )(x)
             kv = nn.DenseGeneral(
-                (2, KH, D // H), dtype=self.dtype, name="kv"
+                (2, KH, D // H), dtype=self.dtype, param_dtype=self.param_dtype,
+                name="kv"
             )(x)
             k, v = kv[:, :, 0], kv[:, :, 1]
         if cache is not None:
@@ -268,14 +281,19 @@ class _DecoderBlock(nn.Module):
                     q, k, v, causal=True, segment_ids=segment_ids,
                     window=self.window or None,
                 ).astype(q.dtype)
-        o = nn.DenseGeneral(D, axis=(-2, -1), dtype=self.dtype, name="proj")(a)
+        o = nn.DenseGeneral(
+            D, axis=(-2, -1), dtype=self.dtype,
+            param_dtype=self.param_dtype, name="proj",
+        )(a)
         h = h + o
-        x = nn.LayerNorm(dtype=self.dtype, name="ln2")(h)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(h)
         if self.n_experts:
             y = self._moe_ffn(x)
         else:
-            y = nn.Dense(self.d_ff, dtype=self.dtype, name="ff1")(x)
-            y = nn.Dense(D, dtype=self.dtype, name="ff2")(nn.gelu(y))
+            y = nn.Dense(self.d_ff, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ff1")(x)
+            y = nn.Dense(D, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ff2")(nn.gelu(y))
         h = h + y
         return (h, new_cache) if cache is not None else h
 
@@ -314,14 +332,16 @@ class _DecoderBlock(nn.Module):
         )
         w1 = self.param(
             "moe_w1", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (E, D, F), jnp.float32,
+            (E, D, F), self.param_dtype,
         )
-        b1 = self.param("moe_b1", nn.initializers.zeros, (E, F), jnp.float32)
+        b1 = self.param("moe_b1", nn.initializers.zeros, (E, F),
+                        self.param_dtype)
         w2 = self.param(
             "moe_w2", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (E, F, D), jnp.float32,
+            (E, F, D), self.param_dtype,
         )
-        b2 = self.param("moe_b2", nn.initializers.zeros, (E, D), jnp.float32)
+        b2 = self.param("moe_b2", nn.initializers.zeros, (E, D),
+                        self.param_dtype)
 
         xg = flat.reshape(n_groups, G, D)
         probs = jax.nn.softmax(
@@ -374,6 +394,13 @@ class TransformerLM(nn.Module):
     d_ff: int = 1024
     max_len: int = 1024
     dtype: Any = jnp.bfloat16
+    #: parameter STORAGE dtype.  ``bfloat16`` halves persistent
+    #: params(+grads) HBM — with adafactor's factored stats following it,
+    #: the T5-style all-bf16 layout sized to fit a 2.6B model's optimizer
+    #: state on the one 15.75 GB chip (capture armed in the watcher; even
+    #: 2.08B with fp32 params OOMs, ``result/lm_2085m_stdout.log``).  The
+    #: MoE router and the LayerNorm/lm_head COMPUTE stay fp32 either way.
+    param_dtype: Any = jnp.float32
     #: "flash" (Pallas kernel), "xla" (materialized-scores oracle — the
     #: switch the LM benchmark uses to measure the kernel's end-to-end
     #: value), or "auto" (default): flash from the measured on-chip
@@ -435,7 +462,8 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"pos_enc={self.pos_enc!r}: expected 'learned' or 'rope'"
             )
-        h = nn.Embed(self.vocab, D, dtype=self.dtype, name="embed")(tokens)
+        h = nn.Embed(self.vocab, D, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="embed")(tokens)
         positions = None
         if segment_ids is not None and cache is None:
             # Per-document position restart (shared helper; both schemes:
@@ -445,7 +473,7 @@ class TransformerLM(nn.Module):
         if self.pos_enc == "learned":
             pos = self.param(
                 "pos", nn.initializers.normal(0.02), (self.max_len, D),
-                jnp.float32,
+                self.param_dtype,
             )
             if cache is not None:
                 if jnp.ndim(decode_pos) == 0:
@@ -497,7 +525,8 @@ class TransformerLM(nn.Module):
                 pos_enc=self.pos_enc, n_experts=self.n_experts,
                 moe_k=self.moe_k,
                 moe_capacity_factor=self.moe_capacity_factor,
-                moe_group=self.moe_group, name=f"block_{i}",
+                moe_group=self.moe_group,
+                param_dtype=self.param_dtype, name=f"block_{i}",
             )
             if cache is not None:
                 h, c = blk(h, None, cache[i], decode_pos, rope=rope,
@@ -505,10 +534,12 @@ class TransformerLM(nn.Module):
                 new_cache.append(c)
             else:
                 h = blk(h, segment_ids, rope=rope)
-        h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_f")(h)
         if return_hidden:
             return h
-        logits = nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(h)
+        logits = nn.Dense(self.vocab, dtype=jnp.float32,
+                          param_dtype=self.param_dtype, name="lm_head")(h)
         return (logits, new_cache) if cache is not None else logits
 
     def init_cache(self, batch: int, max_len: int = None):

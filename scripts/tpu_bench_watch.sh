@@ -414,6 +414,36 @@ print(float((x@x).sum()))
     # (bench.py exits 0 on them) so a failure record can never clobber
     # the known-good done-artifact.
     if [ -s result/bench_tpu_done.json ] \
+       && [ ! -s result/lm_tpu_2700m.json ]; then
+      # 2.6B ladder point (GPT-3-2.7B geometry, heads=20 so head_dim=128):
+      # bf16 param storage (T5-style) — fp32 params OOM even at 2.08B on
+      # the 15.75 GB chip (result/lm_2085m_stdout.log).  The session-3
+      # direct attempt lost its tunnel window mid-compile.
+      echo "# running 2.6B bf16-params LM bench at $(date +%H:%M:%S)" >&2
+      timeout 3000 python benchmarks/lm.py --batch 1 --seq 2048 \
+        --layers 32 --d-model 2560 --heads 20 --d-ff 10240 \
+        --remat --ce-chunk 8192 --optimizer adafactor \
+        --param-dtype bfloat16 --arms flash --iters 10 --accept-oom \
+        --out result/lm_tpu_2700m.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# 2.6B lm rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/bench_tpu_done.json ] \
+       && [ ! -s result/lm_tpu_2085m.json ]; then
+      # 2.08B with CLASSIC fp32 master params: measures whether the
+      # donated opt.init (init peak = one params copy + stats, not two
+      # copies) is enough to fit fp32 at this scale — the A/B for the
+      # param-dtype lever's necessity.
+      echo "# running 2.08B fp32-params LM bench at $(date +%H:%M:%S)" >&2
+      timeout 3000 python benchmarks/lm.py --batch 1 --seq 2048 \
+        --layers 40 --d-model 2048 --heads 16 --d-ff 8192 \
+        --remat --ce-chunk 8192 --optimizer adafactor \
+        --arms flash --iters 10 --accept-oom \
+        --out result/lm_tpu_2085m.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# 2.08B lm rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/bench_tpu_done.json ] \
        && [ ! -s result/bench_tpu_r05.json ]; then
       echo "# running fresh r5 headline bench at $(date +%H:%M:%S)" >&2
       CMN_BENCH_PROBE_S=60 CMN_BENCH_BATCH=$BATCH timeout 1800 python bench.py \
@@ -453,6 +483,8 @@ print(float((x@x).sum()))
        && [ -s result/bench_tpu_conv1pallas.json ] \
        && [ -s result/bench_tpu_vit_p14.json ] \
        && [ -s result/bench_tpu_vitb.json ] \
+       && [ -s result/lm_tpu_2700m.json ] \
+       && [ -s result/lm_tpu_2085m.json ] \
        && [ -s result/bench_tpu_r05.json ]; then
       exit 0
     fi
