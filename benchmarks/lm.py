@@ -18,6 +18,22 @@ import json
 import time
 
 
+def artifact_disposition(measured, oom_recorded, retryable, accept_oom):
+    """Should this run's --out artifact land?  (The watcher-wedge contract,
+    unit-tested in tests/examples_tests/test_benchmarks_smoke.py.)
+
+    * any arm measured, no transient → land (the honest partial record);
+    * all arms OOM'd deterministically → land ONLY under --accept-oom
+      (fit-probe stanzas, where the OOM is the answer — withholding would
+      wedge the watcher's file-existence gate into re-running a doomed
+      bench every window);
+    * any transient (non-OOM) failure → withhold, so the watcher retries
+      and a mis-wrapped transient never freezes in as a permanent
+      error-only artifact.
+    """
+    return bool(measured or (oom_recorded and accept_oom)) and not retryable
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=8)
@@ -283,18 +299,11 @@ def main():
     oom_recorded = [
         k for k in ("flash", "xla") if "error" in out.get(k, {})
     ]
-    # A run is COMPLETE when every attempted arm reached a deterministic
-    # outcome: a measurement, or — under --accept-oom only — a recorded
-    # OOM (only ResourceExhausted reaches here without setting
-    # `retryable`).  For a fit-probe stanza the OOM IS the measurement,
-    # and withholding it would wedge the watcher's file-existence gate
-    # into re-running a doomed ~1-h bench every window, forever; for
-    # every other stanza a zero-measurement run stays withheld, so a
-    # mis-wrapped transient at a known-good geometry can't freeze in as
-    # a permanent error-only artifact.
-    complete = bool(
-        measured or (oom_recorded and args.accept_oom)
-    ) and not retryable
+    # Only ResourceExhausted reaches oom_recorded without setting
+    # `retryable`; see artifact_disposition for the landing contract.
+    complete = artifact_disposition(
+        measured, oom_recorded, retryable, args.accept_oom
+    )
     if args.out:
         if complete:
             from chainermn_tpu.utils import atomic_json_dump

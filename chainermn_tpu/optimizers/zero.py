@@ -153,9 +153,19 @@ class ZeroMultiNodeOptimizer:
             # Leaves that inherited the exact 1/N sharding stay; anything
             # else (a transform that built fresh zeros, or a wrong spec) is
             # re-placed through the communicator's multi-host-safe path.
+            # A param-MARKED leaf is only shardable if it actually has the
+            # flat (padded,) layout — optax's factored transforms keep
+            # (1,)-shaped v_row/v_col placeholders for unfactored leaves
+            # (every 1-D flat leaf is unfactored), and those replicate.
             on_param=lambda v: (
                 v if getattr(v, "sharding", None) == sh
-                else self.comm.place(np.asarray(jax.device_get(v)), sh)
+                else (
+                    self.comm.place(np.asarray(jax.device_get(v)), sh)
+                    if self._flat_shardable(v)
+                    else self.comm.replicate(
+                        np.asarray(jax.device_get(v))
+                    )
+                )
             ),
             on_other=self.comm.replicate,
         )
@@ -178,6 +188,19 @@ class ZeroMultiNodeOptimizer:
             opt_state=opt_state,
             model_state=model_state,
             ef_residual=resid,
+        )
+
+    def _flat_shardable(self, v) -> bool:
+        """True iff a param-marked optax state leaf actually has the 1-D
+        flat (padded,) layout and so can carry the 1/N ``data`` sharding.
+        Factored transforms (adafactor) keep (1,)-shaped ``v_row``/``v_col``
+        placeholders for unfactored leaves — 1-D flat leaves are never
+        factored, so every flat leaf's placeholder is exactly that shape —
+        and a (1,) leaf cannot split over n>1 shards: it replicates."""
+        shape = getattr(v, "shape", None)
+        return (
+            shape is not None and len(shape) == 1
+            and shape[0] % self._n == 0
         )
 
     def _map_opt_state(self, opt_state, on_param, on_other):
@@ -343,7 +366,11 @@ class ZeroMultiNodeOptimizer:
             jax.eval_shape(lambda: tx.init(
                 [jnp.zeros((s.padded,), s.dtype) for s in specs]
             )),
-            on_param=lambda _: P(axes),
+            # Same shardability rule as init: factored-transform (1,)
+            # placeholders are param-marked but replicated.
+            on_param=lambda v: (
+                P(axes) if self._flat_shardable(v) else P()
+            ),
             on_other=lambda _: P(),
         )
         state_spec = ZeroTrainState(

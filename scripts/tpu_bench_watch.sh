@@ -414,6 +414,18 @@ print(float((x@x).sum()))
     # (bench.py exits 0 on them) so a failure record can never clobber
     # the known-good done-artifact.
     if [ -s result/bench_tpu_done.json ] \
+       && [ ! -s result/memory_fitprobe_tpu.json ]; then
+      # Compile-only >2B storage-lever A/B (fp32 vs bf16 params at the
+      # 2.6B geometry, step + donated-init programs): minutes, not an
+      # hour — lands the fit/OOM evidence even if the full 2.6B bench
+      # below can't finish inside the window.
+      echo "# running 2.6B fit-probe (compile-only) at $(date +%H:%M:%S)" >&2
+      timeout 2400 python benchmarks/memory.py --fitprobe \
+        --out result/memory_fitprobe_tpu.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# fitprobe rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/bench_tpu_done.json ] \
        && [ ! -s result/lm_tpu_2700m.json ]; then
       # 2.6B ladder point (GPT-3-2.7B geometry, heads=20 so head_dim=128):
       # bf16 param storage (T5-style) — fp32 params OOM even at 2.08B on
@@ -427,6 +439,21 @@ print(float((x@x).sum()))
         --out result/lm_tpu_2700m.json \
         >>result/bench_watch_stderr.log 2>&1
       echo "# 2.6B lm rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/lm_tpu_2700m.json ] \
+       && grep -q step_ms result/lm_tpu_2700m.json \
+       && [ ! -s result/lm_tpu_2700m_t4096.json ]; then
+      # Opportunistic (NOT in the exit gate): if 2.6B trains at T=2048,
+      # probe the long-context point too — the 1.558B family held 31.6%
+      # XLA-counted MFU at T=4096.
+      echo "# running 2.6B T=4096 LM bench at $(date +%H:%M:%S)" >&2
+      timeout 3000 python benchmarks/lm.py --batch 1 --seq 4096 \
+        --layers 32 --d-model 2560 --heads 20 --d-ff 10240 \
+        --remat --ce-chunk 8192 --optimizer adafactor \
+        --param-dtype bfloat16 --arms flash --iters 10 --accept-oom \
+        --out result/lm_tpu_2700m_t4096.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# 2.6B T=4096 lm rc=$? at $(date +%H:%M:%S)" >&2
     fi
     if [ -s result/bench_tpu_done.json ] \
        && [ ! -s result/lm_tpu_2085m.json ]; then
@@ -485,6 +512,7 @@ print(float((x@x).sum()))
        && [ -s result/bench_tpu_vitb.json ] \
        && [ -s result/lm_tpu_2700m.json ] \
        && [ -s result/lm_tpu_2085m.json ] \
+       && [ -s result/memory_fitprobe_tpu.json ] \
        && [ -s result/bench_tpu_r05.json ]; then
       exit 0
     fi

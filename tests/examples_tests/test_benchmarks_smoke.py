@@ -24,6 +24,8 @@ BENCHES = {
     "flash_interpret": ["benchmarks/flash_tpu.py", "--interpret-smoke"],
     "seq2seq": ["benchmarks/seq2seq.py", "--smoke"],
     "longcontext": ["benchmarks/longcontext.py", "--smoke"],
+    "memory_fitprobe": ["benchmarks/memory.py", "--smoke", "--fitprobe",
+                        "--allow-cpu"],
 }
 
 
@@ -55,3 +57,23 @@ def test_benchmark_smoke(name, tmp_path):
     ]
     assert payloads, log[-1000:]
     assert not any("error" in p for p in payloads), payloads
+
+
+def test_lm_artifact_disposition():
+    """The watcher-wedge contract (round-5): land on any measurement or on
+    an all-OOM run under --accept-oom; withhold on transients always."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lm_bench", os.path.join(REPO, "benchmarks", "lm.py")
+    )
+    lm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lm)
+    d = lm.artifact_disposition
+    assert d(["flash"], [], False, False)          # measured → land
+    assert d(["flash"], ["xla"], False, False)     # partial OOM → land
+    assert not d([], ["flash"], False, False)      # all-OOM, no flag → hold
+    assert d([], ["flash"], False, True)           # all-OOM fit-probe → land
+    assert not d([], [], False, True)              # nothing happened → hold
+    assert not d(["flash"], [], True, True)        # transient → always hold
+    assert not d([], ["flash"], True, True)

@@ -221,3 +221,70 @@ def test_optimizer_state_specs_structural():
     assert adam_state.count == P()
     sched_state = specs[1]
     assert sched_state.count == P()
+
+
+def test_zero_mixed_param_dtypes_bf16_storage(devices):
+    """ZeRO over a MIXED-dtype param tree — the bf16-storage LM layout
+    (`TransformerLM(param_dtype=bfloat16)`: bf16 leaves + the fp32 MoE
+    router).  The flat-packing must keep each leaf's dtype through
+    shard/update/materialize, and the sharded update must TRACK the
+    replicated optax oracle — bounded, not exact: in bf16 the 8-shard
+    gradient reduction sums in a different order than the oracle's
+    single-device full-batch gradient, and adafactor's update clipping /
+    parameter-scale multiply amplify that ~1-ulp noise over steps (the
+    fp32 oracle above stays at 3e-5; this is a bf16 property, not a ZeRO
+    one).  Also pins the adafactor regression: optax's factored transforms
+    keep (1,)-shaped v_row/v_col placeholders for unfactored leaves, which
+    are param-MARKED but must replicate, not shard (`_flat_shardable`).
+    This is the combination a >2B multi-chip run uses (bf16 storage for
+    HBM + ZeRO for state scaling)."""
+    from chainermn_tpu.models import TransformerLM, lm_loss
+
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = TransformerLM(vocab=128, n_layers=2, d_model=32, n_heads=4,
+                          d_ff=64, max_len=32, n_experts=4,
+                          param_dtype=jnp.bfloat16)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32), np.int32)
+    )["params"]
+    loss_fn = lm_loss(model)
+    tx = optax.adafactor(1e-2)
+    opt = cmn.create_zero_optimizer(tx, comm)
+    state = opt.init(params)
+    step = opt.make_train_step(loss_fn, has_aux=True)
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 128, size=(16, 32)).astype(np.int32)
+    tgts = np.concatenate(
+        [toks[:, 1:], np.full((16, 1), -1, np.int32)], axis=1
+    )
+    batches = [(toks, tgts)] * 3
+
+    oparams, oopt = params, tx.init(params)
+    for b in batches:
+        (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(oparams, b)
+        up, oopt = tx.update(grads, oopt, oparams)
+        oparams = optax.apply_updates(oparams, up)
+
+    losses = []
+    for b in batches:
+        state, metrics = step(state, comm.shard_batch(b))
+        jax.block_until_ready(state)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses  # it really trains
+
+    got = opt.materialize_params(state)
+    got_flat = jax.tree_util.tree_flatten_with_path(got)[0]
+    want_flat = jax.tree_util.tree_flatten_with_path(oparams)[0]
+    for (pa, a), (pb, b) in zip(got_flat, want_flat):
+        assert a.dtype == b.dtype, (jax.tree_util.keystr(pa), a.dtype)
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=0.1, rtol=0.1,
+        )
+    dts = {
+        jax.tree_util.keystr(p): a.dtype for p, a in got_flat
+    }
+    assert any("router" in k and v == jnp.float32 for k, v in dts.items())
+    assert any(v == jnp.bfloat16 for v in dts.values())
+    assert np.isfinite(float(metrics["loss"]))
