@@ -111,8 +111,12 @@ def _lm_headline() -> dict | None:
 
 
 def _emit(payload: dict) -> None:
+    # ALWAYS recompute: a cached payload embeds the lm_headline as of its
+    # own capture time, but the composite is compiled from result/ on disk
+    # — newer LM captures (e.g. a fresh ladder point landed by a later
+    # watcher window) must win over the snapshot baked into the cache.
     lm = _lm_headline()
-    if lm is not None and "lm_headline" not in payload:
+    if lm is not None:
         payload["lm_headline"] = lm
     print(json.dumps(payload))
 

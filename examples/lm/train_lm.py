@@ -66,6 +66,12 @@ def main():
                          "multi-head; must divide the 4 query heads)")
     ap.add_argument("--window", type=int, default=0,
                     help="sliding-window attention size (0 = full)")
+    ap.add_argument("--param-dtype", default="float32",
+                    choices=("float32", "bfloat16"),
+                    help="parameter STORAGE dtype: bfloat16 halves "
+                         "persistent params+grads HBM (T5-style; pairs "
+                         "with --optimizer adafactor for >2B configs on "
+                         "one chip)")
     args = ap.parse_args()
     if args.generate and 16 + args.generate > args.seq_len and not args.rope:
         # Fail fast, not after the whole training run: the 16-token prompt
@@ -143,6 +149,7 @@ def main():
         vocab=vocab, n_layers=args.layers, d_model=args.d_model,
         n_heads=4, d_ff=4 * args.d_model, max_len=T,
         dtype=jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16,
+        param_dtype=getattr(jnp, args.param_dtype),
         remat=args.remat,
         pos_enc="rope" if args.rope else "learned",
         n_kv_heads=args.kv_heads, window=args.window,
