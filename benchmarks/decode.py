@@ -65,6 +65,12 @@ def main():
                     help="grouped-query attention: kv heads (0 = classic "
                          "MHA) — shrinks the KV cache, decode's dominant "
                          "bandwidth term, by n_heads/kv_heads")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="also time an int8-quantized KV cache arm "
+                         "(kv_dtype=jnp.int8: same params, half the "
+                         "HBM-resident cache bytes) against the float "
+                         "cache in the SAME process; reports the speedup "
+                         "and the greedy-token agreement structure")
     ap.add_argument("--speculative", type=int, default=0, metavar="K",
                     help="also time speculative decoding with K proposals "
                          "per round from a shallow draft model")
@@ -166,9 +172,10 @@ def main():
         )
     )
 
-    def timed(rolling):
+    def timed(rolling, m=None):
+        m = m or model
         gen = jax.jit(
-            lambda p, pr: lm_generate(model, p, pr, args.new,
+            lambda p, pr: lm_generate(m, p, pr, args.new,
                                       rolling=rolling)
         )
         warm = np.asarray(gen(params, prompt))  # compile+warm, value-synced
@@ -342,6 +349,30 @@ def main():
             payload["speculative_sweep"] = spec_recs
         else:
             payload["speculative"] = spec_recs[0]
+    if args.kv_int8:
+        # Same params (kv_dtype only changes cache storage), same prompt,
+        # same process: the ratio isolates the cache-bandwidth halving.
+        # Token agreement vs the float cache is reported with the same
+        # divergence structure as the speculative check — int8 absmax
+        # noise can flip near-argmax-ties, a logic bug flips row 0 step 0.
+        q8_model = model.clone(kv_dtype=jnp.int8)
+        q8_dt, q8_toks = timed(False, m=q8_model)
+        payload["kv_int8"] = {
+            "tokens_per_sec": round(
+                args.batch * args.new * args.iters / q8_dt, 1
+            ),
+            "ms_per_gen_step": round(
+                q8_dt / args.iters / steps * 1000.0, 3
+            ),
+            "speedup_vs_float_cache": round(dt / q8_dt, 3),
+            # k+v int8 payload plus the two fp32 scale planes.
+            "cache_bytes_per_layer": (
+                2 * args.batch * model.max_len
+                * (args.kv_heads or args.heads)
+                * (args.d_model // args.heads + 4)
+            ),
+            "greedy_agreement": _divergence_stats(q8_toks, plain_toks),
+        }
     if rolling_dt is not None:
         payload["rolling"] = {
             "tokens_per_sec": round(

@@ -110,7 +110,7 @@ def lm_beam_search(
         {"params": params}, prompt, cache=cache, decode_pos=0
     )
     cache = [
-        {n: jnp.repeat(c[n], K, axis=0) for n in ("k", "v")} for c in cache
+        {n: jnp.repeat(c[n], K, axis=0) for n in c} for c in cache
     ]
     logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # (B, V)
     V = logp0.shape[-1]
@@ -153,7 +153,7 @@ def lm_beam_search(
             jnp.arange(B)[:, None] * K + parent
         ).reshape(B * K)
         cache = [
-            {n: c[n][flat_parent] for n in ("k", "v")} for c in cache
+            {n: c[n][flat_parent] for n in c} for c in cache
         ]
         return (nxt, scores, alive, lengths, cache), (nxt, parent)
 

@@ -197,23 +197,64 @@ class _DecoderBlock(nn.Module):
                 # re-rotation (RoPE's relative property does the rest).
                 q = apply_rope(q, tables=rope)
                 k = apply_rope(k, tables=rope)
+            # int8-quantized cache (``TransformerLM.kv_dtype=jnp.int8``,
+            # detected by the scale entries ``init_cache`` adds): each
+            # written (token, kv-head) row stores symmetric-absmax int8
+            # values plus one fp32 scale — the HBM-RESIDENT cache is half
+            # the bf16 bytes (decode is measured KV-bandwidth-bound:
+            # result/decode_tpu_b64.json, decode_tpu_gqa.json), and twice
+            # the context/batch fits.  Dequantization never materializes a
+            # float cache: the k scale folds into the score einsum's
+            # output, the v scale into the probability operand.
+            quant = "k_scale" in cache
+            if quant:
+                kf = k.astype(jnp.float32)
+                vf = v.astype(jnp.float32)
+                k_scale = jnp.maximum(
+                    jnp.max(jnp.abs(kf), axis=-1), 1e-6
+                ) / 127.0  # (B, T, KH)
+                v_scale = jnp.maximum(
+                    jnp.max(jnp.abs(vf), axis=-1), 1e-6
+                ) / 127.0
+                k_w = jnp.clip(
+                    jnp.round(kf / k_scale[..., None]), -127, 127
+                ).astype(jnp.int8)
+                v_w = jnp.clip(
+                    jnp.round(vf / v_scale[..., None]), -127, 127
+                ).astype(jnp.int8)
+            else:
+                # Float cache: cast to the cache's storage dtype (kv_dtype
+                # may differ from the compute dtype — e.g. store bf16 under
+                # fp32 compute).
+                k_w = k.astype(cache["k"].dtype)
+                v_w = v.astype(cache["v"].dtype)
             write_pos = (
                 decode_pos % self.window if rolling else decode_pos
             )
             if jnp.ndim(decode_pos) == 0:
                 kc = lax.dynamic_update_slice(
-                    cache["k"], k, (0, write_pos, 0, 0)
+                    cache["k"], k_w, (0, write_pos, 0, 0)
                 )
                 vc = lax.dynamic_update_slice(
-                    cache["v"], v, (0, write_pos, 0, 0)
+                    cache["v"], v_w, (0, write_pos, 0, 0)
                 )
+                if quant:
+                    ks_c = lax.dynamic_update_slice(
+                        cache["k_scale"], k_scale, (0, write_pos, 0)
+                    )
+                    vs_c = lax.dynamic_update_slice(
+                        cache["v_scale"], v_scale, (0, write_pos, 0)
+                    )
             else:
                 # Per-row chunk scatter: row r writes its T slots starting
                 # at write_pos[r].
                 rows = jnp.arange(B)[:, None]
                 cols = write_pos[:, None] + jnp.arange(T)[None]
-                kc = cache["k"].at[rows, cols].set(k)
-                vc = cache["v"].at[rows, cols].set(v)
+                kc = cache["k"].at[rows, cols].set(k_w)
+                vc = cache["v"].at[rows, cols].set(v_w)
+                if quant:
+                    ks_c = cache["k_scale"].at[rows, cols].set(k_scale)
+                    vs_c = cache["v_scale"].at[rows, cols].set(v_scale)
             # Grouped attention against the (B, L, KH, Dh) cache: query head
             # h reads kv head h // (H // KH).  KH == H reduces to classic
             # multi-head (group axis of size 1).
@@ -223,6 +264,10 @@ class _DecoderBlock(nn.Module):
                 "bqkgd,btkd->bkgqt", qg.astype(jnp.float32),
                 kc.astype(jnp.float32),
             ) / math.sqrt(D // H)
+            if quant:
+                # Per-(t, kv-head) k scale commutes out of the head_dim
+                # contraction: apply it on the (b, k, g, q, t) scores.
+                s = s * jnp.transpose(ks_c, (0, 2, 1))[:, :, None, None, :]
             t_idx = jnp.arange(kc.shape[1])
             if rolling:
                 # Slot s holds absolute position pos − ((pos − s) mod W):
@@ -249,10 +294,17 @@ class _DecoderBlock(nn.Module):
                     )
             s = jnp.where(visible, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
+            if quant:
+                # v scale folds into the probability operand (per t, kv
+                # head) — the int8 cache feeds the einsum directly.
+                p = p * jnp.transpose(vs_c, (0, 2, 1))[:, :, None, None, :]
             a = jnp.einsum(
                 "bkgqt,btkd->bqkgd", p, vc.astype(jnp.float32)
             ).reshape(q.shape[0], T, H, D // H).astype(q.dtype)
-            new_cache = {"k": kc, "v": vc}
+            new_cache = (
+                {"k": kc, "v": vc, "k_scale": ks_c, "v_scale": vs_c}
+                if quant else {"k": kc, "v": vc}
+            )
         else:
             if self.attention not in ("flash", "xla", "auto"):
                 raise ValueError(
@@ -412,6 +464,15 @@ class TransformerLM(nn.Module):
     #: 1 → multi-query).  Must divide ``n_heads``; shrinks the generation
     #: KV cache (and the k/v projection) by ``n_heads // n_kv_heads``.
     n_kv_heads: int = 0
+    #: KV-cache STORAGE dtype (decode only; ``None`` → the compute dtype).
+    #: ``jnp.int8`` stores each written (token, kv-head) row as
+    #: symmetric-absmax int8 plus one fp32 scale: the HBM-resident cache
+    #: halves vs bf16 (decode throughput is measured KV-bandwidth-bound —
+    #: ``result/decode_tpu_b64.json``/``decode_tpu_gqa.json``), and twice
+    #: the context or decode batch fits.  Composes with GQA (`n_kv_heads`)
+    #: multiplicatively.  Training is untouched — quantization happens at
+    #: cache-write time, never on the flash/xla training paths.
+    kv_dtype: Any = None
     #: sliding-window attention size (0 → full): each position attends only
     #: the previous ``window`` positions, in training (flash kernel skips
     #: out-of-window blocks — O(T·window)) AND in KV-cache decode (same
@@ -547,13 +608,29 @@ class TransformerLM(nn.Module):
         ``(batch, max_len, kv_heads, head_dim)`` in the compute dtype —
         ``n_heads // n_kv_heads``-fold smaller under grouped-query
         attention (the main GQA payoff: longer contexts / bigger decode
-        batches fit in HBM)."""
+        batches fit in HBM).  With ``kv_dtype=jnp.int8`` the entries are
+        int8 plus per-(token, kv-head) fp32 ``{"k_scale","v_scale"}`` of
+        shape ``(batch, max_len, kv_heads)`` — half the bf16 bytes (the
+        scale adds 2/head_dim fp32 words per row)."""
         L = max_len or self.max_len
         kvh = self.n_kv_heads or self.n_heads
         shape = (batch, L, kvh, self.d_model // self.n_heads)
+        kvd = self.kv_dtype if self.kv_dtype is not None else self.dtype
+        if jnp.dtype(kvd) == jnp.int8:
+            return [
+                {"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "k_scale": jnp.zeros(shape[:3], jnp.float32),
+                 "v_scale": jnp.zeros(shape[:3], jnp.float32)}
+                for _ in range(self.n_layers)
+            ]
+        if not jnp.issubdtype(jnp.dtype(kvd), jnp.floating):
+            raise ValueError(
+                f"kv_dtype must be a float dtype or jnp.int8, got {kvd}"
+            )
         return [
-            {"k": jnp.zeros(shape, self.dtype),
-             "v": jnp.zeros(shape, self.dtype)}
+            {"k": jnp.zeros(shape, kvd),
+             "v": jnp.zeros(shape, kvd)}
             for _ in range(self.n_layers)
         ]
 
@@ -730,7 +807,7 @@ def lm_generate(
         pos_s = (P - 1) - ((P - 1 - sl) % W)
         safe = jnp.clip(pos_s, 0, P - 1)
         cache = [
-            {"k": c["k"][:, safe], "v": c["v"][:, safe]} for c in cache
+            {n: c[n][:, safe] for n in c} for c in cache
         ]
 
     def body(carry, i):
