@@ -74,6 +74,14 @@ def main():
                          "persistent params+grads bytes (adafactor stats "
                          "follow) — the storage lever for >2B configs, "
                          "where fp32 params OOM on the 15.75 GB chip")
+    ap.add_argument("--lora", type=int, default=0, metavar="RANK",
+                    help="LoRA fine-tuning step instead of full training: "
+                         "frozen base params (in --param-dtype storage), "
+                         "rank-RANK adapters on the attention projections, "
+                         "optimizer state on the adapters only. Measures "
+                         "the fine-tuning step time/MFU and records the "
+                         "trainable-param fraction — the fits-where-full-"
+                         "training-can't tier for >6B on one chip")
     ap.add_argument("--accept-oom", action="store_true",
                     help="an all-arms-OOM run still writes --out (the OOM "
                          "is the answer for a does-this-geometry-fit "
@@ -160,7 +168,47 @@ def main():
         params = jax.jit(
             lambda r: model.init(r, jnp.zeros((1, args.seq), jnp.int32))
         )(jax.random.PRNGKey(0))["params"]
-        if jax.process_count() > 1:
+        base_params = None
+        inner_loss = (
+            lm_loss_chunked(model, chunk_size=args.ce_chunk)
+            if args.ce_chunk
+            else lm_loss(model)
+        )
+        if args.lora:
+            # Fine-tuning tier: the optimizer's tree is the ADAPTER tree;
+            # the frozen base stays alive as a closure constant of the
+            # loss (so no donation / no drop — it must survive every
+            # step).  Persistent memory: base params + rank-sized
+            # adapters + adapter-sized opt state.
+            from chainermn_tpu.models import (
+                lora_init,
+                lora_param_count,
+                make_lora_loss,
+            )
+
+            base_params = params
+            lora = jax.block_until_ready(jax.jit(
+                lambda r: lora_init(r, base_params, rank=args.lora)
+            )(jax.random.PRNGKey(1)))
+            out["lora"] = {
+                "rank": args.lora,
+                "trainable_params": lora_param_count(lora),
+                "total_params": sum(
+                    int(x.size)
+                    for x in jax.tree_util.tree_leaves(base_params)
+                ),
+            }
+            # Same multi-host rule as the full-training path below:
+            # opt.init goes through make_array_from_callback there, which
+            # cannot run under a trace.
+            state = (
+                opt.init(lora)
+                if jax.process_count() > 1
+                else jax.block_until_ready(jax.jit(opt.init)(lora))
+            )
+            params = None
+            loss_fn = make_lora_loss(inner_loss, base_params)
+        elif jax.process_count() > 1:
             # Multi-host placement goes through make_array_from_callback,
             # which cannot run under a trace.
             state = opt.init(params)
@@ -180,11 +228,8 @@ def main():
                 jax.jit(opt.init, donate_argnums=0)(params)
             )
             params = None
-        loss_fn = (
-            lm_loss_chunked(model, chunk_size=args.ce_chunk)
-            if args.ce_chunk
-            else lm_loss(model)
-        )
+        if not args.lora:
+            loss_fn = inner_loss
         step = opt.make_train_step(loss_fn, has_aux=True,
                                    accum_steps=args.accum)
 
@@ -251,8 +296,8 @@ def main():
         # exceeded the 15.75 GB chip (RESOURCE_EXHAUSTED at the second
         # opt.init, 2026-08-01), killing the run after the flash number
         # had already been measured.
-        held = jax.tree.leaves((params, state))
-        del params, state, step, compiled
+        held = jax.tree.leaves((params, state, base_params))
+        del params, state, step, compiled, base_params
         for a in held:
             try:
                 a.delete()

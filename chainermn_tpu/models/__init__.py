@@ -56,6 +56,12 @@ from chainermn_tpu.models.decoding import (
     lm_beam_search,
     lm_speculative_generate,
 )
+from chainermn_tpu.models.lora import (
+    lora_init,
+    lora_merge,
+    lora_param_count,
+    make_lora_loss,
+)
 
 __all__ = [
     "MLP",
@@ -85,6 +91,10 @@ __all__ = [
     "lm_speculative_generate",
     "lm_loss",
     "lm_loss_chunked",
+    "lora_init",
+    "lora_merge",
+    "lora_param_count",
+    "make_lora_loss",
     "ParallelLM",
     "ParallelLMConfig",
     "init_parallel_lm",

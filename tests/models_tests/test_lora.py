@@ -1,0 +1,232 @@
+"""LoRA fine-tuning (``models.lora``): adapter init/merge/loss transform.
+
+Contract: (a) zero-init B means step-0 outputs are BIT-IDENTICAL to the
+base model; (b) gradients and optimizer state exist only for the adapter
+leaves and the base tree never changes; (c) the merged export equals the
+runtime-merged function; (d) the transform composes with the SPMD
+optimizer (DP mesh), GQA's split q/kv projections, chunked CE, and bf16
+base storage.
+
+No reference counterpart (SURVEY §2.3 covers full-parameter parallelism
+only) — beyond-parity on the training stack.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models import (
+    TransformerLM,
+    lm_loss,
+    lm_loss_chunked,
+    lora_init,
+    lora_merge,
+    lora_param_count,
+    make_lora_loss,
+)
+from chainermn_tpu.models.lora import DEFAULT_TARGETS
+
+
+def _model(**kw):
+    kw.setdefault("vocab", 50)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("d_ff", 64)
+    kw.setdefault("max_len", 16)
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("attention", "xla")
+    return TransformerLM(**kw)
+
+
+def _base(model, T=16):
+    return model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, T), jnp.int32)
+    )["params"]
+
+
+def _toks(B=2, T=16, vocab=50, seed=1):
+    return jnp.asarray(
+        np.random.RandomState(seed).randint(0, vocab, (B, T)).astype(
+            np.int32
+        )
+    )
+
+
+def test_zero_init_is_identity():
+    """B = 0 -> merged params equal base params exactly, so the adapted
+    model's step-0 logits are bit-identical to the base model's."""
+    model = _model()
+    base = _base(model)
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=4)
+    merged = lora_merge(base, lora)
+    toks = _toks()
+    a = model.apply({"params": base}, toks)
+    b = model.apply({"params": merged}, toks)
+    assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_adapter_structure_and_count():
+    model = _model()
+    base = _base(model)
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=4)
+    # MHA layout: fused qkv + proj per block, nothing else.
+    assert set(lora) == {"block_0", "block_1"}
+    assert set(lora["block_0"]) == {"qkv", "proj"}
+    # qkv kernel (32, 3, 4, 8): in 32, out 96; proj kernel (4, 8, 32):
+    # in 32, out 32.
+    assert lora["block_0"]["qkv"]["a"].shape == (32, 4)
+    assert lora["block_0"]["qkv"]["b"].shape == (4, 96)
+    assert lora["block_0"]["proj"]["a"].shape == (32, 4)
+    assert lora["block_0"]["proj"]["b"].shape == (4, 32)
+    assert lora_param_count(lora) == 2 * (
+        (32 * 4 + 4 * 96) + (32 * 4 + 4 * 32)
+    )
+
+
+def test_gqa_split_projections_targeted():
+    """GQA models split the fused qkv into q + kv — both get adapters."""
+    model = _model(n_kv_heads=2)
+    base = _base(model)
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=2)
+    assert set(lora["block_0"]) == {"q", "kv", "proj"}
+
+
+def test_merge_matches_manual_delta():
+    """Merged kernel == base + (alpha/rank) * (A @ B) reshaped."""
+    model = _model()
+    base = _base(model)
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=4)
+    # Give B real values so the delta is nonzero.
+    lora = jax.tree_util.tree_map(
+        lambda x: x + 0.01 * np.random.RandomState(0).randn(*x.shape), lora
+    )
+    merged = lora_merge(base, lora, alpha=8)
+    k0 = base["block_0"]["qkv"]["kernel"]
+    d0 = (lora["block_0"]["qkv"]["a"] @ lora["block_0"]["qkv"]["b"])
+    want = np.asarray(k0) + 2.0 * np.asarray(d0).reshape(k0.shape)
+    np.testing.assert_allclose(
+        np.asarray(merged["block_0"]["qkv"]["kernel"]), want, rtol=1e-6
+    )
+    # Non-targeted leaves pass through as the SAME arrays (no copy).
+    assert merged["embed"]["embedding"] is base["embed"]["embedding"]
+    assert (
+        merged["block_0"]["ff1"]["kernel"]
+        is base["block_0"]["ff1"]["kernel"]
+    )
+
+
+def test_grads_only_on_adapters_and_training_moves_loss():
+    """End-to-end on the 8-device DP mesh: optimizer state is built over
+    the ADAPTER tree only, training reduces the loss, and the base tree
+    is bitwise untouched."""
+    import optax
+
+    comm = cmn.create_communicator("flat")
+    model = _model()
+    base = _base(model)
+    base_snapshot = jax.tree_util.tree_map(np.asarray, base)
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=4)
+    loss_fn = make_lora_loss(lm_loss(model), base)
+
+    opt = cmn.create_multi_node_optimizer(optax.adam(1e-2), comm)
+    state = opt.init(lora)
+    step = opt.make_train_step(loss_fn, has_aux=True)
+    toks = _toks(B=8)
+    batch = comm.shard_batch((toks, toks))
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    # Optimizer params == adapter tree shape (nothing for the base).
+    trained = jax.tree_util.tree_map(np.asarray, state.params)
+    assert set(trained) == set(lora)
+    # The base never changed.
+    for a, b in zip(
+        jax.tree_util.tree_leaves(base_snapshot),
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, base)
+        ),
+    ):
+        assert (a == b).all()
+    # And training actually moved the adapters (B leaves are nonzero now).
+    assert float(np.abs(trained["block_0"]["qkv"]["b"]).max()) > 0
+
+
+def test_composes_with_chunked_ce_and_bf16_base():
+    """The >2B recipe: bf16 base storage + chunked CE under the LoRA
+    transform (fp32 adapters, bf16 delta cast at merge)."""
+    import optax
+
+    comm = cmn.create_communicator("flat")
+    model = _model(param_dtype=jnp.bfloat16, dtype=jnp.bfloat16)
+    base = _base(model)
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=4)
+    loss_fn = make_lora_loss(lm_loss_chunked(model, chunk_size=16), base)
+    opt = cmn.create_multi_node_optimizer(optax.adam(1e-2), comm)
+    state = opt.init(lora)
+    step = opt.make_train_step(loss_fn, has_aux=True)
+    toks = _toks(B=8)
+    batch = comm.shard_batch((toks, toks))
+    l0 = None
+    for _ in range(6):
+        state, metrics = step(state, batch)
+        l0 = l0 or float(metrics["loss"])
+    assert float(metrics["loss"]) < l0
+    # Adapters stay fp32 even under a bf16 base.
+    assert state.params["block_0"]["qkv"]["a"].dtype == jnp.float32
+
+
+def test_merged_export_equals_runtime_merge():
+    """lora_merge(base, trained) is a plain params tree: applying the
+    model to it reproduces the adapted function exactly (export path)."""
+    model = _model()
+    base = _base(model)
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=4)
+    lora = jax.tree_util.tree_map(
+        lambda x: x + 0.02 * np.random.RandomState(1).randn(*x.shape), lora
+    )
+    toks = _toks()
+    via_loss_path = model.apply({"params": lora_merge(base, lora)}, toks)
+    exported = jax.tree_util.tree_map(jnp.asarray, lora_merge(base, lora))
+    via_export = model.apply({"params": exported}, toks)
+    np.testing.assert_allclose(
+        np.asarray(via_loss_path), np.asarray(via_export), rtol=1e-6
+    )
+
+
+def test_seq2seq_proj_name_collision_clamps():
+    """The seq2seq vocab head is ALSO named ``proj`` but is a 2-D Dense
+    kernel: the (heads, head_dim) split clamps back to (in, out) instead
+    of crashing, and the adapted model still equals the base at zero init
+    (review finding r5s4)."""
+    from chainermn_tpu.models import TransformerSeq2Seq
+
+    model = TransformerSeq2Seq(vocab_src=30, vocab_tgt=30, d_model=32,
+                               n_heads=4, d_ff=64, n_enc=1, n_dec=1,
+                               max_len=16)
+    src = jnp.ones((2, 8), jnp.int32)
+    tgt = jnp.ones((2, 8), jnp.int32)
+    base = model.init(jax.random.PRNGKey(0), src, tgt)["params"]
+    lora = lora_init(jax.random.PRNGKey(1), base, rank=2)
+    a = model.apply({"params": base}, src, tgt)
+    b = model.apply({"params": lora_merge(base, lora)}, src, tgt)
+    assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_validation_errors():
+    model = _model()
+    base = _base(model)
+    with pytest.raises(ValueError, match="rank"):
+        lora_init(jax.random.PRNGKey(0), base, rank=0)
+    with pytest.raises(ValueError, match="no kernels matched"):
+        lora_init(jax.random.PRNGKey(0), base, rank=2,
+                  targets=("nonexistent",))
+
+
+def test_default_targets_cover_both_attention_layouts():
+    assert set(DEFAULT_TARGETS) == {"qkv", "q", "kv", "proj"}
