@@ -63,9 +63,41 @@ print(float((x@x).sum()))
         >>result/bench_watch_stderr.log 2>&1
       echo "# 6.7B lm rc=$? at $(date +%H:%M:%S)" >&2
     fi
+    if [ -s result/lm_tpu_2700m.json ] \
+       && [ ! -s result/lm_tpu_2700m_lora.json ]; then
+      # LoRA-vs-full A/B at the 2.6B headline geometry: same model, same
+      # step shape, adapters-only training — measures the backward's
+      # skipped frozen-weight grad matmuls and the fine-tuning tier's
+      # step time against the 320.2 ms full-training capture.
+      echo "# running 2.6B LoRA fine-tune bench at $(date +%H:%M:%S)" >&2
+      timeout 3000 python benchmarks/lm.py --batch 1 --seq 2048 \
+        --layers 32 --d-model 2560 --heads 20 --d-ff 10240 \
+        --remat --ce-chunk 8192 --optimizer adafactor \
+        --param-dtype bfloat16 --arms flash --iters 10 --accept-oom \
+        --lora 16 --out result/lm_tpu_2700m_lora.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# 2.6B lora rc=$? at $(date +%H:%M:%S)" >&2
+    fi
+    if [ -s result/lm_tpu_6700m.json ] \
+       && [ ! -s result/lm_tpu_6700m_lora.json ]; then
+      # The fine-tuning tier at the wall: if the full 6.7B step OOM'd,
+      # LoRA (no full-size grads at the optimizer boundary, adapter-only
+      # state) is the config that should still fit; if full fit, this
+      # measures the step-time saving.
+      echo "# running 6.7B LoRA fine-tune bench at $(date +%H:%M:%S)" >&2
+      timeout 3600 python benchmarks/lm.py --batch 1 --seq 2048 \
+        --layers 32 --d-model 4096 --heads 32 --d-ff 16384 \
+        --remat --ce-chunk 8192 --optimizer adafactor \
+        --param-dtype bfloat16 --arms flash --iters 10 --accept-oom \
+        --lora 16 --out result/lm_tpu_6700m_lora.json \
+        >>result/bench_watch_stderr.log 2>&1
+      echo "# 6.7B lora rc=$? at $(date +%H:%M:%S)" >&2
+    fi
     if [ -s result/decode_tpu_kvint8.json ] \
        && [ -s result/decode_tpu_kvint8_gqa.json ] \
-       && [ -s result/lm_tpu_6700m.json ]; then
+       && [ -s result/lm_tpu_6700m.json ] \
+       && [ -s result/lm_tpu_2700m_lora.json ] \
+       && [ -s result/lm_tpu_6700m_lora.json ]; then
       exit 0
     fi
   else
