@@ -72,7 +72,21 @@ def main():
                          "persistent params+grads HBM (T5-style; pairs "
                          "with --optimizer adafactor for >2B configs on "
                          "one chip)")
+    ap.add_argument("--lora", type=int, default=0, metavar="RANK",
+                    help="LoRA fine-tuning: freeze the base params after "
+                         "init and train rank-RANK adapters on the "
+                         "attention projections only (optimizer state, "
+                         "grads and allreduce wire are adapter-sized); "
+                         "--eval/--generate run on the merged export")
     args = ap.parse_args()
+    if args.lora and args.zero:
+        # ZeRO shards the OPTIMIZER tree; with LoRA that tree is the tiny
+        # adapter set while the frozen base stays replicated — sharding
+        # kilobytes defeats the point and materialize_params would return
+        # adapters, not params.  Keep the tiers orthogonal.
+        ap.error("--lora and --zero are mutually exclusive (the adapter "
+                 "tree is too small to shard; the frozen base is "
+                 "replicated either way)")
     if args.generate and 16 + args.generate > args.seq_len and not args.rope:
         # Fail fast, not after the whole training run: the 16-token prompt
         # plus the generated tokens must fit the learned table's max_len
@@ -176,10 +190,30 @@ def main():
         if args.zero
         else cmn.create_multi_node_optimizer(tx, comm)
     )
-    state = opt.init(params)
-    step = opt.make_train_step(
-        lm_loss(model), has_aux=True, accum_steps=args.accum
-    )
+    if args.lora:
+        from chainermn_tpu.models import (
+            lora_init,
+            lora_merge,
+            lora_param_count,
+            make_lora_loss,
+        )
+
+        base_params = params
+        lora = lora_init(jax.random.PRNGKey(1), base_params, rank=args.lora)
+        if jax.process_index() == 0:
+            print(f"lora rank {args.lora}: {lora_param_count(lora)} "
+                  f"trainable / {lora_param_count(base_params)} total "
+                  "params")
+        state = opt.init(lora)
+        step = opt.make_train_step(
+            make_lora_loss(lm_loss(model), base_params),
+            has_aux=True, accum_steps=args.accum,
+        )
+    else:
+        state = opt.init(params)
+        step = opt.make_train_step(
+            lm_loss(model), has_aux=True, accum_steps=args.accum
+        )
 
     for i in range(args.steps):
         batch = next(it)
@@ -194,9 +228,14 @@ def main():
     # this is a full cross-device param all-gather; don't repeat it).
     full_params = None
     if args.eval or args.generate:
-        full_params = (
-            opt.materialize_params(state) if args.zero else state.params
-        )
+        if args.lora:
+            # Merged export: a plain params tree — eval and decode run
+            # exactly as they would on a fully fine-tuned model.
+            full_params = lora_merge(base_params, state.params)
+        else:
+            full_params = (
+                opt.materialize_params(state) if args.zero else state.params
+            )
     if args.eval:
         from chainermn_tpu.extensions import (
             Evaluator,

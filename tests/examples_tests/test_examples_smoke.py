@@ -41,6 +41,9 @@ EXAMPLES = {
                          "--generate", "8"],
     "lm_zero": ["examples/lm/train_lm.py", "--steps", "4", "--layers", "1",
                 "--d-model", "64", "--seq-len", "64", "--zero"],
+    "lm_lora": ["examples/lm/train_lm.py", "--steps", "4", "--layers", "1",
+                "--d-model", "64", "--seq-len", "64", "--lora", "4",
+                "--eval", "--generate", "8"],
     "seq2seq": ["examples/seq2seq/seq2seq.py", "--force-cpu", "--epoch", "1",
                 "--batchsize", "64", "--embed", "16", "--hidden", "32"],
     "seq2seq_transformer": ["examples/seq2seq/seq2seq.py", "--force-cpu",
