@@ -54,70 +54,118 @@ BASELINE_PROVENANCE = {
 # use time — this module must stay importable before the device probe).
 
 
-def _lm_headline() -> dict | None:
-    """The LM family's strongest on-chip capture, embedded in every payload.
+def _best_result(pattern: str, candidates) -> dict | None:
+    """Shared composite-headline scaffold: scan ``result/`` artifacts
+    matching ``pattern``, keep the highest-keyed candidate.
 
-    The repo's best measured number is LM training MFU (50.59% incl. flash
-    at 1.558B on one chip), but the driver's mechanical capture only ever
-    saw the ResNet top-level value (VERDICT r4 weak #8) — so the composite
-    payload carries the best ``result/lm_tpu*.json`` arm with full
-    provenance.  Selection key is ``mfu_pct_incl_flash`` when the artifact
-    carries it (flash-core FLOPs are invisible to XLA's ``cost_analysis``;
-    artifacts predating the corrected accounting only have the XLA-counted
-    lower bound ``mfu_pct``, which stays comparable).  Cached by
-    construction (these captures come from the watcher's tunnel windows,
-    not this process); ``artifact`` + ``cached`` say so explicitly.
+    ``candidates(rec)`` yields ``(key, fields)`` pairs per on-chip record;
+    the winner is returned with shared provenance (``artifact`` path,
+    ``device_kind``, ``measured_at``, ``cached: True`` — these captures
+    come from the watcher's tunnel windows, not this process).
     """
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
     best = None
     best_key = None
-    for path in sorted(glob.glob(os.path.join(here, "result/lm_tpu*.json"))):
+    for path in sorted(glob.glob(os.path.join(here, "result", pattern))):
         try:
             with open(path) as f:
                 rec = json.load(f)
             if rec.get("platform") != "tpu":
                 continue
-            for impl in ("flash", "xla"):
-                arm = rec.get(impl, {})
-                mfu = arm.get("mfu_pct_incl_flash", arm.get("mfu_pct"))
-                if mfu is None:
+            for key, fields in candidates(rec):
+                if key is None or (best is not None and key <= best_key):
                     continue
-                if best is None or mfu > best_key:
-                    best_key = mfu
-                    best = {
-                        "metric": "lm_train_mfu_pct",
-                        "mfu_pct": arm.get("mfu_pct"),
-                        "mfu_pct_incl_flash": arm.get("mfu_pct_incl_flash"),
-                        "tokens_per_sec_per_chip": arm.get(
-                            "tokens_per_sec_per_chip"
-                        ),
-                        "step_ms": arm.get("step_ms"),
-                        "attention": impl,
-                        "config": rec.get("config"),
-                        "device_kind": rec.get("device_kind"),
-                        "artifact": os.path.relpath(path, here),
-                        "measured_at": rec.get(
-                            "measured_at",
-                            "unstamped; see result/README.md for the "
-                            "capture log",
-                        ),
-                        "cached": True,
-                    }
+                best_key = key
+                best = dict(
+                    fields,
+                    device_kind=rec.get("device_kind"),
+                    artifact=os.path.relpath(path, here),
+                    measured_at=rec.get(
+                        "measured_at",
+                        "unstamped; see result/README.md for the "
+                        "capture log",
+                    ),
+                    cached=True,
+                )
         except Exception:
             continue
     return best
 
 
+def _lm_headline() -> dict | None:
+    """The LM family's strongest on-chip capture, embedded in every payload.
+
+    The repo's best measured number is LM training MFU, but the driver's
+    mechanical capture only ever saw the ResNet top-level value (VERDICT
+    r4 weak #8) — so the composite payload carries the best
+    ``result/lm_tpu*.json`` arm with full provenance.  Selection key is
+    ``mfu_pct_incl_flash`` when the artifact carries it (flash-core FLOPs
+    are invisible to XLA's ``cost_analysis``; artifacts predating the
+    corrected accounting only have the XLA-counted lower bound
+    ``mfu_pct``, which stays comparable).
+    """
+
+    def cands(rec):
+        for impl in ("flash", "xla"):
+            arm = rec.get(impl, {})
+            mfu = arm.get("mfu_pct_incl_flash", arm.get("mfu_pct"))
+            if mfu is None:
+                continue
+            yield mfu, {
+                "metric": "lm_train_mfu_pct",
+                "mfu_pct": arm.get("mfu_pct"),
+                "mfu_pct_incl_flash": arm.get("mfu_pct_incl_flash"),
+                "tokens_per_sec_per_chip": arm.get(
+                    "tokens_per_sec_per_chip"
+                ),
+                "step_ms": arm.get("step_ms"),
+                "attention": impl,
+                "config": rec.get("config"),
+            }
+
+    return _best_result("lm_tpu*.json", cands)
+
+
+def _decode_headline() -> dict | None:
+    """The decode family's strongest on-chip generated-tokens/sec, same
+    composite policy as :func:`_lm_headline`.  The glob covers every
+    decode artifact family (``decode_tpu*``, ``decode_spec*``,
+    ``decode_streaming*``); embedded arms (``kv_int8``, ``rolling``,
+    ``speculative``) compete against the plain number with a tag saying
+    which arm won."""
+
+    def cands(rec):
+        if rec.get("metric") != "lm_decode_tokens_per_sec":
+            return
+        arms = [(rec.get("value"), "plain")]
+        for arm in ("kv_int8", "rolling", "speculative"):
+            if isinstance(rec.get(arm), dict):
+                arms.append((rec[arm].get("tokens_per_sec"), arm))
+        for tps, arm in arms:
+            yield tps, {
+                "metric": "lm_decode_tokens_per_sec",
+                "tokens_per_sec": tps,
+                "arm": arm,
+                "batch": rec.get("batch"),
+                "config": rec.get("config"),
+            }
+
+    return _best_result("decode*tpu*.json", cands)
+
+
 def _emit(payload: dict) -> None:
-    # ALWAYS recompute: a cached payload embeds the lm_headline as of its
+    # ALWAYS recompute: a cached payload embeds the headlines as of its
     # own capture time, but the composite is compiled from result/ on disk
-    # — newer LM captures (e.g. a fresh ladder point landed by a later
+    # — newer captures (e.g. a fresh ladder point landed by a later
     # watcher window) must win over the snapshot baked into the cache.
     lm = _lm_headline()
     if lm is not None:
         payload["lm_headline"] = lm
+    dec = _decode_headline()
+    if dec is not None:
+        payload["decode_headline"] = dec
     print(json.dumps(payload))
 
 
