@@ -1,0 +1,140 @@
+"""Fused single-position decode attention — Pallas TPU kernel.
+
+The KV-cache generation step is bandwidth-bound: each token reads the
+whole cache for a (B, heads) set of matvecs (measured:
+``result/decode_tpu_b64.json`` vs ``decode_tpu_gqa.json`` — throughput
+follows cache bytes, 3.54× from GQA's shrink alone).  The XLA einsum
+path (`models/transformer.py` `_DecoderBlock` decode branch) converts
+the cache to fp32 for the score/value einsums and makes two passes; this
+kernel streams each K/V byte through VMEM ONCE at its storage width
+(bf16, or int8 with the per-(position, kv-head) scales dequantized
+in-register) and fuses score → mask → softmax → value-weighting in one
+program.
+
+Layout: the fused path stores the cache **(B, KH, L, Dh)** (kv-head
+major) so each grid program ``(b, kh)`` reads a contiguous ``(L, Dh)``
+panel — `TransformerLM(decode_attention="fused")` selects this layout in
+``init_cache`` and the block's write path.  Grid ``(B, KH)``; each
+program stages its panel in VMEM (L·Dh·itemsize — ~1 MB at L=4096,
+Dh=128 bf16), computes the G=H/KH query heads' scores against it, masks
+positions ``>= valid_len`` (causality at decode = a length bound), and
+writes the (G, Dh) output block.  One-shot softmax — no online
+recurrence needed since L fits VMEM for every decode-practical length;
+lengths beyond the VMEM budget fall back to the einsum path upstream.
+
+No reference counterpart (the reference has no incremental-decode stack;
+SURVEY §2.9's examples are training-side) — this extends the repo's
+Pallas hot-op family (``ops/flash_attention.py``) to the inference loop.
+On non-TPU backends the kernel runs in Pallas interpret mode, so the CPU
+suite pins numerics against the einsum oracle.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from chainermn_tpu.ops.flash_attention import NEG_INF, _use_interpret
+
+#: stage-whole-panel VMEM budget: k + v panels at Dh=128 bf16 hit ~4 MB
+#: at this L; callers fall back to the einsum path past it.
+MAX_FUSED_LEN = 16384
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest, scale, quant):
+    """One (batch row, kv head): q (1,1,G,Dh) vs the (1,1,L,Dh) panel."""
+    if quant:
+        ks_ref, vs_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
+    G = q_ref.shape[2]
+    L = k_ref.shape[2]
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (G, Dh)
+    k = k_ref[0, 0].astype(jnp.float32)          # (L, Dh) — int8 or float
+    v = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (G, L)
+    if quant:
+        # Per-position k scale commutes out of the Dh contraction; v scale
+        # folds into the probability operand below.
+        s = s * ks_ref[0, 0, :, 0][None, :]
+    valid = len_ref[0, 0, 0, 0]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (G, L), 1)
+    s = jnp.where(pos < valid, s, NEG_INF)
+    m = jnp.max(s, axis=1)
+    p = jnp.exp(s - m[:, None])
+    l = jnp.sum(p, axis=1)
+    if quant:
+        p = p * vs_ref[0, 0, :, 0][None, :]
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0, 0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def fused_decode_attention(
+    q: jax.Array,
+    kc: jax.Array,
+    vc: jax.Array,
+    valid_len: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Single-position attention against a kv-head-major cache.
+
+    Args:
+      q: ``(B, H, Dh)`` — the current position's queries.
+      kc/vc: ``(B, KH, L, Dh)`` cache panels (float, or int8 with scales).
+      valid_len: ``(B,)`` int32 — positions ``< valid_len[b]`` are
+        attendable (the decode-time causal bound, ragged rows included).
+      k_scale/v_scale: ``(B, KH, L)`` fp32 — required iff the cache is
+        int8 (symmetric-absmax dequantization, folded into the einsums).
+
+    Returns ``(B, H, Dh)`` in ``q``'s dtype.
+    """
+    B, H, Dh = q.shape
+    _, KH, L, _ = kc.shape
+    if H % KH:
+        raise ValueError(f"H ({H}) must be a multiple of KH ({KH})")
+    G = H // KH
+    quant = kc.dtype == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError("int8 cache needs k_scale and v_scale")
+    qg = q.reshape(B, KH, G, Dh)
+    lens = jnp.broadcast_to(
+        jnp.asarray(valid_len, jnp.int32).reshape(B, 1, 1, 1), (B, 1, 1, 1)
+    )
+    operands = [qg, kc, vc, lens]
+    in_specs = [
+        pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, L, Dh), lambda b, h: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, L, Dh), lambda b, h: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, 1, 1), lambda b, h: (b, 0, 0, 0)),
+    ]
+    if quant:
+        operands += [
+            k_scale.reshape(B, KH, L, 1),
+            v_scale.reshape(B, KH, L, 1),
+        ]
+        in_specs += [
+            pl.BlockSpec((1, 1, L, 1), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, L, 1), lambda b, h: (b, h, 0, 0)),
+        ]
+    out = pl.pallas_call(
+        lambda *refs: _decode_kernel(
+            *refs, scale=1.0 / math.sqrt(Dh), quant=quant
+        ),
+        grid=(B, KH),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, Dh), lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, KH, G, Dh), q.dtype),
+        interpret=_use_interpret(),
+    )(*operands)
+    return out.reshape(B, H, Dh)
