@@ -9,7 +9,11 @@ training/data/fault-tolerance integration re-built on optax/orbax.
 API facade (reference anchor: ``chainermn/__init__.py``).
 """
 
-from chainermn_tpu.comm import (
+from chainermn_tpu import _compat
+
+_compat.install()
+
+from chainermn_tpu.comm import (  # noqa: E402
     CommunicatorBase,
     DummyCommunicator,
     XlaCommunicator,
@@ -40,6 +44,14 @@ from chainermn_tpu.extensions import (  # noqa: E402
     create_multi_node_evaluator,
 )
 from chainermn_tpu import global_except_hook  # noqa: E402
+from chainermn_tpu import resilience  # noqa: E402
+from chainermn_tpu.resilience import (  # noqa: E402
+    PREEMPTION_EXIT_CODE,
+    FailureDetector,
+    PeerFailedError,
+    PreemptionGuard,
+    RetryPolicy,
+)
 
 global_except_hook._add_hook_if_enabled()
 from chainermn_tpu.iterators import (  # noqa: E402
@@ -87,4 +99,10 @@ __all__ = [
     "create_multi_node_iterator",
     "create_synchronized_iterator",
     "create_device_prefetch_iterator",
+    "resilience",
+    "FailureDetector",
+    "PeerFailedError",
+    "PreemptionGuard",
+    "RetryPolicy",
+    "PREEMPTION_EXIT_CODE",
 ]

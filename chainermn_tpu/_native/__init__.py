@@ -78,7 +78,7 @@ def load_hostcomm() -> Optional[ctypes.CDLL]:
     lib.hostcomm_send.restype = ctypes.c_int
     lib.hostcomm_send.argtypes = [
         ctypes.c_void_p, ctypes.c_int,
-        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_int,
     ]
     lib.hostcomm_recv.restype = ctypes.c_int64
     lib.hostcomm_recv.argtypes = [

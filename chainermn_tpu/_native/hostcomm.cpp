@@ -22,6 +22,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -63,6 +65,37 @@ bool send_all(int fd, const void* buf, size_t n) {
     n -= static_cast<size_t>(w);
   }
   return true;
+}
+
+// Deadline-bounded send: poll for writability, then non-blocking send, so a
+// dead peer whose socket buffer is full fails the op after deadline_ms
+// instead of wedging the sender forever (the reference's MPI_Send had the
+// same silent-blocking failure mode).  deadline_ms < 0 → wait forever.
+// Returns 0 ok, -2 connection failure, -3 timeout.
+int send_all_deadline(int fd, const void* buf, size_t n, int deadline_ms) {
+  if (deadline_ms < 0) return send_all(fd, buf, n) ? 0 : -2;
+  const char* p = static_cast<const char*>(buf);
+  auto t0 = std::chrono::steady_clock::now();
+  while (n > 0) {
+    auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+    int remain = deadline_ms - static_cast<int>(elapsed);
+    if (remain <= 0) return -3;
+    pollfd pfd{fd, POLLOUT, 0};
+    int pr = ::poll(&pfd, 1, remain);
+    if (pr == 0) return -3;
+    if (pr < 0 || (pfd.revents & (POLLERR | POLLHUP | POLLNVAL))) return -2;
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (w < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return -2;
+    }
+    if (w == 0) return -2;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return 0;
 }
 
 bool recv_all(int fd, void* buf, size_t n) {
@@ -214,15 +247,38 @@ void* hostcomm_init(int rank, int size, const char** hosts, const int* ports,
   return c.release();
 }
 
-// Blocking framed send to `dest`. Returns 0 on success.
-int hostcomm_send(void* handle, int dest, const uint8_t* data, uint64_t len) {
+// Framed send to `dest`, bounded by timeout_ms (< 0 → wait forever).
+// Returns 0 ok, -1 bad args, -2 connection failure, -3 timeout.
+int hostcomm_send(void* handle, int dest, const uint8_t* data, uint64_t len,
+                  int timeout_ms) {
   auto* c = static_cast<Comm*>(handle);
   if (dest < 0 || dest >= c->size || dest == c->rank) return -1;
   std::lock_guard<std::mutex> lk(c->send_mu[dest]);
+  auto t0 = std::chrono::steady_clock::now();
   uint64_t n = len;
-  if (!send_all(c->fds[dest], &n, sizeof(n))) return -2;
-  if (len > 0 && !send_all(c->fds[dest], data, len)) return -2;
-  return 0;
+  int rc = send_all_deadline(c->fds[dest], &n, sizeof(n), timeout_ms);
+  if (len > 0 && rc == 0) {
+    // The header is committed: spend only whatever deadline REMAINS on
+    // the payload, so the whole frame honors one timeout_ms budget.
+    int remain = timeout_ms;
+    if (timeout_ms >= 0) {
+      auto spent = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+      remain = timeout_ms - static_cast<int>(spent);
+      if (remain < 0) remain = 0;
+    }
+    rc = send_all_deadline(c->fds[dest], data, len, remain);
+  }
+  if (rc != 0) {
+    // A failed send may have written part of a frame; the byte stream to
+    // this peer is unrecoverable (the reader has no resync point), so
+    // poison the channel: shutdown makes the peer's reader see EOF and
+    // every later op on this fd fail fast, instead of a silently
+    // desynced stream delivering garbage to a retried send.
+    ::shutdown(c->fds[dest], SHUT_RDWR);
+  }
+  return rc;
 }
 
 // Blocking receive of the next frame from `source`.  Two-phase: first call

@@ -21,6 +21,7 @@ property the reference built with ``pack_params``/``unpack_params``
 
 from __future__ import annotations
 
+import os
 import pickle
 import queue as _queue
 import threading
@@ -445,30 +446,77 @@ class XlaCommunicator(CommunicatorBase):
     def _nproc(self) -> int:
         return jax.process_count()
 
+    def _p2p_tree_bcast(self, obj: Any, root_proc: int) -> Any:
+        """Binomial-tree broadcast over this communicator's process group
+        on the rank-addressed p2p plane (each process speaks through its
+        FIRST rank): log2(group) rounds, every edge a distinct
+        ``(source, dest)`` rank pair so the frame demux can't cross-pair.
+        """
+        procs = self._topo.procs
+        me = jax.process_index()
+        rel = (procs.index(me) - procs.index(root_proc)) % len(procs)
+
+        def _first_rank_of_rel(r: int) -> int:
+            p = procs[(r + procs.index(root_proc)) % len(procs)]
+            return self._topo.ranks_of_proc(p)[0]
+
+        mask = 1
+        while mask < len(procs):
+            if rel & mask:
+                obj = self.recv_obj(
+                    source=_first_rank_of_rel(rel - mask),
+                    dest=self.rank,
+                    timeout=120.0,
+                )
+                break
+            mask <<= 1
+        mask >>= 1
+        while mask >= 1:
+            if rel + mask < len(procs):
+                self.send_obj(
+                    obj,
+                    dest=_first_rank_of_rel(rel + mask),
+                    source=self.rank,
+                )
+            mask >>= 1
+        return obj
+
+    @property
+    def _use_obj_p2p(self) -> bool:
+        """Prefer the native host object plane for object collectives when
+        it is bootstrapped (``CMN_TPU_HOSTS``): it is the resilience-
+        integrated path (per-op deadlines, attributed ``PeerFailedError``,
+        failure-detector slicing), and it keeps control-plane pickles off
+        the XLA device plane entirely — routing pickled bytes through
+        device collectives was also observed to re-materialize corrupted
+        on this container's jax (0.4.37, gloo, n>2).  Without the env —
+        or without a native toolchain to build the transport — the
+        XLA-collective fallback still works (multi-host pods launched by a
+        scheduler that never exported the object-plane ports; g++-less
+        hosts, which _native promises degrade gracefully)."""
+        if not os.environ.get("CMN_TPU_HOSTS"):
+            return False
+        from chainermn_tpu import _native
+
+        return _native.load_hostcomm() is not None
+
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
         procs = self._topo.procs
         if self._nproc == 1 or len(procs) == 1:
             # Single process, or a group living entirely on this process
             # (e.g. ``sub("intra")`` on a pod host) — identity.
             return obj
-        if len(procs) < self._nproc:
+        if len(procs) < self._nproc or self._use_obj_p2p:
             # Group spans a strict SUBSET of processes (e.g. ``sub``/``split``
-            # over one replica of a 3-level mesh).  multihost_utils spans ALL
-            # processes and would elect one source per group — wrong; fan out
+            # over one replica of a 3-level mesh), or the native object
+            # plane is up (preferred — see ``_use_obj_p2p``).  For subsets
+            # multihost_utils would be WRONG regardless (it spans ALL
+            # processes and would elect one source per group); fan out
             # over the rank-addressed p2p plane inside the group instead.
             # (Groups partition processes, so cross-group frames can't mix.)
-            me = jax.process_index()
-            root_proc = self._topo.proc_of(root)
-            if me == root_proc:
-                for p in procs:
-                    if p != me:
-                        self.send_obj(
-                            obj,
-                            dest=self._topo.ranks_of_proc(p)[0],
-                            source=root,
-                        )
-                return obj
-            return self.recv_obj(source=root, dest=self.rank, timeout=120.0)
+            # Binomial tree over the group's processes — log2(group) depth,
+            # not an O(group) serial loop through the root.
+            return self._p2p_tree_bcast(obj, self._topo.proc_of(root))
         from jax.experimental import multihost_utils
 
         is_src = jax.process_index() == self._root_proc(root)
@@ -500,9 +548,12 @@ class XlaCommunicator(CommunicatorBase):
         procs = self._topo.procs
         if self._nproc == 1 or len(procs) == 1:
             return [obj]
-        if len(procs) < self._nproc:
-            # Subset group: linear gather to the group's first process over
-            # the rank-addressed p2p plane, then group-internal bcast.
+        if len(procs) < self._nproc or self._use_obj_p2p:
+            # Subset group (where multihost_utils would be wrong), or the
+            # native object plane is up (preferred — see ``_use_obj_p2p``):
+            # linear gather to the group's first process (inherently
+            # O(group) at the root) over the rank-addressed p2p plane,
+            # then binomial-tree bcast of the gathered list back out.
             me = jax.process_index()
             root_proc = procs[0]
             root_rank = self._topo.ranks_of_proc(root_proc)[0]
@@ -516,15 +567,10 @@ class XlaCommunicator(CommunicatorBase):
                             timeout=120.0,
                         )
                     )
-                for p in procs[1:]:
-                    self.send_obj(
-                        objs,
-                        dest=self._topo.ranks_of_proc(p)[0],
-                        source=root_rank,
-                    )
-                return objs
-            self.send_obj(obj, dest=root_rank, source=self.rank)
-            return self.recv_obj(source=root_rank, dest=self.rank, timeout=120.0)
+            else:
+                self.send_obj(obj, dest=root_rank, source=self.rank)
+                objs = None
+            return self._p2p_tree_bcast(objs, root_proc)
         from jax.experimental import multihost_utils
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
@@ -651,7 +697,14 @@ class XlaCommunicator(CommunicatorBase):
                     frame = self._hostcomm.recv_obj(
                         src_proc, timeout_ms=int(min(remaining, 0.25) * 1000)
                     )
-                except TimeoutError:
+                except TimeoutError as e:
+                    # Only a genuine slice timeout means "keep polling".
+                    # PeerFailedError subclasses TimeoutError but carries
+                    # a kind: a detector DEAD verdict or a hard transport
+                    # failure must propagate attributed, not degrade into
+                    # a busy-loop ending in a generic deadline error.
+                    if getattr(e, "kind", "timeout") != "timeout":
+                        raise
                     continue
                 # Dispatch UNDER the drain lock: parking after release would
                 # let a concurrent same-pair receiver drain a LATER frame

@@ -24,6 +24,7 @@ from typing import Any, Tuple
 import jax
 import numpy as np
 
+from chainermn_tpu.resilience.policy import RetryPolicy
 from chainermn_tpu.training import Extension
 
 
@@ -46,6 +47,19 @@ class MultiNodeCheckpointer(Extension):
 
         self.comm = comm
         self._dir = os.path.abspath(os.path.join(path, name))
+        # Deterministic bounded retries around snapshot I/O: a transient
+        # filesystem hiccup (GCS 5xx, NFS stall) must not cost a whole-job
+        # restart.  Saves retry broadly (the partial commit is clobbered
+        # with force=True); restores retry only OS-level I/O errors —
+        # template/structure mismatches are NOT transients and must reach
+        # maybe_load's fallback logic untouched.
+        self._save_retry = RetryPolicy(
+            max_attempts=3, base_delay_s=0.2, multiplier=2.0, max_delay_s=2.0
+        )
+        self._restore_retry = RetryPolicy(
+            max_attempts=3, base_delay_s=0.2, multiplier=2.0,
+            max_delay_s=2.0, retry_on=(OSError,),
+        )
         self._mngr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -79,7 +93,36 @@ class MultiNodeCheckpointer(Extension):
                 stacklevel=2,
             )
         payload = {"train_state": state, "loop": loop}
-        self._mngr.save(step, args=ocp.args.StandardSave(payload))
+        attempts = [0]
+
+        def _commit():
+            # Retry attempts force-overwrite: the failed attempt may have
+            # left a partial step directory that a plain save would
+            # reject.  Counted at ENTRY — a failed save must still mark
+            # the attempt, or every retry would re-run force=False.
+            attempt = attempts[0]
+            attempts[0] += 1
+            self._mngr.save(
+                step,
+                args=ocp.args.StandardSave(payload),
+                force=attempt > 0,
+            )
+
+        self._save_retry.call(_commit)
+
+    def emergency_save(self, trainer) -> int:
+        """Preemption entry point (:class:`PreemptionGuard`): one
+        *synchronous* snapshot at the trainer's current iteration —
+        flushes any in-flight async commit first, skips the write when
+        that step is already the newest snapshot (idempotent under
+        repeated signals), and blocks until the new step is durable.
+        Returns the step saved."""
+        step = int(trainer.iteration)
+        self._mngr.wait_until_finished()
+        if self._mngr.latest_step() != step:
+            self.save(trainer.state, trainer)
+            self._mngr.wait_until_finished()
+        return step
 
     @staticmethod
     def _loop_state(trainer) -> dict:
@@ -128,6 +171,13 @@ class MultiNodeCheckpointer(Extension):
         return out
 
     # -------------------------------------------------------------- restore
+    def _restore(self, step, template):
+        import orbax.checkpoint as ocp
+
+        return self._restore_retry.call(
+            self._mngr.restore, step, args=ocp.args.StandardRestore(template)
+        )
+
     def maybe_load(self, state, trainer=None) -> Tuple[Any, int]:
         """Reference anchor: ``_MultiNodeCheckpointer.maybe_load`` — restore
         the latest complete snapshot if one exists; otherwise return the
@@ -144,9 +194,7 @@ class MultiNodeCheckpointer(Extension):
             "loop": self._loop_state(trainer),
         }
         try:
-            restored = self._mngr.restore(
-                step, args=ocp.args.StandardRestore(template)
-            )
+            restored = self._restore(step, template)
         except Exception:
             # Backward-compatible retries: snapshots predating leaves the
             # CURRENT template carries (it_inexact; ema_params when the
@@ -186,9 +234,7 @@ class MultiNodeCheckpointer(Extension):
                     ),
                 }
                 try:
-                    restored = self._mngr.restore(
-                        step, args=ocp.args.StandardRestore(t2)
-                    )
+                    restored = self._restore(step, t2)
                     dropped_ema = "ema" in drops
                     break
                 except Exception:
@@ -267,13 +313,15 @@ class MultiNodeCheckpointer(Extension):
         # restore with no device placement at all.
         item_dir = os.path.join(self._dir, str(step), "default")
         meta = ocp.StandardCheckpointer().metadata(item_dir)
+        # Orbax moved the tree around across versions: current wraps it as
+        # .item_metadata.tree, 0.7.x returns the metadata pytree directly.
+        if hasattr(meta, "item_metadata"):
+            meta = meta.item_metadata
+        meta = getattr(meta, "tree", meta)
         template = jax.tree_util.tree_map(
-            lambda m: np.zeros(m.shape, m.dtype),
-            meta.item_metadata.tree,
+            lambda m: np.zeros(m.shape, m.dtype), meta
         )
-        raw = self._mngr.restore(
-            step, args=ocp.args.StandardRestore(template)
-        )
+        raw = self._restore(step, template)
         new_state = reshard_zero_state(
             raw["train_state"], opt, params_template,
             model_state_template=model_state_template,

@@ -12,6 +12,12 @@ process-tagged traceback and tears the job down via ``jax.distributed``
 shutdown + hard exit.  Single-process jobs keep default behavior (nothing to
 deadlock).
 
+Both ``sys.excepthook`` AND ``threading.excepthook`` are installed: an
+uncaught exception in a *worker thread* (``iterators/prefetch.py`` feeders,
+a heartbeat thread) would otherwise print and die quietly, leaving the main
+thread blocked forever in a collective the dead thread was supposed to
+feed — exactly the deadlock the hook exists to prevent.
+
 Opt-out: set ``CHAINERMN_TPU_NO_EXCEPT_HOOK=1`` (reference analog:
 ``CHAINERMN_DISABLE_GLOBAL_EXCEPT_HOOK``).
 """
@@ -20,9 +26,11 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import traceback
 
 _hook_installed = False
+_prev_threading_hook = None
 
 
 def _global_except_hook(exctype, value, tb):
@@ -49,9 +57,11 @@ def _global_except_hook(exctype, value, tb):
             # are stuck in the very collective we are aborting), so arm a
             # watchdog first: this process dies within 2s no matter what —
             # MPI_Abort was never graceful either.
-            import threading
-
-            threading.Timer(2.0, lambda: os._exit(1)).start()
+            watchdog = threading.Timer(2.0, lambda: os._exit(1))
+            # Daemon: the watchdog must never be the thread keeping a
+            # process alive that was already told to die.
+            watchdog.daemon = True
+            watchdog.start()
             try:
                 import jax
 
@@ -61,18 +71,36 @@ def _global_except_hook(exctype, value, tb):
             os._exit(1)
 
 
+def _thread_except_hook(args) -> None:
+    """``threading.excepthook`` shim: same whole-job teardown for worker
+    threads.  SystemExit in a thread stays the quiet no-op it always was
+    (that is how ``threading`` itself treats it)."""
+    if args.exc_type is SystemExit:
+        return
+    tname = getattr(args.thread, "name", "?")
+    sys.stderr.write(
+        f"[chainermn_tpu] uncaught exception in thread {tname!r}\n"
+    )
+    _global_except_hook(args.exc_type, args.exc_value, args.exc_traceback)
+
+
 def add_hook() -> None:
-    global _hook_installed
+    global _hook_installed, _prev_threading_hook
     if _hook_installed:
         return
     sys.excepthook = _global_except_hook
+    _prev_threading_hook = threading.excepthook
+    threading.excepthook = _thread_except_hook
     _hook_installed = True
 
 
 def remove_hook() -> None:
-    global _hook_installed
+    global _hook_installed, _prev_threading_hook
     if _hook_installed:
         sys.excepthook = sys.__excepthook__
+        if _prev_threading_hook is not None:
+            threading.excepthook = _prev_threading_hook
+            _prev_threading_hook = None
         _hook_installed = False
 
 

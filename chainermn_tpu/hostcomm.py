@@ -12,6 +12,24 @@ Topology comes from env (``CMN_TPU_HOSTS`` = comma-separated ``ip:port``,
 is bootstrapped.  Composite ops (barrier/bcast/gather/allgather/allreduce)
 are built from framed point-to-point in Python; the wire is native C++
 (`_native/hostcomm.cpp`).
+
+Resilience integration (``chainermn_tpu/resilience/``):
+
+* **Per-op deadlines** — every send/recv is bounded by the communicator's
+  ``timeout_ms`` unless overridden, and failures raise
+  :class:`~chainermn_tpu.resilience.PeerFailedError` carrying *which peer*
+  and *which op* (it subclasses ``TimeoutError``, so pre-resilience
+  ``except TimeoutError`` call sites still match).
+* **Failure detection** — with a :class:`FailureDetector` attached
+  (:meth:`attach_detector`), blocking waits are sliced by the heartbeat
+  interval and re-check the detector between slices: a collective blocked
+  against a dead peer fails in ~1 heartbeat interval, not after the full
+  transport timeout.
+* **Bootstrap retry** — mesh establishment runs under a deterministic
+  :class:`~chainermn_tpu.resilience.RetryPolicy` (transient port races on
+  dense CI hosts no longer kill the job on the first dial).
+* **Fault injection** — ``CMN_FAULT`` hook points on barrier/send/recv
+  (see :mod:`chainermn_tpu.resilience.faults`).
 """
 
 from __future__ import annotations
@@ -23,6 +41,15 @@ import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from chainermn_tpu import _native
+from chainermn_tpu.resilience import faults as _faults
+from chainermn_tpu.resilience.detector import PeerFailedError
+from chainermn_tpu.resilience.policy import RetryPolicy
+
+#: Mesh bootstrap retry: 3 attempts, 0.5s/1s deterministic backoff.
+DEFAULT_BOOTSTRAP_RETRY = RetryPolicy(
+    max_attempts=3, base_delay_s=0.5, multiplier=2.0, max_delay_s=5.0,
+    retry_on=(RuntimeError,),
+)
 
 
 class HostComm:
@@ -33,6 +60,8 @@ class HostComm:
         rank: Optional[int] = None,
         hosts: Optional[Sequence[Tuple[str, int]]] = None,
         timeout_ms: int = 30000,
+        bootstrap_retry: Optional[RetryPolicy] = None,
+        enable_faults: bool = True,
     ):
         if hosts is None:
             spec = os.environ.get("CMN_TPU_HOSTS", "")
@@ -50,6 +79,15 @@ class HostComm:
             raise ValueError(f"bad rank {rank} for {len(hosts)} hosts")
         self.rank = int(rank)
         self.size = len(hosts)
+        self.timeout_ms = int(timeout_ms)
+        self._detector = None
+        # ``enable_faults=False`` exists for auxiliary meshes (the failure
+        # detector's heartbeat plane): CMN_FAULT specs target the DATA
+        # plane's op counters; injecting them into heartbeat traffic too
+        # would fire on the wrong counter and skew detection timings.
+        # The PROCESS-WIDE injector is shared with the trainer loop so a
+        # hang fired from any site freezes the callbacks registered here.
+        self._faults = _faults.process_injector() if enable_faults else None
         self._lib = _native.load_hostcomm()
         if self._lib is None:
             raise RuntimeError("native hostcomm unavailable (g++ missing?)")
@@ -57,48 +95,147 @@ class HostComm:
             *[h.encode() for h, _ in hosts]
         )
         c_ports = (ctypes.c_int * self.size)(*[p for _, p in hosts])
-        self._h = self._lib.hostcomm_init(
-            self.rank, self.size, c_hosts, c_ports, timeout_ms
+
+        def _bootstrap():
+            h = self._lib.hostcomm_init(
+                self.rank, self.size, c_hosts, c_ports, timeout_ms
+            )
+            if not h:
+                raise RuntimeError(
+                    f"hostcomm rank {rank}: failed to establish the peer mesh"
+                )
+            return h
+
+        retry = bootstrap_retry or DEFAULT_BOOTSTRAP_RETRY
+        self._h = retry.call(_bootstrap)
+
+    # ------------------------------------------------------------ resilience
+    def attach_detector(self, detector) -> None:
+        """Wire a :class:`~chainermn_tpu.resilience.FailureDetector` in:
+        blocking waits start slicing by its heartbeat interval (attributed
+        fast-fail), and an injected ``hang`` freezes its beats too (a hung
+        process must look dead to its peers)."""
+        self._detector = detector
+        if self._faults is not None:
+            self._faults.add_freeze_callback(detector.freeze)
+
+    def _peer_error(
+        self, peer: int, op: str, reason: str, kind: str = "timeout"
+    ) -> PeerFailedError:
+        return PeerFailedError(
+            peer, op=op, rank=self.rank, reason=reason, kind=kind
         )
-        if not self._h:
-            raise RuntimeError(
-                f"hostcomm rank {rank}: failed to establish the peer mesh"
+
+    def _wait_frame(self, source: int, timeout_ms: int, op: str) -> int:
+        """Wait for the next frame from ``source`` (leaving it queued) and
+        return its length.  Sliced by the detector's heartbeat interval when
+        one is attached, so a dead peer raises attributed long before the
+        deadline; ``timeout_ms < 0`` waits forever (detector checks still
+        apply)."""
+        deadline = (
+            None if timeout_ms < 0
+            else time.monotonic() + timeout_ms / 1000.0
+        )
+        while True:
+            if self._detector is not None:
+                self._detector.check(op=op)
+                slice_ms = max(int(self._detector.interval_s * 1000), 20)
+            else:
+                slice_ms = -1
+            if deadline is None:
+                wait_ms = slice_ms
+            else:
+                remain_ms = int((deadline - time.monotonic()) * 1000)
+                if remain_ms <= 0:
+                    raise self._peer_error(
+                        source, op,
+                        f"recv timed out after {timeout_ms}ms",
+                    )
+                wait_ms = (
+                    remain_ms if slice_ms < 0 else min(remain_ms, slice_ms)
+                )
+            n = self._lib.hostcomm_recv(self._h, source, None, 0, wait_ms)
+            if n >= 0:
+                return int(n)
+            if n == -1:  # this slice timed out; loop re-checks detector
+                if deadline is None and self._detector is None:
+                    raise self._peer_error(
+                        source, op, "recv timed out (transport)"
+                    )
+                continue
+            raise self._peer_error(
+                source, op, f"recv failed (rc={n})", kind="transport"
             )
 
     # ------------------------------------------------------- point-to-point
-    def send_obj(self, obj: Any, dest: int) -> None:
+    def send_obj(
+        self,
+        obj: Any,
+        dest: int,
+        timeout_ms: Optional[int] = None,
+        op: str = "send_obj",
+    ) -> None:
+        if self._faults is not None:
+            if self._faults.hook("send") == "drop":
+                # Injected drop: the message is lost on the wire — the
+                # sender proceeds as if delivered, the receiver never
+                # sees it (how a real lost frame presents).
+                return
+        timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
         payload = pickle.dumps(obj)
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-        rc = self._lib.hostcomm_send(self._h, dest, buf, len(payload))
+        rc = self._lib.hostcomm_send(
+            self._h, dest, buf, len(payload), timeout_ms
+        )
+        if rc == -3:
+            raise self._peer_error(
+                dest, op,
+                f"send timed out after {timeout_ms}ms (peer not draining)",
+            )
         if rc != 0:
-            raise RuntimeError(f"send to {dest} failed (rc={rc})")
+            raise self._peer_error(
+                dest, op, f"send failed (rc={rc})", kind="transport"
+            )
 
-    def recv_obj(self, source: int, timeout_ms: int = -1) -> Any:
-        t0 = time.monotonic()
-        n = self._lib.hostcomm_recv(self._h, source, None, 0, timeout_ms)
-        if n == -1:
-            raise TimeoutError(f"recv from {source} timed out")
-        if n < 0:
-            raise RuntimeError(f"recv from {source} failed (rc={n})")
-        if timeout_ms >= 0:
-            # The peek already consumed part of the budget; the pop gets the
-            # remainder (the frame is already queued, so this is just the
-            # memcpy — but keep the total wait ≤ timeout_ms, not 2×).
-            elapsed_ms = int((time.monotonic() - t0) * 1000)
-            timeout_ms = max(timeout_ms - elapsed_ms, 0)
+    def recv_obj(
+        self,
+        source: int,
+        timeout_ms: Optional[int] = None,
+        op: str = "recv_obj",
+    ) -> Any:
+        if self._faults is not None:
+            if self._faults.hook("recv") == "drop":
+                # Injected drop: consume and discard one frame, then
+                # deliver the next as if the first never arrived.
+                self._pop_frame(source, timeout_ms, op)
+        timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
+        return pickle.loads(self._pop_frame(source, timeout_ms, op))
+
+    def _pop_frame(
+        self, source: int, timeout_ms: Optional[int], op: str
+    ) -> bytes:
+        timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
+        n = self._wait_frame(source, timeout_ms, op)
+        # The frame is already queued (the peek waited for arrival); the pop
+        # is just the memcpy, so a zero wait suffices.
         buf = (ctypes.c_uint8 * max(int(n), 1))()
-        got = self._lib.hostcomm_recv(self._h, source, buf, int(n), timeout_ms)
+        got = self._lib.hostcomm_recv(self._h, source, buf, int(n), 0)
         if got != n:
-            raise RuntimeError(f"recv from {source}: length changed {n}->{got}")
-        return pickle.loads(bytes(buf[: int(n)]))
+            raise self._peer_error(
+                source, op, f"frame length changed {n}->{got}",
+                kind="transport",
+            )
+        return bytes(buf[: int(n)])
 
     # ----------------------------------------------------------- composites
     def barrier(self) -> None:
         """Dissemination barrier: log2(size) rounds of paired send/recv."""
+        if self._faults is not None:
+            self._faults.hook("barrier")
         k = 1
         while k < self.size:
-            self.send_obj((), (self.rank + k) % self.size)
-            self.recv_obj((self.rank - k) % self.size)
+            self.send_obj((), (self.rank + k) % self.size, op="barrier")
+            self.recv_obj((self.rank - k) % self.size, op="barrier")
             k *= 2
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
@@ -107,13 +244,17 @@ class HostComm:
         mask = 1
         while mask < self.size:
             if rel & mask:
-                obj = self.recv_obj((self.rank - mask) % self.size)
+                obj = self.recv_obj(
+                    (self.rank - mask) % self.size, op="bcast_obj"
+                )
                 break
             mask <<= 1
         mask >>= 1
         while mask >= 1:
             if rel + mask < self.size:
-                self.send_obj(obj, (self.rank + mask) % self.size)
+                self.send_obj(
+                    obj, (self.rank + mask) % self.size, op="bcast_obj"
+                )
             mask >>= 1
         return obj
 
@@ -123,9 +264,9 @@ class HostComm:
             out[self.rank] = obj
             for r in range(self.size):
                 if r != root:
-                    out[r] = self.recv_obj(r)
+                    out[r] = self.recv_obj(r, op="gather_obj")
             return out
-        self.send_obj(obj, root)
+        self.send_obj(obj, root, op="gather_obj")
         return None
 
     def allgather_obj(self, obj: Any) -> List[Any]:

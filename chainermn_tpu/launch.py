@@ -33,6 +33,8 @@ import subprocess
 import sys
 import time
 
+from chainermn_tpu.resilience.preemption import PREEMPTION_EXIT_CODE
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -52,6 +54,11 @@ def launch(
     coord = _free_port()
     hc_ports = [_free_port() for _ in range(nproc)]
     hosts = ",".join(f"127.0.0.1:{p}" for p in hc_ports)
+    # Second port set for the failure detector's dedicated heartbeat mesh
+    # (resilience/detector.py): heartbeat frames must not share the data
+    # plane's per-source FIFOs with real messages.
+    hb_ports = [_free_port() for _ in range(nproc)]
+    hb_hosts = ",".join(f"127.0.0.1:{p}" for p in hb_ports)
 
     procs = []
     for pid in range(nproc):
@@ -64,6 +71,7 @@ def launch(
                 "CMN_PROCESS_ID": str(pid),
                 "CMN_TPU_HOSTS": hosts,
                 "CMN_TPU_RANK": str(pid),
+                "CMN_TPU_HB_HOSTS": hb_hosts,
             }
         )
         # Own session per rank so the launcher can kill a rank's whole
@@ -142,6 +150,7 @@ def supervise(
     grace_s: float = 10.0,
     env_extra: dict = None,
     restart_nproc: int = None,
+    preempt_restarts: int = 8,
 ) -> int:
     """Run the job, relaunching it up to ``restarts`` times on failure.
 
@@ -158,21 +167,59 @@ def supervise(
     ranks resume through ``maybe_load_elastic``, which reshards the
     checkpoint to whatever world answers.  Every attempt exports
     ``CMN_LAUNCH_ATTEMPT`` so scripts can tell a fresh start from a
-    supervised relaunch."""
+    supervised relaunch.
+
+    **Preemption contract**: a job exiting with
+    :data:`~chainermn_tpu.resilience.PREEMPTION_EXIT_CODE` was preempted
+    cooperatively — the :class:`PreemptionGuard` already took a
+    synchronized emergency checkpoint — so it is ALWAYS restart-eligible:
+    it consumes the separate ``preempt_restarts`` allowance, never the
+    failure ``restarts`` budget (a preempted job is healthy; it must not
+    exhaust the crash budget of a flaky one).
+
+    Each attempt emits one health line to stderr:
+    ``attempt N: nproc=X rc=Y (ok|failure|preemption) duration=Zs``.
+    """
     attempt = 0
+    fail_used = 0
+    preempt_used = 0
     while True:
         n = nproc if attempt == 0 else (restart_nproc or nproc)
         env = dict(env_extra or {})
         env["CMN_LAUNCH_ATTEMPT"] = str(attempt)
+        t0 = time.time()
         rc = launch(n, argv, grace_s=grace_s, env_extra=env)
-        if rc == 0 or attempt >= restarts:
-            return rc
-        attempt += 1
-        sys.stderr.write(
-            f"[chainermn_tpu.launch] job failed (rc={rc}); "
-            f"restart {attempt}/{restarts} "
-            f"(n={restart_nproc or nproc}) in {backoff_s:.1f}s\n"
+        kind = (
+            "ok" if rc == 0
+            else "preemption" if rc == PREEMPTION_EXIT_CODE
+            else "failure"
         )
+        sys.stderr.write(
+            f"[chainermn_tpu.launch] attempt {attempt}: nproc={n} rc={rc} "
+            f"({kind}) duration={time.time() - t0:.1f}s\n"
+        )
+        if rc == 0:
+            return 0
+        if rc == PREEMPTION_EXIT_CODE:
+            if preempt_used >= preempt_restarts:
+                return rc
+            preempt_used += 1
+            attempt += 1
+            sys.stderr.write(
+                f"[chainermn_tpu.launch] job preempted (rc={rc}); "
+                f"restart {preempt_used}/{preempt_restarts} (preemption "
+                f"allowance, n={restart_nproc or nproc}) in {backoff_s:.1f}s\n"
+            )
+        else:
+            if fail_used >= restarts:
+                return rc
+            fail_used += 1
+            attempt += 1
+            sys.stderr.write(
+                f"[chainermn_tpu.launch] job failed (rc={rc}); "
+                f"restart {fail_used}/{restarts} "
+                f"(n={restart_nproc or nproc}) in {backoff_s:.1f}s\n"
+            )
         time.sleep(backoff_s)
 
 
@@ -194,6 +241,11 @@ def main():
                     help="world size for RELAUNCH attempts (elastic "
                          "restart: resume the checkpoint at a different "
                          "process count via maybe_load_elastic)")
+    ap.add_argument("--preempt-restarts", type=int, default=8,
+                    help="separate relaunch allowance for cooperative "
+                         f"preemptions (exit code {PREEMPTION_EXIT_CODE}: "
+                         "the PreemptionGuard already checkpointed); does "
+                         "not consume --restarts")
     ap.add_argument("script", help="python script to run on every rank")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args()
@@ -202,6 +254,7 @@ def main():
             ns.nproc, [ns.script] + ns.args, restarts=ns.restarts,
             backoff_s=ns.restart_backoff, grace_s=ns.grace,
             restart_nproc=ns.restart_nproc,
+            preempt_restarts=ns.preempt_restarts,
         )
     )
 

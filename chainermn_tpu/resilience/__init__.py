@@ -1,0 +1,67 @@
+"""Resilience layer: failure detection, retry, preemption, fault injection.
+
+The reference's fault tolerance was restart-based and blunt (SURVEY.md
+§2.8): a dead rank was discovered by a peer's collective timing out, and
+recovery meant the launcher reaping everything and relaunching from the
+last checkpoint.  This package supplies the other half:
+
+* :mod:`~chainermn_tpu.resilience.detector` — ring heartbeats over the
+  host object plane; blocked collectives fail in ~1 heartbeat interval
+  with a :class:`PeerFailedError` naming the dead rank and op.
+* :mod:`~chainermn_tpu.resilience.policy` — deterministic bounded
+  :class:`RetryPolicy`, applied to mesh bootstrap and checkpoint I/O.
+* :mod:`~chainermn_tpu.resilience.preemption` — :class:`PreemptionGuard`
+  converts SIGTERM into a rank-synchronized emergency checkpoint and a
+  distinguished exit code the launcher always restarts.
+* :mod:`~chainermn_tpu.resilience.faults` — ``CMN_FAULT`` deterministic
+  fault injection (``crash@iter:5``, ``hang@barrier:3``, ...), the
+  backbone of the multiprocess robustness tests.
+
+See ``docs/resilience.md`` for the failure model and every knob.
+"""
+
+from chainermn_tpu.resilience.detector import (
+    ALIVE,
+    DEAD,
+    SUSPECT,
+    DetectorCore,
+    FailureDetector,
+    PeerFailedError,
+)
+from chainermn_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSpec,
+    FaultSpecError,
+    InjectedFault,
+    parse_fault_spec,
+)
+from chainermn_tpu.resilience.policy import RetryExhaustedError, RetryPolicy
+from chainermn_tpu.resilience.preemption import (
+    PREEMPTION_EXIT_CODE,
+    PreemptionGuard,
+    PreemptionInterrupt,
+)
+from chainermn_tpu.resilience import detector, faults, policy, preemption
+
+__all__ = [
+    "ALIVE",
+    "SUSPECT",
+    "DEAD",
+    "DetectorCore",
+    "FailureDetector",
+    "PeerFailedError",
+    "FaultInjector",
+    "FaultSpec",
+    "FaultSpecError",
+    "InjectedFault",
+    "parse_fault_spec",
+    "RetryExhaustedError",
+    "RetryPolicy",
+    "PREEMPTION_EXIT_CODE",
+    "PreemptionGuard",
+    "PreemptionInterrupt",
+    "detector",
+    "faults",
+    "policy",
+    "preemption",
+]

@@ -1,0 +1,328 @@
+"""Heartbeat failure detection — *attributed* failures instead of timeouts.
+
+The reference discovered a dead rank only when a peer's collective timed out
+(or never returned): recovery started after a full transport timeout with no
+idea *which* rank died.  This module runs ring heartbeats over the host
+object plane: rank ``r`` beats to ``(r+1) % size`` every ``interval_s`` and
+monitors ``(r-1) % size``; a missed-beat window marks the predecessor
+SUSPECT then DEAD, and death gossips around the ring inside the heartbeat
+payload.  A :class:`~chainermn_tpu.hostcomm.HostComm` with a detector
+attached slices its blocking waits by the heartbeat interval, so a
+collective blocked against a dead peer raises :class:`PeerFailedError`
+*naming the dead rank and the op* in ~1 heartbeat interval — not a generic
+``TimeoutError`` 30 seconds later.
+
+The state machine (:class:`DetectorCore`) is pure — fed explicit clocks and
+heartbeat events — so CI tests its transitions single-process with a fake
+clock; the thread + transport wrapper (:class:`FailureDetector`) is what
+jobs run.  Death is **sticky**: once DEAD, a rank stays DEAD for the life of
+the detector (recovery is restart-based; a flapping peer must not oscillate
+a collective between failing and proceeding).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Set, Tuple
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+
+class PeerFailedError(TimeoutError):
+    """A peer rank was detected dead (or a bounded op against it expired).
+
+    Subclasses :class:`TimeoutError` so pre-resilience call sites that
+    caught the transport's generic timeout keep working; carries the
+    attribution the generic error lacked: ``peer`` (the rank that failed),
+    ``op`` (what the caller was doing), ``rank`` (who observed it), and
+    ``kind`` — ``"timeout"`` (a bounded wait expired; retrying the wait is
+    meaningful), ``"dead"`` (the failure detector's verdict), or
+    ``"transport"`` (hard socket/framing failure) — so callers that poll
+    with short slices can keep waiting on a timeout without also
+    swallowing fatal verdicts."""
+
+    def __init__(
+        self,
+        peer: int,
+        op: str = "?",
+        rank: Optional[int] = None,
+        reason: str = "",
+        kind: str = "timeout",
+    ):
+        self.peer = int(peer)
+        self.op = op
+        self.rank = rank
+        self.reason = reason
+        self.kind = kind
+        who = f"rank {rank}: " if rank is not None else ""
+        super().__init__(
+            f"{who}peer rank {self.peer} failed during {op}"
+            + (f" ({reason})" if reason else "")
+        )
+
+
+class DetectorCore:
+    """Pure per-process heartbeat state machine (no threads, no sockets).
+
+    Monitors the ring predecessor directly; any rank can additionally be
+    marked dead via gossip.  Thresholds are in units of ``interval_s``:
+    a predecessor silent for ``suspect_after`` intervals is SUSPECT, for
+    ``dead_after`` intervals DEAD."""
+
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        interval_s: float = 0.5,
+        suspect_after: float = 2.0,
+        dead_after: float = 4.0,
+    ):
+        if size < 1 or not (0 <= rank < size):
+            raise ValueError(f"bad rank {rank} / size {size}")
+        if not (0 < suspect_after <= dead_after):
+            raise ValueError("need 0 < suspect_after <= dead_after")
+        self.rank = int(rank)
+        self.size = int(size)
+        self.interval_s = float(interval_s)
+        self.suspect_after = float(suspect_after)
+        self.dead_after = float(dead_after)
+        self.pred = (rank - 1) % size
+        self.succ = (rank + 1) % size
+        self._last_seen: Optional[float] = None
+        self._dead: Set[int] = set()
+        self._dead_reason: Dict[int, str] = {}
+
+    def start(self, now: float) -> None:
+        """Arm the monitor; the predecessor's silence clock starts *now*."""
+        self._last_seen = now
+
+    def note_heartbeat(
+        self, peer: int, now: float, dead_ranks: Iterable[int] = ()
+    ) -> None:
+        if peer == self.pred:
+            self._last_seen = now
+        for r in dead_ranks:
+            r = int(r)
+            if r != self.rank and r not in self._dead:
+                self._dead.add(r)
+                self._dead_reason[r] = "reported dead by ring gossip"
+
+    def evaluate(self, now: float) -> str:
+        """Predecessor's state at time ``now`` (also latches DEAD)."""
+        if self.size == 1:
+            return ALIVE
+        if self.pred in self._dead:
+            return DEAD
+        if self._last_seen is None:
+            return ALIVE  # not armed yet
+        age = now - self._last_seen
+        if age > self.dead_after * self.interval_s:
+            self._dead.add(self.pred)
+            self._dead_reason[self.pred] = (
+                f"no heartbeat for {age:.2f}s "
+                f"(> {self.dead_after:g} x {self.interval_s:g}s)"
+            )
+            return DEAD
+        if age > self.suspect_after * self.interval_s:
+            return SUSPECT
+        return ALIVE
+
+    def mark_dead(self, peer: int, reason: str) -> None:
+        if peer != self.rank:
+            self._dead.add(int(peer))
+            self._dead_reason[int(peer)] = reason
+
+    def dead(self) -> Set[int]:
+        return set(self._dead)
+
+    def reason(self, peer: int) -> str:
+        return self._dead_reason.get(int(peer), "")
+
+
+class FailureDetector:
+    """Ring heartbeats over a point-to-point transport, in two daemon
+    threads (sender + monitor), wrapping a :class:`DetectorCore`.
+
+    ``transport`` is anything with ``rank``, ``size``,
+    ``send_obj(obj, dest)`` and ``recv_obj(source, timeout_ms=...)``
+    raising ``TimeoutError`` when nothing arrives —
+    :class:`chainermn_tpu.hostcomm.HostComm` natively, a mock in tests.
+    It must be *dedicated* to the detector (heartbeat frames share the
+    per-source FIFO with data frames otherwise); multiprocess jobs get one
+    from :func:`heartbeat_comm` over the launcher-allocated
+    ``CMN_TPU_HB_HOSTS`` ports.
+    """
+
+    def __init__(
+        self,
+        transport,
+        interval_s: float = 0.5,
+        suspect_after: float = 2.0,
+        dead_after: float = 4.0,
+        clock: Callable[[], float] = time.monotonic,
+        own_transport: bool = False,
+    ):
+        self.core = DetectorCore(
+            transport.rank,
+            transport.size,
+            interval_s=interval_s,
+            suspect_after=suspect_after,
+            dead_after=dead_after,
+        )
+        self._tp = transport
+        self._own_tp = own_transport
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+        self._seq = 0
+        self._started = False
+
+    # ---------------------------------------------------------------- state
+    @property
+    def rank(self) -> int:
+        return self.core.rank
+
+    @property
+    def interval_s(self) -> float:
+        return self.core.interval_s
+
+    def dead_ranks(self) -> Set[int]:
+        with self._mu:
+            return self.core.dead()
+
+    def check(self, op: str = "collective") -> None:
+        """Raise :class:`PeerFailedError` if any peer is known dead.
+
+        The hook :class:`~chainermn_tpu.hostcomm.HostComm` calls between
+        wait slices — ``op`` attributes what the caller was blocked in."""
+        with self._mu:
+            self.core.evaluate(self._clock())
+            dead = self.core.dead()
+            if dead:
+                peer = min(dead)
+                reason = self.core.reason(peer)
+        if dead:
+            raise PeerFailedError(
+                peer, op=op, rank=self.core.rank, reason=reason,
+                kind="dead",
+            )
+
+    # -------------------------------------------------------------- threads
+    def start(self) -> "FailureDetector":
+        if self._started or self.core.size == 1:
+            self._started = True
+            return self
+        with self._mu:
+            self.core.start(self._clock())
+        for fn, name in ((self._send_loop, "hb-send"),
+                         (self._monitor_loop, "hb-monitor")):
+            t = threading.Thread(
+                target=fn, name=f"cmn-{name}-r{self.core.rank}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        """Graceful shutdown (normal job end)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2 * self.core.interval_s + 1.0)
+        self._threads = []
+        if self._own_tp:
+            try:
+                self._tp.close()
+            except Exception:
+                pass
+
+    def freeze(self) -> None:
+        """Halt heartbeating WITHOUT closing the transport — the fault
+        injector's ``hang`` hook: the process plays dead (peers detect it)
+        while its sockets stay open (exactly a frozen host's TCP looks)."""
+        self._stop.set()
+
+    def _send_loop(self) -> None:
+        while not self._stop.wait(self.core.interval_s):
+            with self._mu:
+                self._seq += 1
+                payload = ("hb", self._seq, sorted(self.core.dead()))
+            try:
+                self._tp.send_obj(payload, self.core.succ)
+            except Exception:
+                # A failed beat to the successor is the successor's
+                # successor's problem to detect; ours is only to keep
+                # beating (and the send will keep failing harmlessly).
+                pass
+
+    def _monitor_loop(self) -> None:
+        wait_ms = max(int(self.core.interval_s * 1000), 1)
+        while not self._stop.is_set():
+            try:
+                msg = self._tp.recv_obj(self.core.pred, timeout_ms=wait_ms)
+                if isinstance(msg, tuple) and len(msg) == 3 and msg[0] == "hb":
+                    with self._mu:
+                        self.core.note_heartbeat(
+                            self.core.pred, self._clock(), dead_ranks=msg[2]
+                        )
+            except TimeoutError:
+                pass
+            except Exception:
+                # Transport torn down under us (peer reset, close()) — the
+                # silence clock keeps running; evaluate() does the rest.
+                if self._stop.wait(self.core.interval_s):
+                    return
+            with self._mu:
+                self.core.evaluate(self._clock())
+
+    # ------------------------------------------------------------ wiring
+    def attach(self, hostcomm) -> "FailureDetector":
+        """Attach to a data-plane :class:`HostComm`: its ops now fail fast
+        with attribution, and an injected ``hang`` freezes our beats."""
+        hostcomm.attach_detector(self)
+        return self
+
+
+def heartbeat_comm(timeout_ms: int = 10000):
+    """Build the detector's dedicated mesh from ``CMN_TPU_HB_HOSTS`` (a
+    second port set the launcher allocates next to ``CMN_TPU_HOSTS``)."""
+    from chainermn_tpu.hostcomm import HostComm
+
+    spec = os.environ.get("CMN_TPU_HB_HOSTS", "")
+    if not spec:
+        raise ValueError("CMN_TPU_HB_HOSTS not set (launcher too old?)")
+    hosts = []
+    for part in spec.split(","):
+        ip, port = part.rsplit(":", 1)
+        hosts.append((ip, int(port)))
+    # enable_faults=False: CMN_FAULT specs address the DATA plane's op
+    # counters; the heartbeat plane must stay fault-free or an injected
+    # slow/hang would fire on the wrong mesh and skew detection itself
+    # (hang reaches the heartbeats anyway, via the freeze callback).
+    return HostComm(
+        rank=int(os.environ["CMN_TPU_RANK"]), hosts=hosts,
+        timeout_ms=timeout_ms, enable_faults=False,
+    )
+
+
+def from_env(
+    interval_s: float = 0.5,
+    suspect_after: float = 2.0,
+    dead_after: float = 4.0,
+) -> Optional[FailureDetector]:
+    """Launcher-wired constructor: ``None`` when no heartbeat mesh exists
+    (single process, or a pre-resilience launcher)."""
+    if not os.environ.get("CMN_TPU_HB_HOSTS"):
+        return None
+    return FailureDetector(
+        heartbeat_comm(),
+        interval_s=interval_s,
+        suspect_after=suspect_after,
+        dead_after=dead_after,
+        own_transport=True,
+    )

@@ -1,0 +1,242 @@
+"""Deterministic fault injection — make failure modes testable on CPU in CI.
+
+The reference could only test fault tolerance by actually killing an
+``mpiexec`` rank from the outside.  Here faults are injected from the
+*inside*, driven by one env var, so a 2-process CPU job in CI exercises the
+same detection/teardown/recovery machinery a preempted TPU pod does:
+
+    CMN_FAULT=crash@iter:5        # raise at trainer iteration 5
+    CMN_FAULT=hang@barrier:3      # freeze the process at its 3rd barrier
+    CMN_FAULT=slow@send:200ms     # delay every object-plane send by 200ms
+    CMN_FAULT=drop@recv:2         # discard the frame of the 2nd recv
+    CMN_FAULT=slow@send:50ms;crash@iter:7     # ';'-separated composition
+
+Scoping env vars:
+
+* ``CMN_FAULT_RANK`` — inject only on this rank (default: every rank).
+* ``CMN_FAULT_ATTEMPT`` — inject only on this ``CMN_LAUNCH_ATTEMPT``
+  (default 0: the first launch), so a supervised relaunch is automatically
+  fault-free — the deterministic replacement for "fire once" marker files.
+
+Grammar: ``kind@site:arg`` where ``kind`` ∈ {crash, hang, slow, drop},
+``site`` is a hook-point name (``iter``/``barrier``/``send``/``recv`` today;
+any identifier parses), and ``arg`` is a 1-based hit count for one-shot
+kinds (crash/hang/drop) or a duration (``200ms``/``1.5s``) for ``slow``.
+crash/hang/slow fire at any site; ``drop`` is message-shaped and honored
+at the ``send`` (message lost on the wire) and ``recv`` (frame discarded
+on arrival) hook points.
+
+Hook points live in :class:`chainermn_tpu.hostcomm.HostComm`
+(barrier/send/recv) and the :class:`chainermn_tpu.training.Trainer` step
+loop (iter).  ``hang`` freezes registered collaborators first (the
+:class:`~chainermn_tpu.resilience.detector.FailureDetector`'s heartbeat
+threads) so it models a *frozen host* — the whole process stops, heartbeats
+included — not a live process with one stuck thread.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+KINDS = ("crash", "hang", "slow", "drop")
+ONE_SHOT_KINDS = ("crash", "hang", "drop")
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z]+)@(?P<site>[A-Za-z_][A-Za-z0-9_]*):(?P<arg>[^@;]+)$"
+)
+_DURATION_RE = re.compile(r"^(?P<num>\d+(?:\.\d+)?)(?P<unit>ms|s)$")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``crash`` spec — an ordinary uncaught exception, handled
+    by the global except hook exactly as a user crash would be."""
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``CMN_FAULT`` value."""
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    site: str
+    #: 1-based hit count at/after which a one-shot kind fires.
+    n: Optional[int] = None
+    #: per-hit delay for ``slow``.
+    duration_s: Optional[float] = None
+    fired: bool = field(default=False, compare=False)
+
+    @property
+    def text(self) -> str:
+        arg = f"{self.n}" if self.n is not None else f"{self.duration_s}s"
+        return f"{self.kind}@{self.site}:{arg}"
+
+
+def parse_fault_spec(spec: str) -> List[FaultSpec]:
+    """Parse a ``CMN_FAULT`` value into :class:`FaultSpec` s.
+
+    Raises :class:`FaultSpecError` on any malformed component — a typo'd
+    fault spec silently injecting nothing would invalidate the test built
+    on it."""
+    out: List[FaultSpec] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        m = _SPEC_RE.match(part)
+        if not m:
+            raise FaultSpecError(
+                f"bad fault spec {part!r} (want kind@site:arg, e.g. "
+                f"crash@iter:5 or slow@send:200ms)"
+            )
+        kind, site, arg = m.group("kind"), m.group("site"), m.group("arg")
+        if kind not in KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r} in {part!r} (one of {KINDS})"
+            )
+        if kind == "slow":
+            dm = _DURATION_RE.match(arg)
+            if not dm:
+                raise FaultSpecError(
+                    f"slow fault needs a duration arg like 200ms or 1.5s, "
+                    f"got {arg!r} in {part!r}"
+                )
+            dur = float(dm.group("num"))
+            if dm.group("unit") == "ms":
+                dur /= 1000.0
+            out.append(FaultSpec(kind=kind, site=site, duration_s=dur))
+        else:
+            if not arg.isdigit() or int(arg) < 1:
+                raise FaultSpecError(
+                    f"{kind} fault needs a 1-based hit count, got {arg!r} "
+                    f"in {part!r}"
+                )
+            out.append(FaultSpec(kind=kind, site=site, n=int(arg)))
+    if not out:
+        raise FaultSpecError(f"empty fault spec {spec!r}")
+    return out
+
+
+class FaultInjector:
+    """Evaluates parsed specs at named hook points.
+
+    ``hook(site)`` counts hits per site (1-based) and applies matching
+    specs; pass ``count=`` to match against an externally-maintained
+    counter instead (the trainer passes its iteration).  Returns ``"drop"``
+    when the caller should discard the in-flight message, else ``None``.
+    """
+
+    def __init__(
+        self,
+        specs: List[FaultSpec],
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.specs = list(specs)
+        self._counts: Dict[str, int] = {}
+        self._freeze_cbs: List[Callable[[], None]] = []
+        self._mu = threading.Lock()
+        self._sleep = sleep
+
+    def add_freeze_callback(self, cb: Callable[[], None]) -> None:
+        """Register a collaborator to freeze when a ``hang`` fires (the
+        failure detector registers its heartbeat-thread shutdown here)."""
+        with self._mu:
+            self._freeze_cbs.append(cb)
+
+    def hook(self, site: str, count: Optional[int] = None) -> Optional[str]:
+        with self._mu:
+            if count is None:
+                self._counts[site] = self._counts.get(site, 0) + 1
+                count = self._counts[site]
+            todo = [
+                s
+                for s in self.specs
+                if s.site == site
+                and (
+                    s.kind == "slow"
+                    or (not s.fired and s.n is not None and count >= s.n)
+                )
+            ]
+            for s in todo:
+                if s.kind in ONE_SHOT_KINDS:
+                    s.fired = True
+            freeze_cbs = list(self._freeze_cbs)
+        action = None
+        for s in todo:
+            if s.kind == "slow":
+                self._sleep(s.duration_s)
+            elif s.kind == "crash":
+                raise InjectedFault(f"injected fault: {s.text}")
+            elif s.kind == "drop":
+                action = "drop"
+            elif s.kind == "hang":
+                self._hang(s, freeze_cbs)
+        return action
+
+    def _hang(self, spec: FaultSpec, freeze_cbs) -> None:
+        # Freeze collaborators FIRST: a hang models a frozen host, so the
+        # detector's heartbeat sender must stop beating too — otherwise the
+        # peers would see a live-but-stuck process forever.
+        import sys
+
+        for cb in freeze_cbs:
+            try:
+                cb()
+            except Exception:
+                pass
+        sys.stderr.write(
+            f"[chainermn_tpu.resilience] injected fault: {spec.text} — "
+            f"freezing this process\n"
+        )
+        sys.stderr.flush()
+        while True:  # pragma: no cover - exercised only multiprocess
+            self._sleep(3600)
+
+
+#: Process-wide injector cache (see :func:`process_injector`).
+_process_injector = {"built": False, "inj": None}
+
+
+def process_injector() -> Optional[FaultInjector]:
+    """The ONE injector shared by every hook site in this process
+    (trainer loop, data-plane HostComm, ...), built lazily from the env.
+
+    Sharing matters for ``hang``: the freeze callbacks (the failure
+    detector's heartbeat shutdown) are registered on the data plane's
+    injector — if the trainer had its own, ``hang@iter:N`` would freeze
+    the step loop while the heartbeats kept beating, and peers would
+    never detect the hang."""
+    if not _process_injector["built"]:
+        _process_injector["inj"] = from_env()
+        _process_injector["built"] = True
+    return _process_injector["inj"]
+
+
+def from_env(rank: Optional[int] = None) -> Optional[FaultInjector]:
+    """Build the process's injector from ``CMN_FAULT``; ``None`` (zero
+    overhead) when unset or when rank/attempt scoping excludes us.
+
+    ``rank`` defaults to ``CMN_TPU_RANK``/``CMN_PROCESS_ID``."""
+    spec = os.environ.get("CMN_FAULT", "")
+    if not spec:
+        return None
+    want_attempt = int(os.environ.get("CMN_FAULT_ATTEMPT", "0"))
+    attempt = int(os.environ.get("CMN_LAUNCH_ATTEMPT", "0"))
+    if attempt != want_attempt:
+        return None
+    want_rank = os.environ.get("CMN_FAULT_RANK")
+    if want_rank is not None:
+        if rank is None:
+            rank = int(
+                os.environ.get(
+                    "CMN_TPU_RANK", os.environ.get("CMN_PROCESS_ID", "-1")
+                )
+            )
+        if int(want_rank) != rank:
+            return None
+    return FaultInjector(parse_fault_spec(spec))

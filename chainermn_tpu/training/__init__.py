@@ -21,6 +21,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from chainermn_tpu.resilience import faults as _faults
+
 
 #: True while a ProgressBar \r-line is open on stderr; printers that emit
 #: full lines (LogReport) break the line first so output never interleaves.
@@ -231,13 +233,22 @@ class Trainer:
       loss_fn: ``loss_fn(params, batch) -> scalar`` (or ``(scalar, aux)``).
       train_iter: yields global batches (tuples of stacked arrays).
       stop: ``(n, 'epoch'|'iteration')`` stop trigger.
+      preemption_guard: optional
+        :class:`~chainermn_tpu.resilience.PreemptionGuard`, polled once per
+        iteration — converts SIGTERM into a rank-synchronized emergency
+        checkpoint + distinguished exit (see ``docs/resilience.md``).
+
+    The loop is also a ``CMN_FAULT`` hook point: ``crash@iter:N`` raises an
+    :class:`~chainermn_tpu.resilience.InjectedFault` at iteration N through
+    the exact path a user exception would take.
     """
 
     def __init__(self, optimizer, state, loss_fn, train_iter,
                  stop: Tuple[int, str] = (1, "epoch"),
                  extensions: Optional[List[Extension]] = None,
                  has_aux: bool = False, stateful: bool = False,
-                 step_kwargs: Optional[dict] = None):
+                 step_kwargs: Optional[dict] = None,
+                 preemption_guard=None):
         self.optimizer = optimizer
         self.state = state
         self.loss_fn = loss_fn
@@ -250,6 +261,11 @@ class Trainer:
         # Extra make_train_step options threaded through optimizer.update
         # (accum_steps, augment, ...).
         self.step_kwargs = dict(step_kwargs or {})
+        self.preemption_guard = preemption_guard
+        # Process-wide injector, shared with HostComm's hook sites: a
+        # hang@iter must also freeze the heartbeat threads whose freeze
+        # callbacks live on the data plane's (same) injector.
+        self._fault_injector = _faults.process_injector()
         self.iteration = 0
         self._observations: List[dict] = []
 
@@ -281,6 +297,13 @@ class Trainer:
             for ext in self.extensions:
                 if ext.should_fire(self):
                     ext(self)
+            if self._fault_injector is not None:
+                self._fault_injector.hook("iter", count=self.iteration)
+            # Guard poll LAST, after the interval extensions: a periodic
+            # checkpoint that fired this very iteration makes the guard's
+            # emergency save an idempotent no-op.
+            if self.preemption_guard is not None:
+                self.preemption_guard.poll(self)
         for ext in self.extensions:
             ext.finalize(self)
         return self.state

@@ -1,7 +1,10 @@
 """Every test in this directory is the examples-as-subprocesses acceptance
 tier (SURVEY.md §2.9: examples are the acceptance tests): marked
 ``acceptance`` so the --quick CI tier can exclude it by MARKER, not by
-directory ignore (VERDICT r4 weak #7)."""
+directory ignore (VERDICT r4 weak #7) — and ``slow`` (the tier IS slow:
+each test trains a real example in a subprocess, ~40s+ apiece), so
+``-m 'not slow'`` invocations that don't know the acceptance marker
+still exclude it, per the marker's own "slow; full CI only" contract."""
 
 import os
 
@@ -17,3 +20,4 @@ def pytest_collection_modifyitems(items):
     for item in items:
         if str(item.fspath).startswith(_HERE):
             item.add_marker(pytest.mark.acceptance)
+            item.add_marker(pytest.mark.slow)

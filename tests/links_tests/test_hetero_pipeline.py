@@ -169,6 +169,13 @@ def test_upstream_switch_vma_defect_still_present(devices):
     installed JAX fixed the defect — verify the gate flipped (the
     consistency test stays green), then delete THIS test and keep the
     gate."""
+    from chainermn_tpu import _compat
+
+    if _compat.VMA_SHIMMED:
+        pytest.skip(
+            "vma checker shimmed out on this JAX (_compat): the defect "
+            "under test is a property of the real checker"
+        )
     mesh = jax.sharding.Mesh(np.array(devices), ("d",))
     S = len(devices)
     rng = np.random.RandomState(0)
@@ -239,6 +246,15 @@ def test_switch_vma_gate_consistent(devices):
         int(p) for p in _jax.__version__.split(".")[:3] if p.isdigit()
     )
     measured_ok = _probe_switch_vma(mesh)
+    from chainermn_tpu import _compat
+
+    if _compat.VMA_SHIMMED:
+        # No real vma checker on this runtime: the gate declares the
+        # switch path trivially safe, and the probe (running checker-off
+        # under the shim) must agree nothing mis-routes.
+        assert switch_vma_safe(mesh) is True
+        assert measured_ok is True
+        return
     if ver <= _SWITCH_VMA_LAST_KNOWN_BAD:
         # Pinned-bad version: the gate must short-circuit to False, and
         # the probe must agree the defect is real (else the pin is stale).

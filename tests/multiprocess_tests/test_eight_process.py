@@ -4,39 +4,15 @@ per-pair FIFO, ragged array plane, and rank bookkeeping at n=8."""
 
 import json
 import os
-import subprocess
-import sys
 
-REPO = os.path.dirname(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-WORKER = os.path.join(
-    REPO, "tests", "multiprocess_tests", "worker_eight_process.py"
-)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_HERE, "worker_eight_process.py")
 
 
-def test_eight_process_stress(tmp_path):
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")
-    }
-    env.update(
-        {
-            "PYTHONPATH": REPO,
-            "JAX_PLATFORMS": "cpu",
-            "CMN_TEST_TMP": str(tmp_path),
-        }
-    )
-    res = subprocess.run(
-        [sys.executable, "-m", "chainermn_tpu.launch", "-n", "8",
-         "--grace", "5", WORKER],
-        env=env, cwd=REPO, capture_output=True, timeout=600,
-    )
-    log = res.stderr.decode(errors="replace") + res.stdout.decode(
-        errors="replace"
-    )
-    assert res.returncode == 0, log[-4000:]
+def test_eight_process_stress(launch_job, tmp_path):
+    job = launch_job(WORKER, nproc=8, timeout=600)
+    log = job.log
+    assert job.returncode == 0, log[-4000:]
     for pid in range(8):
         out = tmp_path / f"verdict_{pid}.json"
         assert out.exists(), f"rank {pid} wrote no verdict:\n{log[-4000:]}"

@@ -4,39 +4,15 @@ shard arithmetic at process_count == 4)."""
 
 import json
 import os
-import subprocess
-import sys
 
-REPO = os.path.dirname(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-WORKER = os.path.join(
-    REPO, "tests", "multiprocess_tests", "worker_four_process.py"
-)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_HERE, "worker_four_process.py")
 
 
-def test_four_process_integration(tmp_path):
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")
-    }
-    env.update(
-        {
-            "PYTHONPATH": REPO,
-            "JAX_PLATFORMS": "cpu",
-            "CMN_TEST_TMP": str(tmp_path),
-        }
-    )
-    res = subprocess.run(
-        [sys.executable, "-m", "chainermn_tpu.launch", "-n", "4",
-         "--grace", "5", WORKER],
-        env=env, cwd=REPO, capture_output=True, timeout=300,
-    )
-    log = res.stderr.decode(errors="replace") + res.stdout.decode(
-        errors="replace"
-    )
-    assert res.returncode == 0, log[-3000:]
+def test_four_process_integration(launch_job, tmp_path):
+    job = launch_job(WORKER, nproc=4, timeout=300)
+    log = job.log
+    assert job.returncode == 0, log[-3000:]
     for pid in range(4):
         out = tmp_path / f"verdict_{pid}.json"
         assert out.exists(), f"rank {pid} wrote no verdict:\n{log[-3000:]}"

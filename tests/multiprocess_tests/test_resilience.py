@@ -1,0 +1,123 @@
+"""Resilience acceptance tests (real OS processes; slow tier).
+
+Covers the two headline behaviors of the resilience layer end to end:
+
+1. **Attributed fast failure** — with ``CMN_FAULT=hang@barrier:3`` injected
+   on rank 1, rank 0's barrier raises :class:`PeerFailedError` *naming
+   rank 1 and the op* well before the 30s transport timeout would have
+   fired, and the launcher reaps the job.
+2. **Preemption-aware checkpointing** — SIGTERM to one rank mid-run makes
+   every rank take a synchronized emergency checkpoint and exit with the
+   preemption code; the supervising launcher relaunches on the preemption
+   allowance and the job resumes via ``maybe_load`` with no lost work
+   beyond the agreed iteration.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.resilience]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+HANG_WORKER = os.path.join(_HERE, "worker_resilience_hang.py")
+PREEMPT_WORKER = os.path.join(_HERE, "worker_resilience_preempt.py")
+
+
+def test_hang_detected_attributed_and_reaped(launch_job, tmp_path):
+    job = launch_job(
+        HANG_WORKER,
+        nproc=2,
+        extra_env={"CMN_FAULT": "hang@barrier:3", "CMN_FAULT_RANK": "1"},
+        timeout=120,
+    )
+    log = job.log
+    # The job died (launcher reaped it), not hung until some harness timeout.
+    assert job.returncode != 0, log[-3000:]
+    assert "terminating" in log, log[-3000:]
+    # The injection fired and froze rank 1 (heartbeats included).
+    assert "injected fault: hang@barrier:3" in log, log[-3000:]
+    # Rank 0 failed ATTRIBUTED: the error names the dead peer and the op.
+    assert "PeerFailedError" in log, log[-3000:]
+    assert "peer rank 1" in log, log[-3000:]
+    assert "barrier" in log, log[-3000:]
+    # Detection beat the 30s transport deadline by a wide margin: the whole
+    # job (bootstrap + 3 barriers + detection + teardown) fits well under
+    # it.  Old behavior: ≥ 30s blocked in recv + teardown on top.
+    assert job.latency < 25, job.latency
+
+
+def test_hang_free_control_run_is_clean(launch_job, tmp_path):
+    """Same worker, no injection: detector + heartbeat mesh must be
+    invisible on the healthy path."""
+    job = launch_job(HANG_WORKER, nproc=2, timeout=120)
+    assert job.returncode == 0, job.tail()
+    for rank in range(2):
+        v = json.loads((tmp_path / f"verdict_{rank}.json").read_text())
+        assert v["status"] == "ok", v
+
+
+def _wait_for(path, timeout=120, min_value=None):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            if min_value is None:
+                return None
+            try:
+                val = int(open(path).read().strip())
+                if val >= min_value:
+                    return val
+            except (ValueError, OSError):
+                pass
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {path}")
+
+
+def test_preemption_emergency_checkpoint_and_resume(launch_job, tmp_path):
+    job = launch_job(
+        PREEMPT_WORKER,
+        nproc=2,
+        extra_args=("--restarts", "0", "--preempt-restarts", "2",
+                    "--restart-backoff", "0.5"),
+        timeout=420,
+        grace=15,
+        wait=False,
+    )
+    # Let the first attempt get demonstrably mid-run (iteration >= 3 of 8),
+    # then preempt rank 1 exactly as the TPU scheduler would.
+    _wait_for(str(tmp_path / "progress_1.txt"), timeout=180, min_value=3)
+    pid = int(open(tmp_path / "pid_1_0.txt").read().strip())
+    os.kill(pid, signal.SIGTERM)
+
+    result = job.finish(timeout=420)
+    log = result.log
+    # One supervise() invocation absorbed the preemption: relaunch came
+    # from the preemption allowance, not the (zero) failure budget.
+    assert result.returncode == 0, log[-4000:]
+    assert "(preemption)" in log, log[-4000:]
+    assert "preemption allowance" in log, log[-4000:]
+    assert "job failed" not in log, log[-4000:]
+    assert "emergency checkpoint at iteration" in log, log[-4000:]
+
+    # Every rank recorded the SAME agreed preemption iteration (the vote).
+    stops = []
+    for rank in range(2):
+        p = tmp_path / f"preempt_{rank}.json"
+        assert p.exists(), log[-4000:]
+        stops.append(json.loads(p.read_text())["iteration"])
+    assert stops[0] == stops[1], stops
+    agreed = stops[0]
+    assert agreed >= 3, stops  # mid-run, not a startup accident
+
+    # The relaunch resumed AT the emergency snapshot: zero iterations lost
+    # beyond the agreed stop (the ISSUE's bound — "at most one trigger
+    # interval" — is met with room: the emergency save IS the boundary).
+    for rank in range(2):
+        v = json.loads((tmp_path / f"verdict_{rank}.json").read_text())
+        assert v["status"] == "ok", v.get("traceback", v)
+        assert v["resumed_from"] == agreed, (v, agreed)
+        assert v["final_iteration"] == 8, v
+        assert v["checkpoint_steps"][-1] == 8, v

@@ -1,11 +1,15 @@
 """Worker for the fault-injection / restart-recovery integration test.
 
-Trains a small DP MLP across 2 OS processes with per-epoch checkpoints.
-With ``CMN_FAULT_ITER`` set, process 1 raises mid-training — the global
-except hook must tear the whole job down (the reference's ``MPI_Abort``
-semantics) instead of leaving process 0 deadlocked in a collective.
-Without it, the worker resumes from the latest complete checkpoint and
-finishes, reporting where it resumed from.
+Trains a small DP MLP across OS processes with per-epoch checkpoints.  The
+crash is injected by the resilience layer itself: the launcher env carries
+``CMN_FAULT=crash@iter:N`` scoped to rank 1 (``CMN_FAULT_RANK=1``), and the
+trainer's built-in hook raises :class:`InjectedFault` at that iteration —
+an ordinary uncaught exception, handled by the global except hook exactly
+as a user crash would be (the reference's ``MPI_Abort`` semantics) instead
+of leaving process 0 deadlocked in a collective.  On a supervised relaunch
+(``CMN_LAUNCH_ATTEMPT`` > 0) the injector disarms automatically, the
+worker resumes from the latest complete checkpoint and finishes, reporting
+where it resumed from.
 """
 
 import json
@@ -14,16 +18,6 @@ import sys
 import traceback
 
 import numpy as np
-
-
-def _fault_marker() -> str:
-    return os.path.join(os.environ["CMN_TEST_TMP"], "fault_fired")
-
-
-def _fault_already_fired() -> bool:
-    return bool(
-        os.environ.get("CMN_FAULT_ONCE") and os.path.exists(_fault_marker())
-    )
 
 
 def main() -> dict:
@@ -55,6 +49,8 @@ def main() -> dict:
     opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
     batch = int(os.environ.get("CMN_BATCH", "64"))
     it = SerialIterator(ds, batch, shuffle=True, seed=2)
+    # The trainer builds its CMN_FAULT injector at construction — the
+    # crash@iter spec in the env is all the fault wiring this worker needs.
     trainer = Trainer(
         opt, opt.init(params), classification_loss(model), it,
         stop=(4, "epoch"), has_aux=True,
@@ -70,25 +66,6 @@ def main() -> dict:
     _, resumed = ckpt.maybe_load(trainer.state, trainer)
     out["resumed_from"] = int(resumed)
 
-    fault_iter = int(os.environ.get("CMN_FAULT_ITER", "-1"))
-    if pid == 1 and fault_iter >= 0 and not _fault_already_fired():
-        # Inject the failure through the real loop: an extension raising an
-        # ordinary uncaught exception at the target iteration, handled by
-        # the global except hook exactly as a user crash would be.
-        from chainermn_tpu.training import Extension
-
-        def blow_up(tr):
-            if tr.iteration >= fault_iter:
-                if os.environ.get("CMN_FAULT_ONCE"):
-                    # Transient-failure model for the self-healing launcher
-                    # test: fire once, not on the supervised relaunch.
-                    with open(_fault_marker(), "w") as f:
-                        f.write("fired")
-                raise RuntimeError("injected fault for recovery test")
-
-        trainer.extend(
-            Extension(blow_up, trigger=(1, "iteration"), name="fault")
-        )
     trainer.run()
 
     out["final_iteration"] = trainer.iteration
@@ -106,7 +83,9 @@ if __name__ == "__main__":
         os.environ["CMN_TEST_TMP"],
         f"verdict_{os.environ['CMN_PROCESS_ID']}.json",
     )
-    if os.environ.get("CMN_FAULT_ITER"):
+    if os.environ.get("CMN_FAULT") and os.environ.get(
+        "CMN_LAUNCH_ATTEMPT", "0"
+    ) == os.environ.get("CMN_FAULT_ATTEMPT", "0"):
         # Fault phase: NO safety net — the injected exception (and the peer's
         # resulting collective failure) must reach sys.excepthook so the
         # global except hook's whole-job teardown is what's under test.  On

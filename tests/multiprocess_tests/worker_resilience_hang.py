@@ -1,0 +1,49 @@
+"""Worker for the hang-detection acceptance test (control plane only — no
+JAX mesh needed, which keeps the failure-detection path isolated).
+
+Every rank builds the data-plane HostComm plus a FailureDetector over the
+launcher's heartbeat mesh, then runs a loop of barriers.  Under
+``CMN_FAULT=hang@barrier:3`` scoped to rank 1, that rank freezes (heartbeats
+included) at its 3rd barrier; the healthy ranks' barriers must then raise
+:class:`PeerFailedError` naming rank 1 within ~1 heartbeat window — the
+whole point of the detector vs the old 30s transport timeout.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    from chainermn_tpu.hostcomm import HostComm
+    from chainermn_tpu.resilience import detector as detector_mod
+
+    rank = int(os.environ["CMN_TPU_RANK"])
+    # Deliberately LONG transport timeout: the test proves detection beats
+    # it by an order of magnitude.
+    comm = HostComm(timeout_ms=30000)
+    det = detector_mod.from_env(interval_s=0.25)
+    assert det is not None, "launcher did not export CMN_TPU_HB_HOSTS"
+    det.attach(comm)
+    det.start()
+
+    t0 = time.monotonic()
+    for i in range(10):
+        comm.barrier()
+        time.sleep(0.05)
+    # Healthy run (no fault injected): report and exit clean.
+    det.stop()
+    comm.close()
+    out = os.path.join(
+        os.environ["CMN_TEST_TMP"], f"verdict_{rank}.json"
+    )
+    with open(out, "w") as f:
+        json.dump({"status": "ok", "elapsed": time.monotonic() - t0}, f)
+
+
+if __name__ == "__main__":
+    # NO safety net: the PeerFailedError on the healthy ranks must escape
+    # as an ordinary uncaught exception (nonzero exit → launcher reaps).
+    main()
+    sys.exit(0)

@@ -1,0 +1,119 @@
+"""Worker for the preemption acceptance test.
+
+Trains the small DP MLP (the fault-recovery worker's setup) with a
+PreemptionGuard installed.  The test SIGTERMs one rank mid-run: the guard's
+per-iteration vote synchronizes all ranks, every rank takes the emergency
+checkpoint at the agreed iteration and exits with the preemption code; the
+supervising launcher relaunches, and this worker (CMN_LAUNCH_ATTEMPT > 0)
+resumes via ``maybe_load`` and finishes.
+
+Progress breadcrumbs for the test: ``pid_<rank>_<attempt>.txt`` (whom to
+signal), ``progress_<rank>.txt`` (when it is mid-run), and
+``preempt_<rank>.json`` (the iteration the guard exited at, to bound the
+lost work).
+"""
+
+import json
+import os
+import sys
+import time
+import traceback
+
+
+TMP = os.environ["CMN_TEST_TMP"]
+ATTEMPT = os.environ.get("CMN_LAUNCH_ATTEMPT", "0")
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    with open(os.path.join(TMP, f"pid_{pid}_{ATTEMPT}.txt"), "w") as f:
+        f.write(str(os.getpid()))
+    out = {"process_id": pid, "attempt": ATTEMPT}
+
+    import numpy as np
+    import optax
+
+    from chainermn_tpu.datasets import make_synthetic_classification
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.resilience import PreemptionGuard
+    from chainermn_tpu.training import Extension, Trainer
+
+    comm = cmn.create_communicator("flat")
+    ds = cmn.scatter_dataset(
+        make_synthetic_classification(256, 8, 4, seed=9), comm, shuffle=True,
+        seed=4,
+    )
+    model = MLP(hidden=(8,), n_out=4)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))[
+        "params"
+    ]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    batch = int(os.environ.get("CMN_BATCH", "64"))
+    it = SerialIterator(ds, batch, shuffle=True, seed=2)
+    # Synchronous saves: the emergency snapshot must be complete the moment
+    # the preemption exit code surfaces (the relaunch resumes immediately).
+    ckpt = create_multi_node_checkpointer(
+        "preempt", comm, path=TMP, trigger=(1, "epoch"), async_save=False,
+    )
+    guard = PreemptionGuard(comm=comm, checkpointer=ckpt).install()
+    trainer = Trainer(
+        opt, opt.init(params), classification_loss(model), it,
+        stop=(4, "epoch"), has_aux=True, preemption_guard=guard,
+    )
+    trainer.extend(ckpt)
+
+    def breadcrumb(tr):
+        # Mid-run marker + pacing: gives the test a window to SIGTERM a
+        # live iteration instead of racing job start/end.
+        with open(os.path.join(TMP, f"progress_{pid}.txt"), "w") as f:
+            f.write(str(tr.iteration))
+        time.sleep(0.2)
+
+    trainer.extend(
+        Extension(breadcrumb, trigger=(1, "iteration"), name="breadcrumb")
+    )
+    _, resumed = ckpt.maybe_load(trainer.state, trainer)
+    out["resumed_from"] = int(resumed)
+    trainer.run()
+
+    out["final_iteration"] = trainer.iteration
+    out["checkpoint_steps"] = [int(s) for s in ckpt.all_steps()]
+    ckpt.close()
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    from chainermn_tpu.resilience import PreemptionInterrupt
+
+    result_path = os.path.join(
+        TMP, f"verdict_{os.environ['CMN_PROCESS_ID']}.json"
+    )
+    try:
+        verdict = main()
+    except PreemptionInterrupt as e:
+        # Record where the guard stopped us, then honor the exit-code
+        # contract (SystemExit would do it anyway; being explicit keeps
+        # the breadcrumb write ordered before the exit).
+        with open(
+            os.path.join(
+                TMP, f"preempt_{os.environ['CMN_PROCESS_ID']}.json"
+            ),
+            "w",
+        ) as f:
+            json.dump({"iteration": e.iteration, "attempt": ATTEMPT}, f)
+        sys.exit(e.code)
+    except BaseException:
+        verdict = {"status": "fail", "traceback": traceback.format_exc()}
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)

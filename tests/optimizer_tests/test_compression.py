@@ -177,6 +177,9 @@ def test_int8_ef_quantization_properties(devices):
       * every device's residual is exactly its own code error, i.e.
         g_d − r_d is an integer multiple of s in [−127s, 127s].
     """
+    pytest.importorskip(
+        "hypothesis", reason="property-fuzz tier needs hypothesis installed"
+    )
     from hypothesis import given, settings
     from hypothesis import strategies as st
     from hypothesis.extra import numpy as hnp

@@ -1,0 +1,218 @@
+"""FailureDetector state transitions — single-process, mocked transport,
+fake clock (tier-1).  The real-socket behavior is covered by the slow
+multiprocess tier (tests/multiprocess_tests/test_resilience.py)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from chainermn_tpu.resilience import (
+    ALIVE,
+    DEAD,
+    SUSPECT,
+    DetectorCore,
+    FailureDetector,
+    PeerFailedError,
+)
+
+
+# ----------------------------------------------------------- DetectorCore
+def test_core_transitions_alive_suspect_dead():
+    c = DetectorCore(rank=0, size=3, interval_s=1.0, suspect_after=2.0,
+                     dead_after=4.0)
+    assert c.pred == 2 and c.succ == 1
+    c.start(now=0.0)
+    assert c.evaluate(1.0) == ALIVE
+    c.note_heartbeat(2, now=1.0)
+    assert c.evaluate(2.9) == ALIVE      # age 1.9 < 2 intervals
+    assert c.evaluate(3.5) == SUSPECT    # 2 < age 2.5 < 4
+    # A late beat clears SUSPECT — no false positive latched.
+    c.note_heartbeat(2, now=3.6)
+    assert c.evaluate(4.0) == ALIVE
+    assert c.dead() == set()
+    # True silence crosses the dead threshold.
+    assert c.evaluate(8.0) == DEAD
+    assert c.dead() == {2}
+    assert "no heartbeat" in c.reason(2)
+
+
+def test_core_death_is_sticky():
+    c = DetectorCore(rank=0, size=2, interval_s=0.5)
+    c.start(0.0)
+    assert c.evaluate(10.0) == DEAD
+    # A zombie beat after the verdict must not resurrect the peer — the
+    # collective already failed; flapping would desynchronize recovery.
+    c.note_heartbeat(1, now=10.1)
+    assert c.evaluate(10.2) == DEAD
+
+
+def test_core_gossip_marks_remote_rank_dead():
+    c = DetectorCore(rank=0, size=4, interval_s=1.0)
+    c.start(0.0)
+    # Predecessor (3) is alive and reports rank 2 dead.
+    c.note_heartbeat(3, now=1.0, dead_ranks=[2])
+    assert c.evaluate(1.1) == ALIVE
+    assert c.dead() == {2}
+    assert "gossip" in c.reason(2)
+
+
+def test_core_gossip_never_marks_self():
+    c = DetectorCore(rank=0, size=2, interval_s=1.0)
+    c.start(0.0)
+    c.note_heartbeat(1, now=0.5, dead_ranks=[0])
+    assert c.dead() == set()
+
+
+def test_core_size_one_is_trivially_alive():
+    c = DetectorCore(rank=0, size=1)
+    c.start(0.0)
+    assert c.evaluate(1e9) == ALIVE
+
+
+def test_core_validation():
+    with pytest.raises(ValueError):
+        DetectorCore(rank=2, size=2)
+    with pytest.raises(ValueError):
+        DetectorCore(rank=0, size=2, suspect_after=3.0, dead_after=2.0)
+
+
+# -------------------------------------------------- mocked-transport wrapper
+class MockTransport:
+    """In-process transport: per-source queues, TimeoutError on empty —
+    the same contract HostComm provides."""
+
+    def __init__(self, rank, size):
+        self.rank = rank
+        self.size = size
+        self.sent = []  # (dest, payload)
+        self._in = {r: queue.Queue() for r in range(size)}
+        self.closed = False
+
+    def send_obj(self, obj, dest, **kw):
+        self.sent.append((dest, obj))
+
+    def deliver(self, source, obj):
+        self._in[source].put(obj)
+
+    def recv_obj(self, source, timeout_ms=-1, **kw):
+        try:
+            return self._in[source].get(
+                timeout=max(timeout_ms, 1) / 1000.0
+            )
+        except queue.Empty:
+            raise TimeoutError(f"recv from {source} timed out")
+
+    def close(self):
+        self.closed = True
+
+
+def _detector(rank=0, size=2, interval_s=0.05):
+    tp = MockTransport(rank, size)
+    det = FailureDetector(tp, interval_s=interval_s, suspect_after=2.0,
+                          dead_after=4.0)
+    return det, tp
+
+
+def test_check_raises_attributed_error_when_peer_silent():
+    det, tp = _detector(rank=0, size=2, interval_s=0.05)
+    det.start()
+    try:
+        # No beats delivered: the predecessor (rank 1) goes dead within
+        # dead_after * interval = 0.2s.
+        deadline = time.monotonic() + 5.0
+        with pytest.raises(PeerFailedError) as ei:
+            while time.monotonic() < deadline:
+                det.check(op="barrier")
+                time.sleep(0.02)
+        err = ei.value
+        assert err.peer == 1
+        assert err.op == "barrier"
+        assert err.rank == 0
+        assert "rank 1" in str(err)
+        assert "barrier" in str(err)
+        # Backward compat: attributed errors still match TimeoutError.
+        assert isinstance(err, TimeoutError)
+    finally:
+        det.stop()
+
+
+def test_heartbeats_keep_peer_alive_then_silence_kills():
+    det, tp = _detector(rank=0, size=2, interval_s=0.05)
+    det.start()
+    try:
+        # Feed beats for a while: check() must stay quiet.
+        for seq in range(8):
+            tp.deliver(1, ("hb", seq, []))
+            det.check(op="recv_obj")
+            time.sleep(0.03)
+        assert det.dead_ranks() == set()
+        # Silence: dead within ~4 intervals, detected via check().
+        deadline = time.monotonic() + 5.0
+        with pytest.raises(PeerFailedError):
+            while time.monotonic() < deadline:
+                det.check(op="recv_obj")
+                time.sleep(0.02)
+    finally:
+        det.stop()
+
+
+def test_sender_beats_successor_with_gossip_payload():
+    det, tp = _detector(rank=0, size=2, interval_s=0.02)
+    det.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not tp.sent and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert tp.sent, "sender thread never beat"
+        dest, payload = tp.sent[0]
+        assert dest == 1  # ring successor
+        assert payload[0] == "hb"
+        assert payload[2] == []  # no deaths to gossip yet
+    finally:
+        det.stop()
+
+
+def test_freeze_stops_beating_without_closing_transport():
+    det, tp = _detector(rank=0, size=2, interval_s=0.02)
+    det.start()
+    time.sleep(0.1)
+    det.freeze()
+    time.sleep(0.06)
+    n = len(tp.sent)
+    time.sleep(0.1)
+    assert len(tp.sent) == n, "frozen detector kept beating"
+    assert not tp.closed  # sockets stay open: hang, not crash
+
+
+def test_gossiped_death_propagates_to_check():
+    det, tp = _detector(rank=0, size=4, interval_s=0.05)
+    det.start()
+    try:
+        # Predecessor (rank 3) alive, gossiping that rank 2 died.
+        deadline = time.monotonic() + 5.0
+        with pytest.raises(PeerFailedError) as ei:
+            while time.monotonic() < deadline:
+                tp.deliver(3, ("hb", 1, [2]))
+                det.check(op="gather_obj")
+                time.sleep(0.02)
+        assert ei.value.peer == 2
+    finally:
+        det.stop()
+
+
+def test_size_one_detector_is_noop():
+    tp = MockTransport(0, 1)
+    det = FailureDetector(tp, interval_s=0.01)
+    det.start()
+    det.check(op="anything")  # never raises
+    det.stop()
+
+
+def test_stop_joins_threads():
+    det, tp = _detector()
+    det.start()
+    det.stop()
+    assert all(not t.is_alive() for t in threading.enumerate()
+               if t.name.startswith("cmn-hb-"))

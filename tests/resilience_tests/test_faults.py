@@ -1,0 +1,147 @@
+"""CMN_FAULT spec parsing + injector hook semantics (tier-1, CPU-only)."""
+
+import pytest
+
+from chainermn_tpu.resilience import (
+    FaultInjector,
+    FaultSpecError,
+    InjectedFault,
+    parse_fault_spec,
+)
+from chainermn_tpu.resilience import faults as faults_mod
+
+
+# ------------------------------------------------------------------ parsing
+def test_parse_all_kinds():
+    specs = parse_fault_spec(
+        "crash@iter:5;hang@barrier:3;slow@send:200ms;drop@recv:2"
+    )
+    assert [(s.kind, s.site) for s in specs] == [
+        ("crash", "iter"), ("hang", "barrier"), ("slow", "send"),
+        ("drop", "recv"),
+    ]
+    assert specs[0].n == 5
+    assert specs[1].n == 3
+    assert specs[2].duration_s == pytest.approx(0.2)
+    assert specs[3].n == 2
+
+
+def test_parse_durations():
+    assert parse_fault_spec("slow@send:1.5s")[0].duration_s == pytest.approx(
+        1.5
+    )
+    assert parse_fault_spec("slow@recv:50ms")[0].duration_s == pytest.approx(
+        0.05
+    )
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "crash",
+        "crash@iter",
+        "crash@iter:",
+        "crash@iter:abc",
+        "crash@iter:0",  # counts are 1-based
+        "explode@iter:5",  # unknown kind
+        "slow@send:200",  # slow needs a unit
+        "slow@send:fastish",
+        "crash@iter:5;;bogus",
+    ],
+)
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(FaultSpecError):
+        parse_fault_spec(bad)
+
+
+def test_spec_text_round_trip():
+    (s,) = parse_fault_spec("crash@iter:7")
+    assert s.text == "crash@iter:7"
+
+
+# ----------------------------------------------------------------- injector
+def test_crash_fires_at_count_and_is_one_shot():
+    inj = FaultInjector(parse_fault_spec("crash@iter:3"))
+    inj.hook("iter")  # 1
+    inj.hook("iter")  # 2
+    with pytest.raises(InjectedFault, match="injected fault: crash@iter:3"):
+        inj.hook("iter")  # 3
+    # One-shot: the consumed spec never fires again.
+    inj.hook("iter")
+
+
+def test_explicit_count_matches_trainer_iteration():
+    inj = FaultInjector(parse_fault_spec("crash@iter:5"))
+    inj.hook("iter", count=4)
+    with pytest.raises(InjectedFault):
+        inj.hook("iter", count=5)
+
+
+def test_crash_fires_even_if_exact_count_skipped():
+    # Trainer resumed past the target: >= semantics, not ==.
+    inj = FaultInjector(parse_fault_spec("crash@iter:5"))
+    with pytest.raises(InjectedFault):
+        inj.hook("iter", count=9)
+
+
+def test_sites_count_independently():
+    inj = FaultInjector(parse_fault_spec("crash@barrier:2"))
+    inj.hook("send")
+    inj.hook("send")
+    inj.hook("barrier")  # barrier count 1: no fire
+    with pytest.raises(InjectedFault):
+        inj.hook("barrier")
+
+
+def test_slow_applies_every_hit():
+    slept = []
+    inj = FaultInjector(parse_fault_spec("slow@send:100ms"),
+                        sleep=slept.append)
+    for _ in range(3):
+        inj.hook("send")
+    assert slept == [pytest.approx(0.1)] * 3
+
+
+def test_drop_returns_action_once():
+    inj = FaultInjector(parse_fault_spec("drop@recv:2"))
+    assert inj.hook("recv") is None
+    assert inj.hook("recv") == "drop"
+    assert inj.hook("recv") is None
+
+
+# ------------------------------------------------------------------ scoping
+def test_from_env_unset_is_none(monkeypatch):
+    monkeypatch.delenv("CMN_FAULT", raising=False)
+    assert faults_mod.from_env() is None
+
+
+def test_from_env_rank_gating(monkeypatch):
+    monkeypatch.setenv("CMN_FAULT", "crash@iter:1")
+    monkeypatch.setenv("CMN_FAULT_RANK", "1")
+    assert faults_mod.from_env(rank=0) is None
+    assert faults_mod.from_env(rank=1) is not None
+    # Rank resolved from the launcher env when not passed explicitly.
+    monkeypatch.setenv("CMN_TPU_RANK", "1")
+    assert faults_mod.from_env() is not None
+    monkeypatch.setenv("CMN_TPU_RANK", "0")
+    assert faults_mod.from_env() is None
+
+
+def test_from_env_attempt_gating(monkeypatch):
+    """A supervised relaunch (CMN_LAUNCH_ATTEMPT=1) is fault-free by
+    default — the deterministic replacement for fire-once marker files."""
+    monkeypatch.setenv("CMN_FAULT", "crash@iter:1")
+    monkeypatch.delenv("CMN_FAULT_RANK", raising=False)
+    monkeypatch.setenv("CMN_LAUNCH_ATTEMPT", "0")
+    assert faults_mod.from_env() is not None
+    monkeypatch.setenv("CMN_LAUNCH_ATTEMPT", "1")
+    assert faults_mod.from_env() is None
+    monkeypatch.setenv("CMN_FAULT_ATTEMPT", "1")
+    assert faults_mod.from_env() is not None
+
+
+def test_from_env_malformed_raises(monkeypatch):
+    monkeypatch.setenv("CMN_FAULT", "nonsense")
+    with pytest.raises(FaultSpecError):
+        faults_mod.from_env()

@@ -1,0 +1,88 @@
+"""supervise()'s preemption exit-code contract, with stub rank scripts —
+fast enough for tier-1 (no JAX, no mesh; the ranks are one-liners)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run_supervised(tmp_path, script_body, args=()):
+    script = tmp_path / "rank.py"
+    script.write_text(textwrap.dedent(script_body))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["CMN_TEST_TMP"] = str(tmp_path)
+    res = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.launch", "-n", "1",
+         "--grace", "2", *args, str(script)],
+        env=env, cwd=REPO, capture_output=True, timeout=120,
+    )
+    return res, res.stderr.decode(errors="replace")
+
+
+#: Exits with the preemption code on the first launch attempt, 0 after —
+#: the shape of a preempted-then-relaunched job.
+_PREEMPT_ONCE = """
+    import os, sys
+    from chainermn_tpu.resilience import PREEMPTION_EXIT_CODE
+    if os.environ.get("CMN_LAUNCH_ATTEMPT", "0") == "0":
+        sys.exit(PREEMPTION_EXIT_CODE)
+    sys.exit(0)
+"""
+
+
+def test_preemption_exit_is_restart_eligible_without_restart_budget(
+    tmp_path,
+):
+    """--restarts 0: a crash would be fatal, but a preemption exit relaunches
+    via the separate preemption allowance and the job self-heals."""
+    res, log = _run_supervised(tmp_path, _PREEMPT_ONCE,
+                               args=("--restarts", "0"))
+    assert res.returncode == 0, log[-3000:]
+    assert "(preemption)" in log, log[-3000:]
+    assert "preemption allowance" in log, log[-3000:]
+    # The failure budget stayed untouched: no 'job failed' line.
+    assert "job failed" not in log, log[-3000:]
+
+
+def test_preempt_allowance_is_bounded(tmp_path):
+    """A job that exits the preemption code forever must not loop: the
+    allowance caps it and the code surfaces to the caller."""
+    from chainermn_tpu.resilience import PREEMPTION_EXIT_CODE
+
+    always = """
+        import sys
+        from chainermn_tpu.resilience import PREEMPTION_EXIT_CODE
+        sys.exit(PREEMPTION_EXIT_CODE)
+    """
+    res, log = _run_supervised(
+        tmp_path, always,
+        args=("--restarts", "0", "--preempt-restarts", "1",
+              "--restart-backoff", "0.1"),
+    )
+    assert res.returncode == PREEMPTION_EXIT_CODE, log[-3000:]
+    assert log.count("(preemption)") == 2, log[-3000:]  # initial + 1 retry
+
+
+def test_health_line_per_attempt(tmp_path):
+    """Every attempt emits one parseable health line."""
+    res, log = _run_supervised(tmp_path, "import sys; sys.exit(0)")
+    assert res.returncode == 0, log[-3000:]
+    assert "attempt 0: nproc=1 rc=0 (ok) duration=" in log, log[-3000:]
+
+
+def test_ordinary_failure_still_consumes_restart_budget(tmp_path):
+    fail_once = """
+        import os, sys
+        sys.exit(3 if os.environ.get("CMN_LAUNCH_ATTEMPT", "0") == "0" else 0)
+    """
+    res, log = _run_supervised(
+        tmp_path, fail_once,
+        args=("--restarts", "1", "--restart-backoff", "0.1"),
+    )
+    assert res.returncode == 0, log[-3000:]
+    assert "restart 1/1" in log, log[-3000:]
+    assert "(failure)" in log, log[-3000:]

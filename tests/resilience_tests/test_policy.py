@@ -1,0 +1,102 @@
+"""RetryPolicy: deterministic schedules, bounded attempts, selective retry.
+
+Tier-1 (CPU, single-process): the policy must be a pure function of its
+constructor arguments — the whole point of a *deterministic* retry layer is
+that CI replays failures identically."""
+
+import pytest
+
+from chainermn_tpu.resilience import RetryExhaustedError, RetryPolicy
+
+
+def test_schedule_is_deterministic_and_capped():
+    p = RetryPolicy(max_attempts=6, base_delay_s=0.5, multiplier=2.0,
+                    max_delay_s=3.0)
+    assert p.delays() == [0.5, 1.0, 2.0, 3.0, 3.0]
+    # Same arguments → identical schedule, every time.
+    assert p.delays() == RetryPolicy(
+        max_attempts=6, base_delay_s=0.5, multiplier=2.0, max_delay_s=3.0
+    ).delays()
+
+
+def test_single_attempt_has_empty_schedule():
+    assert RetryPolicy(max_attempts=1).delays() == []
+
+
+def test_success_after_transient_failures():
+    sleeps = []
+    p = RetryPolicy(max_attempts=4, base_delay_s=0.1, multiplier=2.0,
+                    sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "done"
+
+    assert p.call(flaky) == "done"
+    assert calls["n"] == 3
+    # Exactly the deterministic prefix of the schedule was slept.
+    assert sleeps == [0.1, 0.2]
+
+
+def test_exhaustion_wraps_last_error():
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.0, sleep=lambda s: None)
+
+    def always():
+        raise ValueError("boom")
+
+    with pytest.raises(RetryExhaustedError) as ei:
+        p.call(always)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_non_retryable_errors_propagate_immediately():
+    calls = {"n": 0}
+    p = RetryPolicy(max_attempts=5, retry_on=(OSError,),
+                    sleep=lambda s: None)
+
+    def wrong_kind():
+        calls["n"] += 1
+        raise KeyError("not transient")
+
+    with pytest.raises(KeyError):
+        p.call(wrong_kind)
+    assert calls["n"] == 1  # no retry burned on a non-transient
+
+
+def test_on_retry_hook_sees_each_failure():
+    seen = []
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.0, sleep=lambda s: None)
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(RetryExhaustedError):
+        p.call(always, on_retry=lambda attempt, exc: seen.append(attempt))
+    assert seen == [0, 1]  # no hook after the final (fatal) attempt
+
+
+def test_wrap_decorator():
+    sleeps = []
+    p = RetryPolicy(max_attempts=2, base_delay_s=0.3, sleep=sleeps.append)
+    calls = {"n": 0}
+
+    @p.wrap
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("once")
+        return x * 2
+
+    assert flaky(21) == 42
+    assert sleeps == [0.3]
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(multiplier=0)

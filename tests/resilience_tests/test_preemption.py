@@ -1,0 +1,151 @@
+"""PreemptionGuard: signal → flag → vote → emergency checkpoint → exit code
+(tier-1, single-process; the SIGTERM is sent to ourselves)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+from chainermn_tpu.resilience import (
+    PREEMPTION_EXIT_CODE,
+    PreemptionGuard,
+    PreemptionInterrupt,
+)
+
+
+class FakeTrainer:
+    def __init__(self, iteration=7):
+        self.iteration = iteration
+        self.extensions = []
+
+
+class FakeCheckpointer:
+    def __init__(self):
+        self.saved_at = []
+
+    def emergency_save(self, trainer):
+        self.saved_at.append(int(trainer.iteration))
+        return int(trainer.iteration)
+
+
+def test_exit_code_is_distinguished():
+    # Clear of success, generic failure, and 128+signum kill encodings.
+    assert PREEMPTION_EXIT_CODE not in (0, 1, 2)
+    assert PREEMPTION_EXIT_CODE < 128
+
+
+def test_interrupt_is_system_exit_with_code():
+    exc = PreemptionInterrupt(42)
+    assert isinstance(exc, SystemExit)
+    assert exc.code == PREEMPTION_EXIT_CODE
+    assert exc.iteration == 42
+
+
+def test_signal_sets_flag_without_raising():
+    with PreemptionGuard() as guard:
+        assert not guard.preempted
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not guard.preempted and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert guard.preempted
+    # Handler restored on uninstall: attribute cleared.
+    assert signal.getsignal(signal.SIGTERM) is not guard._on_signal
+
+
+def test_poll_quiet_until_flagged():
+    ckpt = FakeCheckpointer()
+    guard = PreemptionGuard(checkpointer=ckpt)
+    guard.poll(FakeTrainer(iteration=3))
+    assert ckpt.saved_at == []
+
+
+def test_poll_saves_then_raises_with_agreed_iteration():
+    ckpt = FakeCheckpointer()
+    guard = PreemptionGuard(checkpointer=ckpt)
+    guard.request()
+    with pytest.raises(PreemptionInterrupt) as ei:
+        guard.poll(FakeTrainer(iteration=9))
+    assert ei.value.code == PREEMPTION_EXIT_CODE
+    assert ei.value.iteration == 9
+    assert ckpt.saved_at == [9]  # checkpoint landed BEFORE the exit
+
+
+def test_poll_finds_checkpointer_in_trainer_extensions():
+    from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
+
+    class InlineCkpt(MultiNodeCheckpointer):
+        # Bypass the orbax-backed __init__: only emergency_save matters.
+        def __init__(self):
+            self.saved_at = []
+
+        def emergency_save(self, trainer):
+            self.saved_at.append(int(trainer.iteration))
+            return int(trainer.iteration)
+
+    tr = FakeTrainer(iteration=4)
+    ckpt = InlineCkpt()
+    tr.extensions.append(ckpt)
+    guard = PreemptionGuard()
+    guard.request()
+    with pytest.raises(PreemptionInterrupt):
+        guard.poll(tr)
+    assert ckpt.saved_at == [4]
+
+
+def test_poll_without_checkpointer_still_exits():
+    guard = PreemptionGuard()
+    guard.request()
+    with pytest.raises(PreemptionInterrupt):
+        guard.poll(FakeTrainer())
+
+
+def test_check_every_gates_the_vote():
+    votes = []
+
+    class CountingGuard(PreemptionGuard):
+        def _vote(self):
+            votes.append(1)
+            return 0
+
+    guard = CountingGuard(check_every=4)
+    for it in range(1, 9):
+        guard.poll(FakeTrainer(iteration=it))
+    assert len(votes) == 2  # iterations 4 and 8 only
+
+
+def test_vote_uses_hostcomm_style_callable_op():
+    """A bare HostComm-like comm (callable reduce op) also works."""
+
+    class ObjComm:
+        size = 2
+
+        def __init__(self):
+            self.called = []
+
+        def allreduce_obj(self, obj, op):
+            assert callable(op)
+            self.called.append(obj)
+            return op(obj, 1)  # peer voted yes
+
+    comm = ObjComm()
+    guard = PreemptionGuard(comm=comm, checkpointer=FakeCheckpointer())
+    with pytest.raises(PreemptionInterrupt):
+        guard.poll(FakeTrainer(iteration=2))
+    assert comm.called == [0]  # our local flag was 0; the peer's 1 won
+
+
+def test_repeat_signal_is_idempotent():
+    ckpt = FakeCheckpointer()
+    guard = PreemptionGuard(checkpointer=ckpt)
+    guard.request()
+    guard.request()  # the launcher's teardown SIGTERM racing the save
+    with pytest.raises(PreemptionInterrupt):
+        guard.poll(FakeTrainer(iteration=5))
+    assert ckpt.saved_at == [5]
+
+
+def test_check_every_validation():
+    with pytest.raises(ValueError):
+        PreemptionGuard(check_every=0)

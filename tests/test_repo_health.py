@@ -1,0 +1,61 @@
+"""Repo-health guard: no pyc-only ghost packages, ever again.
+
+``chainermn_tpu/observability/`` once existed only as ``__pycache__`` (its
+sources were lost but the stale bytecode kept the name importable as an
+empty namespace package, silently).  This tier-1 guard fails on:
+
+* any ``__pycache__`` entry whose adjacent source file is missing, and
+* any package directory under ``chainermn_tpu/`` lacking ``__init__.py``
+  (a namespace-package hole where a real package is expected).
+"""
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Non-package dirs that legitimately hold no sources.
+_SKIP_DIRS = {os.path.join("chainermn_tpu", "_native", "build")}
+
+
+def _walk(root):
+    for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, root)):
+        rel = os.path.relpath(dirpath, REPO)
+        if any(rel == s or rel.startswith(s + os.sep) for s in _SKIP_DIRS):
+            dirnames[:] = []
+            continue
+        yield dirpath, dirnames, filenames
+
+
+def test_every_pycache_has_adjacent_sources():
+    orphans = []
+    for root in ("chainermn_tpu", "tests"):
+        for dirpath, dirnames, filenames in _walk(root):
+            if os.path.basename(dirpath) != "__pycache__":
+                continue
+            parent = os.path.dirname(dirpath)
+            for f in filenames:
+                if not f.endswith(".pyc"):
+                    continue
+                src = f.split(".", 1)[0] + ".py"
+                if not os.path.exists(os.path.join(parent, src)):
+                    orphans.append(
+                        os.path.relpath(os.path.join(dirpath, f), REPO)
+                    )
+    assert not orphans, (
+        "stale bytecode with no adjacent source (a pyc-only ghost package "
+        f"in the making — delete it): {orphans}"
+    )
+
+
+def test_every_package_dir_has_init():
+    missing = []
+    for dirpath, dirnames, filenames in _walk("chainermn_tpu"):
+        if os.path.basename(dirpath) == "__pycache__":
+            continue
+        has_py = any(f.endswith(".py") for f in filenames)
+        has_cache = "__pycache__" in dirnames
+        if (has_py or has_cache) and "__init__.py" not in filenames:
+            missing.append(os.path.relpath(dirpath, REPO))
+    assert not missing, (
+        f"package dirs importing as silent namespace packages: {missing}"
+    )
