@@ -167,6 +167,36 @@ def _emit(payload: dict) -> None:
     if dec is not None:
         payload["decode_headline"] = dec
     print(json.dumps(payload))
+    # Compact FINAL summary line (VERDICT r5 items 2 & 8): the composite
+    # payload above has grown past tail windows that capture only the last
+    # few hundred bytes of driver output — a consumer reading just the
+    # final line still gets the verdict: headline metric, the LM-MFU
+    # number (incl. flash-core FLOPs when present), and an unambiguous
+    # cached-vs-live provenance flag.
+    platform = str(payload.get("platform", ""))
+    summary = {
+        "bench_summary": True,
+        "metric": payload.get("metric"),
+        "value": payload.get("value"),
+        "unit": payload.get("unit"),
+        "platform": platform,
+        "cached": "cached" in platform or bool(payload.get("cached")),
+        # Explicit None fallback: _lm_headline always materializes the
+        # incl-flash key (as None for pre-accounting artifacts), so a
+        # plain .get(key, fallback) would never fall back.
+        "lm_mfu_pct_incl_flash": (
+            lm["mfu_pct_incl_flash"]
+            if lm is not None and lm.get("mfu_pct_incl_flash") is not None
+            else (lm.get("mfu_pct") if lm is not None else None)
+        ),
+        "decode_tokens_per_sec": (
+            dec.get("tokens_per_sec") if dec is not None else None
+        ),
+    }
+    for k in ("cache_age_hours", "cache_source_commit", "error"):
+        if payload.get(k) is not None:
+            summary[k] = payload[k]
+    print(json.dumps(summary))
 
 
 def _fail(reason: str, cache_ok: bool = False) -> None:
@@ -222,6 +252,15 @@ def _fail(reason: str, cache_ok: bool = False) -> None:
             cached["cached_from"] = prior
             cached["live_probe"] = {"platform": "unreachable",
                                     "error": reason}
+            # Staleness in hours, computed (not just restated) so a
+            # consumer can gate on "fresh enough" without parsing the
+            # stamp; None when only a git-reset mtime was available.
+            cached["cache_age_hours"] = _stamp_age_hours(
+                prev.get("measured_at")
+            )
+            # Which commit last touched the serving artifact — the cache's
+            # provenance in repo history (VERDICT r5 item 8).
+            cached["cache_source_commit"] = _artifact_commit(here, prior)
             json.dumps(cached)  # serializability gate, before we commit
         except Exception:
             cached = None  # fall through to the loud failure record below
@@ -262,6 +301,36 @@ def _fail(reason: str, cache_ok: bool = False) -> None:
     # dropped entirely; value 0.0 + platform "unreachable"/"failed" is the
     # gate signal for any consumer.
     sys.exit(0)
+
+
+def _stamp_age_hours(measured_at) -> float | None:
+    """Hours since an ISO-8601Z ``measured_at`` stamp; None when absent or
+    unparseable (a wrong age is worse than no age)."""
+    if not measured_at:
+        return None
+    try:
+        import calendar
+
+        t = calendar.timegm(
+            time.strptime(str(measured_at), "%Y-%m-%dT%H:%M:%SZ")
+        )
+        return round(max(time.time() - t, 0.0) / 3600.0, 2)
+    except Exception:
+        return None
+
+
+def _artifact_commit(here: str, rel_path: str) -> str | None:
+    """The commit that last touched ``rel_path`` (cache provenance);
+    None outside git or for an untracked artifact."""
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%H", "--", rel_path],
+            cwd=here, capture_output=True, timeout=10,
+        )
+        commit = out.stdout.decode().strip()
+        return commit or None
+    except Exception:
+        return None
 
 
 def _config_matches(prev: dict) -> bool:

@@ -46,11 +46,14 @@ from chainermn_tpu.extensions import (  # noqa: E402
 from chainermn_tpu import global_except_hook  # noqa: E402
 from chainermn_tpu import resilience  # noqa: E402
 from chainermn_tpu.resilience import (  # noqa: E402
+    HEALTH_EXIT_CODE,
     PREEMPTION_EXIT_CODE,
     FailureDetector,
     PeerFailedError,
     PreemptionGuard,
+    RankDivergedError,
     RetryPolicy,
+    TrainingHealthGuard,
 )
 
 global_except_hook._add_hook_if_enabled()
@@ -103,6 +106,9 @@ __all__ = [
     "FailureDetector",
     "PeerFailedError",
     "PreemptionGuard",
+    "RankDivergedError",
+    "TrainingHealthGuard",
     "RetryPolicy",
     "PREEMPTION_EXIT_CODE",
+    "HEALTH_EXIT_CODE",
 ]

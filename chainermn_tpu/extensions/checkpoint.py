@@ -18,8 +18,12 @@ so resume is exact.
 
 from __future__ import annotations
 
+import itertools
+import json
 import os
-from typing import Any, Tuple
+import shutil
+from collections import deque
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -41,6 +45,7 @@ class MultiNodeCheckpointer(Extension):
         max_to_keep: int = 5,
         trigger=(1, "epoch"),
         async_save: bool = True,
+        known_good_keep: int = 3,
     ):
         super().__init__(self._fire, trigger=trigger, name=f"checkpointer/{name}")
         import orbax.checkpoint as ocp
@@ -68,6 +73,21 @@ class MultiNodeCheckpointer(Extension):
                 enable_async_checkpointing=async_save,
             ),
         )
+        # Known-good ring (training-health guard, resilience/guard.py): the
+        # last K snapshot steps that survived a clean cross-rank
+        # consistency vote.  A snapshot's mere existence only proves the
+        # job was ALIVE at the trigger; membership here proves the
+        # replicas still agreed — the only steps rollback recovery may
+        # target.  Persisted next to the snapshots so a supervised
+        # relaunch after a health escalation resumes from verified state.
+        self._known_good: deque = deque(maxlen=int(known_good_keep))
+        for s in self._load_known_good():
+            self._known_good.append(int(s))
+        # Newest step save() committed in THIS life — rank-invariant (the
+        # trigger fires at the same iterations everywhere), so blessing
+        # can skip its async flush deterministically when nothing new
+        # could possibly be on disk.
+        self._last_saved_step: Optional[int] = None
 
     # ----------------------------------------------------------------- save
     def _fire(self, trainer):
@@ -109,6 +129,7 @@ class MultiNodeCheckpointer(Extension):
             )
 
         self._save_retry.call(_commit)
+        self._last_saved_step = step
 
     def emergency_save(self, trainer) -> int:
         """Preemption entry point (:class:`PreemptionGuard`): one
@@ -182,11 +203,17 @@ class MultiNodeCheckpointer(Extension):
         """Reference anchor: ``_MultiNodeCheckpointer.maybe_load`` — restore
         the latest complete snapshot if one exists; otherwise return the
         inputs unchanged.  Returns ``(state, iteration)``."""
-        import orbax.checkpoint as ocp
-
         step = self._mngr.latest_step()
         if step is None:
             return state, 0
+        return self.restore_step(step, state, trainer)
+
+    def restore_step(self, step, state, trainer=None) -> Tuple[Any, int]:
+        """Restore a SPECIFIC snapshot step into ``state``/``trainer`` —
+        the rollback-recovery entry point (``maybe_load`` is this at
+        ``latest_step``).  Collective: every rank restores together."""
+        import orbax.checkpoint as ocp
+
         template = {
             "train_state": jax.tree_util.tree_map(
                 ocp.utils.to_shape_dtype_struct, state
@@ -198,35 +225,43 @@ class MultiNodeCheckpointer(Extension):
         except Exception:
             # Backward-compatible retries: snapshots predating leaves the
             # CURRENT template carries (it_inexact; ema_params when the
-            # user enables EMA on an existing run) restore against a
+            # user enables EMA on an existing run; the health carry when a
+            # TrainingHealthGuard is newly attached) restore against a
             # template without those leaves, then the new leaves re-seed.
-            # The snapshot may be missing EITHER or BOTH, so each drop
+            # The snapshot may be missing ANY subset, so every drop
             # combination is tried independently (dropping a leaf the
             # snapshot HAS would hit the opposite structure mismatch).
             # Ordered LEAST-destructive first (ADVICE r3): {it} costs only
-            # a counter re-seed, {ema} discards a trained average — if a
-            # future orbax version ever tolerates an extra checkpoint
-            # subtree, trying {ema} first would silently throw away a
-            # saved EMA from a snapshot that merely predates it_inexact.
+            # a counter re-seed, {health} resets the guard's anomaly
+            # counters, {ema} discards a trained average — if a future
+            # orbax version ever tolerates an extra checkpoint subtree,
+            # trying {ema} first would silently throw away a saved EMA
+            # from a snapshot that merely predates it_inexact.
             ts = template["train_state"]
-            has_ema = getattr(ts, "ema_params", None) is not None
-            has_it = "it_inexact" in template["loop"]
-            drop_sets = []
-            if has_it:
-                drop_sets.append({"it"})
-            if has_ema:
-                drop_sets.append({"ema"})
-            if has_ema and has_it:
-                drop_sets.append({"ema", "it"})
+            optional = []
+            if "it_inexact" in template["loop"]:
+                optional.append("it")
+            if getattr(ts, "health", None) is not None:
+                optional.append("health")
+            if getattr(ts, "ema_params", None) is not None:
+                optional.append("ema")
+            drop_sets = [
+                set(c)
+                for k in range(1, len(optional) + 1)
+                for c in itertools.combinations(optional, k)
+            ]
             if not drop_sets:
                 raise
-            restored = dropped_ema = None
+            restored = None
+            dropped = set()
             for drops in drop_sets:
+                ts2 = ts
+                if "ema" in drops:
+                    ts2 = ts2.replace(ema_params=None)
+                if "health" in drops:
+                    ts2 = ts2.replace(health=None)
                 t2 = {
-                    "train_state": (
-                        ts.replace(ema_params=None)
-                        if "ema" in drops else ts
-                    ),
+                    "train_state": ts2,
                     "loop": (
                         {k: v for k, v in template["loop"].items()
                          if k != "it_inexact"}
@@ -235,13 +270,13 @@ class MultiNodeCheckpointer(Extension):
                 }
                 try:
                     restored = self._restore(step, t2)
-                    dropped_ema = "ema" in drops
+                    dropped = drops
                     break
                 except Exception:
                     continue
             if restored is None:
                 raise
-            if dropped_ema:
+            if "ema" in dropped:
                 # Seed the average from the restored params (the same
                 # no-debias init a fresh EMA run uses), in fp32.
                 rs = restored["train_state"]
@@ -249,6 +284,11 @@ class MultiNodeCheckpointer(Extension):
                     ema_params=jax.tree_util.tree_map(
                         lambda p: np.asarray(p, np.float32), rs.params
                     )
+                )
+            if "health" in dropped:
+                # Fresh guard counters, exactly as a first bind seeds them.
+                restored["train_state"] = restored["train_state"].replace(
+                    health=np.zeros(3, np.float32)
                 )
         new_state = restored["train_state"]
         # Re-place on the communicator's mesh, honoring each INPUT leaf's
@@ -374,6 +414,110 @@ class MultiNodeCheckpointer(Extension):
                 else int(loop["iteration"])
             )
 
+    # ------------------------------------------------- known-good ring
+    # (training-health guard rollback recovery — see resilience/guard.py)
+    def mark_known_good_upto(self, iteration: int) -> List[int]:
+        """Bless every saved snapshot step ≤ ``iteration`` not yet in the
+        ring.  Called by the guard after a CLEAN consistency vote at that
+        iteration: a vote only vouches for state it actually inspected, so
+        snapshots from the future (or from before a rollback) never enter.
+        Flushes in-flight async commits first so every rank blesses the
+        same step set — skipped (deterministically: the gate depends only
+        on rank-invariant state) when no save since the newest blessed
+        step means there is nothing new to flush or bless.  Returns the
+        newly blessed steps."""
+        newest_blessed = max(self._known_good, default=None)
+        if self._last_saved_step is None or (
+            newest_blessed is not None
+            and self._last_saved_step <= newest_blessed
+        ):
+            return []
+        self._mngr.wait_until_finished()
+        eligible = sorted(
+            int(s) for s in self._mngr.all_steps() if s <= int(iteration)
+        )
+        # Only the newest ring-capacity's worth: blessing older steps just
+        # to evict them immediately would make the return value (and the
+        # persisted ring) churn.
+        new = []
+        for s in eligible[-self._known_good.maxlen:]:
+            if s not in self._known_good:
+                self._known_good.append(s)
+                new.append(s)
+        if new:
+            self._persist_known_good()
+        return new
+
+    def latest_known_good(self) -> Optional[int]:
+        """Newest step that survived a clean consistency vote AND still
+        exists on disk (orbax's ``max_to_keep`` gc may have reaped an old
+        blessed step), or None when no rollback target exists."""
+        on_disk = {int(s) for s in self._mngr.all_steps()}
+        good = [s for s in self._known_good if s in on_disk]
+        return max(good) if good else None
+
+    def known_good_steps(self) -> List[int]:
+        return sorted(self._known_good)
+
+    def discard_after(self, step: int) -> List[int]:
+        """Delete every snapshot NEWER than ``step`` — they were taken on
+        (potentially) poisoned state between the last blessing vote and an
+        escalation.  Collective: call on every rank together — orbax's
+        ``delete`` is itself a cross-process op (the primary host removes
+        the directory, then ALL processes barrier-sync), so gating it to
+        one rank would deadlock that rank in the sync.  The re-run of the
+        rolled-back iterations re-saves those steps cleanly.  Returns the
+        deleted steps."""
+        self._mngr.wait_until_finished()
+        doomed = sorted(int(s) for s in self._mngr.all_steps() if s > step)
+        fell_back = False
+        for s in doomed:
+            try:
+                self._mngr.delete(s)
+            except Exception:
+                # Last-resort path (orbax sync hiccup): the primary
+                # removes the directory; the barrier below resynchronizes
+                # and reload() refreshes every rank's step cache.
+                fell_back = True
+                if jax.process_index() == 0:
+                    shutil.rmtree(
+                        os.path.join(self._dir, str(s)), ignore_errors=True
+                    )
+        while self._known_good and max(self._known_good) > step:
+            self._known_good.remove(max(self._known_good))
+        if self._last_saved_step is not None:
+            self._last_saved_step = min(self._last_saved_step, int(step))
+        self._persist_known_good()
+        if fell_back:
+            if jax.process_count() > 1 and hasattr(self.comm, "barrier"):
+                self.comm.barrier()
+            try:
+                self._mngr.reload()
+            except AttributeError:  # pragma: no cover - pre-reload orbax
+                pass
+        return doomed
+
+    def _known_good_path(self) -> str:
+        return os.path.join(self._dir, "known_good.json")
+
+    def _load_known_good(self) -> List[int]:
+        try:
+            with open(self._known_good_path()) as f:
+                return [int(s) for s in json.load(f)["steps"]]
+        except Exception:
+            return []
+
+    def _persist_known_good(self) -> None:
+        if jax.process_index() != 0:
+            return
+        try:
+            tmp = self._known_good_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"steps": sorted(self._known_good)}, f)
+            os.replace(tmp, self._known_good_path())
+        except OSError:  # best-effort: the ring also lives in memory
+            pass
+
     # ------------------------------------------------------------------ misc
     def all_steps(self):
         return list(self._mngr.all_steps())
@@ -393,13 +537,19 @@ def create_multi_node_checkpointer(
     max_to_keep: int = 5,
     trigger=(1, "epoch"),
     async_save: bool = True,
+    known_good_keep: int = 3,
 ) -> MultiNodeCheckpointer:
     """Reference anchor: ``create_multi_node_checkpointer(name, comm)``.
 
     ``async_save=False`` commits synchronously at the trigger — use when a
     crash immediately after the trigger must still find that snapshot
-    complete (fault-injection tests; final pre-shutdown saves)."""
+    complete (fault-injection tests; final pre-shutdown saves).
+
+    ``known_good_keep`` bounds the ring of vote-blessed snapshots kept for
+    the training-health guard's rollback recovery (``docs/resilience.md``);
+    it should not exceed ``max_to_keep`` or blessed steps may already be
+    garbage-collected when a rollback wants them."""
     return MultiNodeCheckpointer(
         name, comm, path=path, max_to_keep=max_to_keep, trigger=trigger,
-        async_save=async_save,
+        async_save=async_save, known_good_keep=known_good_keep,
     )

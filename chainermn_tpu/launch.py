@@ -33,6 +33,7 @@ import subprocess
 import sys
 import time
 
+from chainermn_tpu.resilience.guard import HEALTH_EXIT_CODE
 from chainermn_tpu.resilience.preemption import PREEMPTION_EXIT_CODE
 
 
@@ -151,6 +152,7 @@ def supervise(
     env_extra: dict = None,
     restart_nproc: int = None,
     preempt_restarts: int = 8,
+    health_restarts: int = 2,
 ) -> int:
     """Run the job, relaunching it up to ``restarts`` times on failure.
 
@@ -177,12 +179,24 @@ def supervise(
     failure ``restarts`` budget (a preempted job is healthy; it must not
     exhaust the crash budget of a flaky one).
 
+    **Training-health contract**: a job exiting with
+    :data:`~chainermn_tpu.resilience.HEALTH_EXIT_CODE` escalated past the
+    TrainingHealthGuard's IN-PROCESS recovery (its rollbacks never reach
+    this supervisor — they are accounted in the guard's own
+    ``[chainermn_tpu.guard]`` health lines) — the state on disk was pruned
+    back to the last known-good snapshot, so a relaunch resumes verified
+    state.  It consumes the separate ``health_restarts`` allowance: a sick
+    job is neither a crashing one (``restarts``) nor a healthy preempted
+    one (``preempt_restarts``), and the three budgets must not poach from
+    each other.
+
     Each attempt emits one health line to stderr:
-    ``attempt N: nproc=X rc=Y (ok|failure|preemption) duration=Zs``.
+    ``attempt N: nproc=X rc=Y (ok|failure|preemption|health) duration=Zs``.
     """
     attempt = 0
     fail_used = 0
     preempt_used = 0
+    health_used = 0
     while True:
         n = nproc if attempt == 0 else (restart_nproc or nproc)
         env = dict(env_extra or {})
@@ -192,6 +206,7 @@ def supervise(
         kind = (
             "ok" if rc == 0
             else "preemption" if rc == PREEMPTION_EXIT_CODE
+            else "health" if rc == HEALTH_EXIT_CODE
             else "failure"
         )
         sys.stderr.write(
@@ -209,6 +224,17 @@ def supervise(
                 f"[chainermn_tpu.launch] job preempted (rc={rc}); "
                 f"restart {preempt_used}/{preempt_restarts} (preemption "
                 f"allowance, n={restart_nproc or nproc}) in {backoff_s:.1f}s\n"
+            )
+        elif rc == HEALTH_EXIT_CODE:
+            if health_used >= health_restarts:
+                return rc
+            health_used += 1
+            attempt += 1
+            sys.stderr.write(
+                f"[chainermn_tpu.launch] training-health escalation "
+                f"(rc={rc}); restart {health_used}/{health_restarts} "
+                f"(health allowance, n={restart_nproc or nproc}) in "
+                f"{backoff_s:.1f}s\n"
             )
         else:
             if fail_used >= restarts:
@@ -246,6 +272,13 @@ def main():
                          f"preemptions (exit code {PREEMPTION_EXIT_CODE}: "
                          "the PreemptionGuard already checkpointed); does "
                          "not consume --restarts")
+    ap.add_argument("--health-restarts", type=int, default=2,
+                    help="separate relaunch allowance for training-health "
+                         f"escalations (exit code {HEALTH_EXIT_CODE}: the "
+                         "TrainingHealthGuard exhausted in-process "
+                         "rollback recovery and pruned the checkpoint "
+                         "trail back to known-good state); does not "
+                         "consume --restarts")
     ap.add_argument("script", help="python script to run on every rank")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args()
@@ -255,6 +288,7 @@ def main():
             backoff_s=ns.restart_backoff, grace_s=ns.grace,
             restart_nproc=ns.restart_nproc,
             preempt_restarts=ns.preempt_restarts,
+            health_restarts=ns.health_restarts,
         )
     )
 

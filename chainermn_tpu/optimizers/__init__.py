@@ -136,6 +136,12 @@ class TrainState:
     # export with these for the Polyak-averaged model.  Initialized to the
     # params themselves, so no debias term is needed.
     ema_params: Any = None
+    # Training-health carry (``health_check=True`` steps): float32
+    # ``[grad_norm_ema, healthy_steps_seen, skipped_total]``, replicated.
+    # None when the health guard is off — seeded by
+    # ``TrainingHealthGuard.bind`` (resilience/guard.py), so existing
+    # checkpoints/states are untouched unless a guard is attached.
+    health: Any = None
 
 
 class MultiNodeOptimizer:
@@ -291,6 +297,10 @@ class MultiNodeOptimizer:
         accum_steps: int = 1,
         augment: Optional[Callable] = None,
         augment_seed: int = 0,
+        health_check: bool = False,
+        spike_factor: float = 10.0,
+        spike_warmup: int = 20,
+        spike_ema_beta: float = 0.1,
     ) -> Callable:
         """Build the jitted SPMD train step (reference hot loop §3.2).
 
@@ -313,6 +323,21 @@ class MultiNodeOptimizer:
         ``(augment_seed, state.step, device mesh position)`` — per-step,
         per-replica randomness, bit-reproducible across runs (see
         ``ops/augment.py``).
+
+        ``health_check=True`` adds the training-health guard's in-graph
+        step anomaly detection (``resilience/guard.py``): the step's
+        verdict is computed from the globally *reduced* gradients and the
+        pmean'd loss — values every device already holds identically, so
+        all ranks agree on it with ZERO extra collectives.  A step whose
+        loss/gradients are non-finite, or whose fp32 global gradient norm
+        exceeds ``spike_factor`` × a running EMA (tracked in
+        ``state.health``, armed after ``spike_warmup`` healthy steps), is
+        a **no-op**: params, optimizer state, EMA params, model state,
+        pending grads, and EF residuals all keep their previous values
+        (only ``step`` advances).  The verdict is exported as the
+        ``step_ok`` metric (plus ``grad_norm`` / ``health_skipped``) for
+        the guard's host-side skip-budget accounting.  Requires
+        ``state.health`` to be seeded (``TrainingHealthGuard.bind``).
         """
         comm = self.comm
         if not isinstance(comm, XlaCommunicator):
@@ -383,9 +408,72 @@ class MultiNodeOptimizer:
                 )
             else:
                 ema = state.ema_params
-            metrics = {"loss": lax.pmean(loss, comm.axis_name)}
+            loss_mean = lax.pmean(loss, comm.axis_name)
+            metrics = {"loss": loss_mean}
             for k, v in aux.items():
                 metrics[k] = lax.pmean(v, comm.axis_name)
+            new_health = state.health
+            if health_check:
+                if state.health is None:
+                    raise ValueError(
+                        "health_check=True needs a seeded state.health "
+                        "carry — attach the guard via "
+                        "TrainingHealthGuard.bind(trainer) (or pass "
+                        "state.replace(health=jnp.zeros(3, jnp.float32)))"
+                    )
+                # Verdict from values already identical on every device
+                # (post-psum grads, pmean'd loss): any non-finite leaf
+                # makes the fp32 norm-of-squares non-finite, so two
+                # isfinite checks cover NaN/Inf anywhere in the tree.
+                gnorm = jnp.sqrt(
+                    sum(
+                        jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree_util.tree_leaves(grads)
+                    )
+                )
+                ema_n, seen, skipped = (
+                    state.health[0], state.health[1], state.health[2]
+                )
+                finite = jnp.isfinite(loss_mean) & jnp.isfinite(gnorm)
+                spike = (
+                    (seen >= spike_warmup)
+                    & (ema_n > 0.0)
+                    & (gnorm > spike_factor * ema_n)
+                )
+                ok = finite & ~spike
+                okf = ok.astype(jnp.float32)
+                # The norm EMA learns only from healthy steps (a skipped
+                # spike must not drag the threshold up after itself) and
+                # seeds itself on the first healthy step.
+                ema_upd = jnp.where(
+                    seen > 0.0,
+                    ema_n * (1.0 - spike_ema_beta) + gnorm * spike_ema_beta,
+                    gnorm,
+                )
+                new_health = jnp.stack([
+                    jnp.where(ok, ema_upd, ema_n),
+                    seen + okf,
+                    skipped + (1.0 - okf),
+                ])
+
+                def _keep(new_tree, old_tree):
+                    return jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree
+                    )
+
+                # A poisoned step is a full no-op: nothing the bad
+                # gradients touched survives — not the params, not the
+                # optimizer moments, not the EMA, not the dbuf carry or
+                # EF residual (both hold the poison), not the BN stats.
+                params = _keep(params, state.params)
+                opt_state = _keep(opt_state, state.opt_state)
+                pending = _keep(pending, state.pending_grads)
+                new_resid = _keep(new_resid, state.ef_residual)
+                ema = _keep(ema, state.ema_params)
+                new_model_state = _keep(new_model_state, state.model_state)
+                metrics["step_ok"] = okf
+                metrics["grad_norm"] = gnorm
+                metrics["health_skipped"] = new_health[2]
             return (
                 TrainState(
                     step=state.step + 1,
@@ -395,6 +483,7 @@ class MultiNodeOptimizer:
                     model_state=new_model_state,
                     ef_residual=new_resid,
                     ema_params=ema,
+                    health=new_health,
                 ),
                 metrics,
             )
@@ -412,6 +501,7 @@ class MultiNodeOptimizer:
             model_state=P(),
             ef_residual=P(axes) if compression is not None else P(),
             ema_params=P(),
+            health=P(),
         )
         mapped = jax.shard_map(
             body,
@@ -434,17 +524,24 @@ class MultiNodeOptimizer:
         accum_steps: int = 1,
         augment: Optional[Callable] = None,
         augment_seed: int = 0,
+        health_check: bool = False,
+        spike_factor: float = 10.0,
+        spike_warmup: int = 20,
+        spike_ema_beta: float = 0.1,
     ) -> Tuple[TrainState, dict]:
         """Eager-style API mirroring ``_MultiNodeOptimizer.update``: caches the
         jitted step per ``loss_fn``."""
         return _eager_update(
             self, state, batch, loss_fn, has_aux, stateful, accum_steps,
-            augment, augment_seed,
+            augment, augment_seed, health_check, spike_factor, spike_warmup,
+            spike_ema_beta,
         )
 
 
 def _eager_update(opt, state, batch, loss_fn, has_aux, stateful,
-                  accum_steps=1, augment=None, augment_seed=0):
+                  accum_steps=1, augment=None, augment_seed=0,
+                  health_check=False, spike_factor=10.0, spike_warmup=20,
+                  spike_ema_beta=0.1):
     """Shared eager-style update: cache the jitted step per (loss_fn, flags)
     — keyed by the FUNCTION OBJECT (holding a reference), not ``id()``,
     which can be recycled after gc — and serialize steps on the CPU
@@ -452,12 +549,21 @@ def _eager_update(opt, state, batch, loss_fn, has_aux, stateful,
     deadlock when launches overlap across the virtual device pool.  The CPU
     mesh exists only to SIMULATE a pod; real TPU/GPU paths keep async
     dispatch and compiler overlap."""
-    key = (loss_fn, has_aux, stateful, accum_steps, augment, augment_seed)
+    key = (loss_fn, has_aux, stateful, accum_steps, augment, augment_seed,
+           health_check, spike_factor, spike_warmup, spike_ema_beta)
     step = opt._step_cache.get(key)
     if step is None:
+        # Health kwargs only when armed: this helper is shared with tiers
+        # whose make_train_step has no in-graph health check (ZeRO), and
+        # they must keep working un-guarded.
+        health_kwargs = (
+            dict(health_check=True, spike_factor=spike_factor,
+                 spike_warmup=spike_warmup, spike_ema_beta=spike_ema_beta)
+            if health_check else {}
+        )
         step = opt._step_cache[key] = opt.make_train_step(
             loss_fn, has_aux, stateful, accum_steps=accum_steps,
-            augment=augment, augment_seed=augment_seed,
+            augment=augment, augment_seed=augment_seed, **health_kwargs,
         )
         if len(opt._step_cache) == 9:  # warn once, at the 9th variant
             import warnings

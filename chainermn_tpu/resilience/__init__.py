@@ -14,8 +14,15 @@ last checkpoint.  This package supplies the other half:
   converts SIGTERM into a rank-synchronized emergency checkpoint and a
   distinguished exit code the launcher always restarts.
 * :mod:`~chainermn_tpu.resilience.faults` — ``CMN_FAULT`` deterministic
-  fault injection (``crash@iter:5``, ``hang@barrier:3``, ...), the
-  backbone of the multiprocess robustness tests.
+  fault injection (``crash@iter:5``, ``hang@barrier:3``, ...; fail-silent:
+  ``nan@grad:5``, ``spike@loss:5``, ``flip@param:7``, ``skew@step:3:150ms``),
+  the backbone of the multiprocess robustness tests.
+* :mod:`~chainermn_tpu.resilience.guard` /
+  :mod:`~chainermn_tpu.resilience.consistency` — the training-HEALTH half
+  (fail-silent/fail-slow): in-graph step anomaly detection with a bounded
+  skip budget, cross-rank digest voting that localizes a diverged replica
+  (:class:`RankDivergedError`), known-good rollback recovery, and
+  straggler surfacing over the heartbeat mesh.
 
 See ``docs/resilience.md`` for the failure model and every knob.
 """
@@ -41,7 +48,25 @@ from chainermn_tpu.resilience.preemption import (
     PreemptionGuard,
     PreemptionInterrupt,
 )
-from chainermn_tpu.resilience import detector, faults, policy, preemption
+from chainermn_tpu.resilience.consistency import (
+    RankDivergedError,
+    VoteResult,
+    majority_vote,
+    tree_digest,
+)
+from chainermn_tpu.resilience.guard import (
+    HEALTH_EXIT_CODE,
+    HealthEscalationInterrupt,
+    TrainingHealthGuard,
+)
+from chainermn_tpu.resilience import (
+    consistency,
+    detector,
+    faults,
+    guard,
+    policy,
+    preemption,
+)
 
 __all__ = [
     "ALIVE",
@@ -60,8 +85,17 @@ __all__ = [
     "PREEMPTION_EXIT_CODE",
     "PreemptionGuard",
     "PreemptionInterrupt",
+    "HEALTH_EXIT_CODE",
+    "HealthEscalationInterrupt",
+    "TrainingHealthGuard",
+    "RankDivergedError",
+    "VoteResult",
+    "majority_vote",
+    "tree_digest",
+    "consistency",
     "detector",
     "faults",
+    "guard",
     "policy",
     "preemption",
 ]

@@ -181,6 +181,13 @@ class FailureDetector:
         self._threads = []
         self._seq = 0
         self._started = False
+        # Step-time stats piggybacked on the heartbeat payload (the
+        # training-health guard's straggler plane): rank -> (stamp, stats)
+        # where stamp is the ORIGIN rank's heartbeat seq — gossip merges
+        # freshest-wins per origin, so stats flood the ring like death
+        # verdicts do, with zero extra connections or frames.
+        self._local_stats: Optional[dict] = None
+        self._peer_stats: Dict[int, Tuple[int, dict]] = {}
 
     # ---------------------------------------------------------------- state
     @property
@@ -194,6 +201,23 @@ class FailureDetector:
     def dead_ranks(self) -> Set[int]:
         with self._mu:
             return self.core.dead()
+
+    # ------------------------------------------------------ stats piggyback
+    def set_local_stats(self, stats: dict) -> None:
+        """Publish this rank's step-time stats; the next heartbeat carries
+        them (and every later one, gossiping around the ring)."""
+        with self._mu:
+            self._local_stats = dict(stats)
+
+    def peer_stats(self) -> Dict[int, dict]:
+        """Freshest known stats per rank (self included), from gossip.
+        Eventually consistent: a rank's entry lags by up to ring-diameter
+        heartbeat intervals."""
+        with self._mu:
+            out = {r: dict(s) for r, (_, s) in self._peer_stats.items()}
+            if self._local_stats is not None:
+                out[self.core.rank] = dict(self._local_stats)
+        return out
 
     def check(self, op: str = "collective") -> None:
         """Raise :class:`PeerFailedError` if any peer is known dead.
@@ -251,7 +275,14 @@ class FailureDetector:
         while not self._stop.wait(self.core.interval_s):
             with self._mu:
                 self._seq += 1
-                payload = ("hb", self._seq, sorted(self.core.dead()))
+                gossip = {r: ts for r, ts in self._peer_stats.items()}
+                if self._local_stats is not None:
+                    gossip[self.core.rank] = (
+                        self._seq, dict(self._local_stats)
+                    )
+                payload = (
+                    "hb", self._seq, sorted(self.core.dead()), gossip
+                )
             try:
                 self._tp.send_obj(payload, self.core.succ)
             except Exception:
@@ -265,11 +296,26 @@ class FailureDetector:
         while not self._stop.is_set():
             try:
                 msg = self._tp.recv_obj(self.core.pred, timeout_ms=wait_ms)
-                if isinstance(msg, tuple) and len(msg) == 3 and msg[0] == "hb":
+                # 3-tuples are pre-stats heartbeats (older peer) — still
+                # valid beats; 4-tuples carry the stats gossip map.
+                if isinstance(msg, tuple) and len(msg) in (3, 4) \
+                        and msg[0] == "hb":
                     with self._mu:
                         self.core.note_heartbeat(
                             self.core.pred, self._clock(), dead_ranks=msg[2]
                         )
+                        if len(msg) == 4 and isinstance(msg[3], dict):
+                            for r, ts in msg[3].items():
+                                r = int(r)
+                                if r == self.core.rank or not (
+                                    isinstance(ts, tuple) and len(ts) == 2
+                                ):
+                                    continue
+                                prev = self._peer_stats.get(r)
+                                if prev is None or prev[0] < ts[0]:
+                                    self._peer_stats[r] = (
+                                        int(ts[0]), dict(ts[1])
+                                    )
             except TimeoutError:
                 pass
             except Exception:

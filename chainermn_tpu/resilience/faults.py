@@ -11,6 +11,15 @@ same detection/teardown/recovery machinery a preempted TPU pod does:
     CMN_FAULT=drop@recv:2         # discard the frame of the 2nd recv
     CMN_FAULT=slow@send:50ms;crash@iter:7     # ';'-separated composition
 
+Fail-SILENT kinds (the training-health guard's test vocabulary — faults
+that corrupt the run without killing any process, see ``resilience/guard.py``
+and ``docs/resilience.md``):
+
+    CMN_FAULT=nan@grad:5          # step 5's batch -> NaN: loss/grads poisoned
+    CMN_FAULT=spike@loss:5        # step 5's batch x1e3: loss/grad-norm spike
+    CMN_FAULT=flip@param:7        # corrupt one param element after step 7
+    CMN_FAULT=skew@step:3:150ms   # from step 3 on, stretch every step 150ms
+
 Scoping env vars:
 
 * ``CMN_FAULT_RANK`` — inject only on this rank (default: every rank).
@@ -18,17 +27,23 @@ Scoping env vars:
   (default 0: the first launch), so a supervised relaunch is automatically
   fault-free — the deterministic replacement for "fire once" marker files.
 
-Grammar: ``kind@site:arg`` where ``kind`` ∈ {crash, hang, slow, drop},
-``site`` is a hook-point name (``iter``/``barrier``/``send``/``recv`` today;
-any identifier parses), and ``arg`` is a 1-based hit count for one-shot
-kinds (crash/hang/drop) or a duration (``200ms``/``1.5s``) for ``slow``.
-crash/hang/slow fire at any site; ``drop`` is message-shaped and honored
-at the ``send`` (message lost on the wire) and ``recv`` (frame discarded
-on arrival) hook points.
+Grammar: ``kind@site:arg`` where ``kind`` ∈ {crash, hang, slow, drop, nan,
+spike, flip, skew}, ``site`` is a hook-point name
+(``iter``/``barrier``/``send``/``recv``/``grad``/``loss``/``param``/``step``
+today; any identifier parses), and ``arg`` is a 1-based hit count for
+one-shot kinds (crash/hang/drop/nan/spike/flip), a duration
+(``200ms``/``1.5s``) for ``slow``, or ``N:duration`` for ``skew`` (from hit
+N on, every hit is stretched by the duration; a bare duration means
+``1:duration``).  crash/hang/slow fire at any site; ``drop`` is
+message-shaped and honored at ``send``/``recv``; the fail-silent kinds are
+value-shaped and honored by the trainer's :func:`poison_batch` (``nan``,
+``spike``) and :func:`corrupt_params` (``flip``) helpers plus the ``step``
+hook (``skew``).
 
 Hook points live in :class:`chainermn_tpu.hostcomm.HostComm`
 (barrier/send/recv) and the :class:`chainermn_tpu.training.Trainer` step
-loop (iter).  ``hang`` freezes registered collaborators first (the
+loop (iter, plus the fail-silent sites grad/loss/param/step, all counted by
+trainer iteration).  ``hang`` freezes registered collaborators first (the
 :class:`~chainermn_tpu.resilience.detector.FailureDetector`'s heartbeat
 threads) so it models a *frozen host* — the whole process stops, heartbeats
 included — not a live process with one stuck thread.
@@ -43,8 +58,14 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-KINDS = ("crash", "hang", "slow", "drop")
-ONE_SHOT_KINDS = ("crash", "hang", "drop")
+KINDS = ("crash", "hang", "slow", "drop", "nan", "spike", "flip", "skew")
+ONE_SHOT_KINDS = ("crash", "hang", "drop", "nan", "spike", "flip")
+#: Value-shaped one-shot kinds: ``hook()`` RETURNS them as the action (the
+#: caller applies the corruption) instead of acting in-process.
+VALUE_KINDS = ("drop", "nan", "spike", "flip")
+#: Batch-scale factor for ``spike`` — big enough to blow the gradient norm
+#: past any sane spike threshold, small enough to stay finite in fp32.
+SPIKE_SCALE = 1e3
 
 _SPEC_RE = re.compile(
     r"^(?P<kind>[a-z]+)@(?P<site>[A-Za-z_][A-Za-z0-9_]*):(?P<arg>[^@;]+)$"
@@ -73,8 +94,25 @@ class FaultSpec:
 
     @property
     def text(self) -> str:
-        arg = f"{self.n}" if self.n is not None else f"{self.duration_s}s"
+        if self.kind == "skew":
+            arg = f"{self.n}:{self.duration_s}s"
+        elif self.n is not None:
+            arg = f"{self.n}"
+        else:
+            arg = f"{self.duration_s}s"
         return f"{self.kind}@{self.site}:{arg}"
+
+
+def _parse_duration(arg: str, part: str) -> float:
+    dm = _DURATION_RE.match(arg)
+    if not dm:
+        raise FaultSpecError(
+            f"need a duration arg like 200ms or 1.5s, got {arg!r} in {part!r}"
+        )
+    dur = float(dm.group("num"))
+    if dm.group("unit") == "ms":
+        dur /= 1000.0
+    return dur
 
 
 def parse_fault_spec(spec: str) -> List[FaultSpec]:
@@ -100,16 +138,27 @@ def parse_fault_spec(spec: str) -> List[FaultSpec]:
                 f"unknown fault kind {kind!r} in {part!r} (one of {KINDS})"
             )
         if kind == "slow":
-            dm = _DURATION_RE.match(arg)
-            if not dm:
-                raise FaultSpecError(
-                    f"slow fault needs a duration arg like 200ms or 1.5s, "
-                    f"got {arg!r} in {part!r}"
-                )
-            dur = float(dm.group("num"))
-            if dm.group("unit") == "ms":
-                dur /= 1000.0
-            out.append(FaultSpec(kind=kind, site=site, duration_s=dur))
+            out.append(
+                FaultSpec(kind=kind, site=site,
+                          duration_s=_parse_duration(arg, part))
+            )
+        elif kind == "skew":
+            # ``N:duration`` (fail-slow from hit N on) or a bare duration
+            # (every hit).  The spec regex lets ':' through in arg.
+            n = 1
+            dur_text = arg
+            if ":" in arg:
+                n_text, dur_text = arg.split(":", 1)
+                if not n_text.isdigit() or int(n_text) < 1:
+                    raise FaultSpecError(
+                        f"skew fault needs N:duration with a 1-based start "
+                        f"hit, got {arg!r} in {part!r}"
+                    )
+                n = int(n_text)
+            out.append(
+                FaultSpec(kind=kind, site=site, n=n,
+                          duration_s=_parse_duration(dur_text, part))
+            )
         else:
             if not arg.isdigit() or int(arg) < 1:
                 raise FaultSpecError(
@@ -127,8 +176,12 @@ class FaultInjector:
 
     ``hook(site)`` counts hits per site (1-based) and applies matching
     specs; pass ``count=`` to match against an externally-maintained
-    counter instead (the trainer passes its iteration).  Returns ``"drop"``
-    when the caller should discard the in-flight message, else ``None``.
+    counter instead (the trainer passes its iteration).  In-process kinds
+    (crash/hang/slow/skew) act right here; value-shaped kinds return the
+    action for the caller to apply: ``"drop"`` (discard the in-flight
+    message), ``"nan"``/``"spike"`` (poison the step's batch — see
+    :func:`poison_batch`), ``"flip"`` (corrupt the params — see
+    :func:`corrupt_params`); else ``None``.
     """
 
     def __init__(
@@ -159,7 +212,13 @@ class FaultInjector:
                 if s.site == site
                 and (
                     s.kind == "slow"
-                    or (not s.fired and s.n is not None and count >= s.n)
+                    or (s.kind == "skew" and count >= s.n)
+                    or (
+                        s.kind in ONE_SHOT_KINDS
+                        and not s.fired
+                        and s.n is not None
+                        and count >= s.n
+                    )
                 )
             ]
             for s in todo:
@@ -168,12 +227,12 @@ class FaultInjector:
             freeze_cbs = list(self._freeze_cbs)
         action = None
         for s in todo:
-            if s.kind == "slow":
+            if s.kind in ("slow", "skew"):
                 self._sleep(s.duration_s)
             elif s.kind == "crash":
                 raise InjectedFault(f"injected fault: {s.text}")
-            elif s.kind == "drop":
-                action = "drop"
+            elif s.kind in VALUE_KINDS:
+                action = s.kind
             elif s.kind == "hang":
                 self._hang(s, freeze_cbs)
         return action
@@ -196,6 +255,97 @@ class FaultInjector:
         sys.stderr.flush()
         while True:  # pragma: no cover - exercised only multiprocess
             self._sleep(3600)
+
+
+# ----------------------------------------------------- fail-silent injection
+# Trainer-loop appliers for the value-shaped kinds.  They live here (not in
+# the trainer) so the corruption SEMANTICS stay next to the grammar, and the
+# guard's tests can drive them without a Trainer.
+
+
+def poison_batch(injector: "FaultInjector", batch, iteration: int):
+    """Apply ``nan@grad`` / ``spike@loss`` to this iteration's batch.
+
+    * ``nan`` — every float leaf becomes NaN: the step's loss and gradients
+      are poisoned exactly as silent input corruption (a bad DMA, a rotted
+      shard) poisons them.  NaN propagates through the in-graph ``psum``,
+      so every rank reaches the same skip verdict with no extra collective.
+    * ``spike`` — float leaves scale by :data:`SPIKE_SCALE`: loss and
+      gradient norm blow up (finite), the grad-norm spike detector's case.
+
+    Counted by trainer iteration, so ``nan@grad:5`` poisons iteration 5
+    regardless of how many hook sites fired before it.
+
+    Only floating leaves can carry the corruption (labels/token ids have
+    no NaN); a batch with NO float leaf would make the fault a silent
+    no-op — the exact failure this module's loud-parse contract exists to
+    prevent — so that raises instead."""
+    import jax
+    import numpy as np
+
+    def _corrupt(fn, kind):
+        hit = [0]
+
+        def one(x):
+            if hasattr(x, "dtype") and np.issubdtype(x.dtype, np.floating):
+                hit[0] += 1
+                return fn(x)
+            return x
+
+        out = jax.tree_util.tree_map(one, batch)
+        if not hit[0]:
+            raise InjectedFault(
+                f"injected fault {kind} at iteration {iteration} found no "
+                f"floating-point batch leaves to corrupt — an all-integer "
+                f"batch cannot carry this fault, and injecting nothing "
+                f"would silently invalidate the test built on it"
+            )
+        return out
+
+    if injector.hook("grad", count=iteration) == "nan":
+        batch = _corrupt(lambda a: np.full_like(a, np.nan), "nan@grad")
+    if injector.hook("loss", count=iteration) == "spike":
+        batch = _corrupt(
+            lambda a: a * a.dtype.type(SPIKE_SCALE), "spike@loss"
+        )
+    return batch
+
+
+def corrupt_params(injector: "FaultInjector", state, iteration: int):
+    """Apply ``flip@param``: after iteration N's update, corrupt one element
+    of the first parameter leaf ON THIS PROCESS ONLY.
+
+    The rebuilt leaf keeps its global sharding
+    (``jax.make_array_from_callback`` — a purely local construction, no
+    collective), so under multi-process SPMD this process's replica silently
+    disagrees with its peers from here on: the exact fail-silent divergence
+    the consistency vote exists to localize."""
+    if injector.hook("param", count=iteration) != "flip":
+        return state
+    import sys
+
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    target = leaves[0]
+    arr = np.array(np.asarray(target))
+    flat = arr.reshape(-1)
+    # Sign flip plus a shift: changes the value even at exact zero.
+    flat[0] = -flat[0] - np.asarray(1.0, arr.dtype)
+    sharding = getattr(target, "sharding", None)
+    if sharding is not None:
+        corrupted = jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+    else:
+        corrupted = jax.numpy.asarray(arr)
+    sys.stderr.write(
+        f"[chainermn_tpu.resilience] injected fault: flip@param at "
+        f"iteration {iteration} — local replica diverged\n"
+    )
+    leaves = [corrupted] + list(leaves[1:])
+    return state.replace(params=jax.tree_util.tree_unflatten(treedef, leaves))
 
 
 #: Process-wide injector cache (see :func:`process_injector`).

@@ -1,0 +1,442 @@
+"""Training-health guard — detect *fail-silent* and *fail-slow* faults.
+
+PR 1's resilience layer handles fail-STOP faults (crash, hang, preemption):
+it knows whether the job is *alive*.  Nothing verified that the job is
+*healthy* — the dominant silent failure modes of long TPU-fleet runs pass
+straight through it:
+
+* a NaN/Inf gradient blowup poisons the params and every step after;
+* silent data/HBM corruption on one host walks a single replica away from
+  the others while the gradient mean hides it;
+* one straggling host stretches every collective and the job "runs" at a
+  fraction of its speed.
+
+:class:`TrainingHealthGuard` closes the gap with three mechanisms, each
+owned by the layer that can decide it cheapest:
+
+1. **Step anomaly detection** (in-graph, ``optimizers.make_train_step
+   (health_check=True)``): the verdict over the *reduced* gradients and
+   pmean'd loss — values every device already holds identically, so all
+   ranks agree with zero extra collectives — turns a poisoned step into a
+   no-op (the update is skipped, nothing else changes).  The guard counts
+   skips host-side and escalates past a bounded budget.
+2. **Cross-rank consistency voting** (:mod:`.consistency`): rolling
+   parameter digests cross the existing host object plane at a
+   configurable cadence; a majority vote localizes the divergent rank
+   (attributed :class:`~chainermn_tpu.resilience.RankDivergedError`).
+3. **Rollback recovery**: the checkpointer keeps a ring of last-K
+   *known-good* snapshots — a snapshot is only marked good after a clean
+   consistency vote — and escalation (skip budget blown, divergence, no
+   majority) triggers a rank-synchronized rollback-and-resume from the
+   newest known-good snapshot, *in-process*: no relaunch, no lost attempt.
+   Only when rollback is impossible (no known-good snapshot) or its own
+   budget is exhausted does the guard exit with
+   :data:`HEALTH_EXIT_CODE` = 76, which ``launch.supervise()`` accounts
+   against a separate ``--health-restarts`` allowance (a sick job is not a
+   crashing one).
+
+Plus **straggler surfacing**: per-rank step-time stats ride the failure
+detector's existing heartbeat gossip (zero extra connections); ranks whose
+mean step time exceeds ``straggler_factor`` × the fleet median are flagged
+in health lines and :meth:`guard_report`.
+
+Every verdict the guard acts on is identical on every rank by construction
+(in-graph psum'd verdicts; allgather'd digests), so escalation and rollback
+are rank-synchronized without any extra agreement protocol.
+
+All of it is deterministically testable: ``CMN_FAULT``'s fail-silent kinds
+(``nan@grad:5``, ``spike@loss:5``, ``flip@param:7``, ``skew@step:3:150ms``)
+inject at the trainer's hook points — see ``docs/resilience.md``.
+"""
+
+from __future__ import annotations
+
+import sys
+from collections import deque
+from typing import Dict, List, Optional
+
+from chainermn_tpu.resilience import consistency as _consistency
+from chainermn_tpu.resilience.consistency import RankDivergedError
+
+#: BSD ``EX_PROTOCOL``: the run violated the training-health protocol and
+#: could not self-heal by rollback.  Distinct from 75 (preemption: healthy,
+#: always relaunch) and from crash codes — ``launch.supervise()`` gives it
+#: its own ``--health-restarts`` allowance.
+HEALTH_EXIT_CODE = 76
+
+
+class HealthEscalationInterrupt(SystemExit):
+    """Raised when the guard cannot recover in-process (no known-good
+    snapshot, or the rollback budget is spent).  A ``SystemExit`` with
+    :data:`HEALTH_EXIT_CODE`, like the preemption interrupt: it bypasses
+    the crash hook and surfaces to ``launch.supervise()`` as a
+    *health* exit, not a failure."""
+
+    def __init__(self, reason: str, iteration: int):
+        super().__init__(HEALTH_EXIT_CODE)
+        self.reason = reason
+        self.iteration = int(iteration)
+
+
+class TrainingHealthGuard:
+    """Per-step training-health monitor, wired through the Trainer.
+
+    Args:
+      comm: object-plane communicator for the digest vote
+        (:class:`~chainermn_tpu.comm.base.CommunicatorBase` or a bare
+        :class:`~chainermn_tpu.hostcomm.HostComm`); ``None`` disables
+        voting (single process).
+      checkpointer: the :class:`MultiNodeCheckpointer` holding the
+        known-good ring; if ``None``, the trainer's extensions are searched
+        at escalation time.
+      detector: optional :class:`~chainermn_tpu.resilience.FailureDetector`
+        — step-time stats piggyback on its heartbeat gossip and peers'
+        stats feed the straggler check.
+      skip_budget: consecutive skipped (anomalous) steps tolerated before
+        escalating.  Identical on every rank (the skip verdict is).
+      check_every: read the in-graph verdict every N iterations (1 = every
+        step; reading syncs the device stream on that cadence).
+      vote_every: consistency-vote cadence in iterations (0 = off).  Must
+        be identical on every rank — the vote is a collective.
+      rollback_budget: in-process rollbacks allowed before the guard gives
+        up and exits :data:`HEALTH_EXIT_CODE`.
+      straggler_factor: flag ranks whose mean step time exceeds this
+        multiple of the fleet median.
+      stats_every: straggler-check cadence in iterations (independent of
+        voting — any guard with a detector surfaces stragglers).
+      spike_factor / spike_warmup / spike_ema_beta: grad-norm spike knobs,
+        forwarded to the in-graph check (see ``make_train_step``).
+      health_check: set False to run votes/stats only (no in-graph step
+        gating — e.g. an optimizer tier that doesn't support it yet).
+    """
+
+    def __init__(
+        self,
+        comm=None,
+        checkpointer=None,
+        detector=None,
+        skip_budget: int = 3,
+        check_every: int = 1,
+        vote_every: int = 0,
+        rollback_budget: int = 2,
+        straggler_factor: float = 3.0,
+        stats_every: int = 20,
+        spike_factor: float = 10.0,
+        spike_warmup: int = 20,
+        spike_ema_beta: float = 0.1,
+        stats_window: int = 100,
+        health_check: bool = True,
+        history_limit: int = 200,
+    ):
+        if check_every < 1:
+            raise ValueError(f"check_every must be >= 1, got {check_every}")
+        if vote_every < 0:
+            raise ValueError(f"vote_every must be >= 0, got {vote_every}")
+        if stats_every < 1:
+            raise ValueError(f"stats_every must be >= 1, got {stats_every}")
+        self.comm = comm
+        self.checkpointer = checkpointer
+        self.detector = detector
+        self.skip_budget = int(skip_budget)
+        self.check_every = int(check_every)
+        self.vote_every = int(vote_every)
+        self.rollback_budget = int(rollback_budget)
+        self.straggler_factor = float(straggler_factor)
+        self.stats_every = int(stats_every)
+        self.spike_factor = float(spike_factor)
+        self.spike_warmup = int(spike_warmup)
+        self.spike_ema_beta = float(spike_ema_beta)
+        self.health_check = bool(health_check)
+        self._history_limit = int(history_limit)
+        # Host-side bookkeeping (identical across ranks except step times).
+        self._consecutive_skips = 0
+        self._total_skips = 0
+        self._skip_steps: List[int] = []
+        self._votes: List[dict] = []
+        self._rollbacks: List[dict] = []
+        self._stragglers: Dict[int, dict] = {}
+        self._step_times = deque(maxlen=int(stats_window))
+        self._steps_timed = 0
+        self.last_divergence: Optional[RankDivergedError] = None
+
+    # ------------------------------------------------------------------ wire
+    @property
+    def rank(self) -> int:
+        return getattr(self.comm, "rank", 0) if self.comm is not None else 0
+
+    def step_kwargs(self) -> dict:
+        """make_train_step/update kwargs the Trainer merges in at bind."""
+        if not self.health_check:
+            return {}
+        return {
+            "health_check": True,
+            "spike_factor": self.spike_factor,
+            "spike_warmup": self.spike_warmup,
+            "spike_ema_beta": self.spike_ema_beta,
+        }
+
+    def bind(self, trainer) -> "TrainingHealthGuard":
+        """Wire into a Trainer (called by ``Trainer(health_guard=...)``):
+        merge the in-graph check's step kwargs and seed the health carry."""
+        if self.health_check:
+            from chainermn_tpu.optimizers import MultiNodeOptimizer
+
+            if not isinstance(trainer.optimizer, MultiNodeOptimizer):
+                raise TypeError(
+                    "health_check=True requires the replicated-state "
+                    f"MultiNodeOptimizer tier, got "
+                    f"{type(trainer.optimizer).__name__}; construct the "
+                    "guard with health_check=False to keep voting/stats"
+                )
+            trainer.step_kwargs.update(self.step_kwargs())
+            if getattr(trainer.state, "health", None) is None:
+                import jax.numpy as jnp
+
+                h = jnp.zeros(3, jnp.float32)
+                comm = trainer.optimizer.comm
+                if hasattr(comm, "replicate"):
+                    h = comm.replicate(h)
+                trainer.state = trainer.state.replace(health=h)
+        return self
+
+    # ------------------------------------------------------------- per step
+    def post_step(self, trainer, metrics: dict, step_time_s: float) -> None:
+        """Called by the trainer after every iteration (extensions and the
+        periodic checkpoint have already fired, so a snapshot taken this
+        iteration exists before the vote that could bless it)."""
+        it = int(trainer.iteration)
+        self._note_step_time(it, step_time_s)
+        if self.health_check and it % self.check_every == 0 \
+                and "step_ok" in metrics:
+            self._check_verdict(trainer, metrics, it)
+        if self.vote_every and it % self.vote_every == 0:
+            self._vote(trainer, it)
+        # Straggler surfacing is independent of voting: it needs only the
+        # heartbeat-gossiped stats, so it runs on its own cadence whenever
+        # a detector is wired (a guard without votes still flags slow
+        # ranks).
+        if self.detector is not None and it % self.stats_every == 0:
+            self._check_stragglers(it)
+
+    # ------------------------------------------------- step anomaly verdict
+    def _check_verdict(self, trainer, metrics: dict, it: int) -> None:
+        ok = float(metrics["step_ok"]) >= 0.5
+        if ok:
+            self._consecutive_skips = 0
+            return
+        self._consecutive_skips += 1
+        self._total_skips += 1
+        self._skip_steps.append(it)
+        # The step LIST is bounded (history); the total is a counter and
+        # never trimmed.
+        del self._skip_steps[: -self._history_limit]
+        gnorm = float(metrics.get("grad_norm", float("nan")))
+        self._health_line(
+            f"step {it} SKIPPED (anomalous loss/grads, grad_norm={gnorm:.3g},"
+            f" consecutive={self._consecutive_skips}/{self.skip_budget})"
+        )
+        if self._consecutive_skips > self.skip_budget:
+            self._escalate(
+                trainer,
+                f"skip budget exhausted: {self._consecutive_skips} "
+                f"consecutive anomalous steps (> {self.skip_budget}) at "
+                f"iteration {it}",
+            )
+
+    # -------------------------------------------------------------- voting
+    def _vote(self, trainer, it: int) -> None:
+        vote = _consistency.exchange_and_vote(
+            self.comm, trainer.state.params, it
+        )
+        entry = {
+            "step": it,
+            "clean": vote.clean,
+            "divergent": list(vote.divergent),
+            "no_majority": vote.no_majority,
+        }
+        self._votes.append(entry)
+        del self._votes[: -self._history_limit]
+        if vote.clean:
+            ckpt = self._find_checkpointer(trainer)
+            if ckpt is not None and hasattr(ckpt, "mark_known_good_upto"):
+                ckpt.mark_known_good_upto(it)
+            return
+        err = RankDivergedError(
+            vote.divergent, it, rank=self.rank, no_majority=vote.no_majority
+        )
+        self.last_divergence = err
+        self._health_line(f"{vote.describe()} — {err}")
+        self._escalate(trainer, str(err))
+
+    # ---------------------------------------------------------- escalation
+    def _escalate(self, trainer, reason: str) -> None:
+        """Rank-synchronized (every rank reaches the same decision from the
+        same replicated verdicts): roll back if a known-good snapshot and
+        budget remain, else exit :data:`HEALTH_EXIT_CODE`."""
+        ckpt = self._find_checkpointer(trainer)
+        good = (
+            ckpt.latest_known_good()
+            if ckpt is not None and hasattr(ckpt, "latest_known_good")
+            else None
+        )
+        if good is not None and len(self._rollbacks) < self.rollback_budget:
+            self._rollback(trainer, ckpt, int(good), reason)
+            return
+        why = (
+            "no known-good snapshot to roll back to"
+            if good is None
+            else f"rollback budget ({self.rollback_budget}) exhausted"
+        )
+        self._health_line(
+            f"ESCALATING at iteration {trainer.iteration}: {reason}; {why}; "
+            f"exiting {HEALTH_EXIT_CODE}"
+        )
+        if ckpt is not None and good is not None and \
+                hasattr(ckpt, "discard_after"):
+            # Leave the on-disk trail sane for the supervised relaunch:
+            # snapshots newer than the last known-good one are suspect
+            # (saved between the blessing vote and the escalation).
+            try:
+                ckpt.discard_after(int(good))
+            except Exception:
+                pass
+        raise HealthEscalationInterrupt(reason, trainer.iteration)
+
+    def _rollback(self, trainer, ckpt, good: int, reason: str) -> None:
+        n = len(self._rollbacks) + 1
+        at_it = int(trainer.iteration)
+        self._health_line(
+            f"rollback #{n}/{self.rollback_budget} to known-good step "
+            f"{good} (from iteration {at_it}): {reason}"
+        )
+        # Discard snapshots newer than the rollback target FIRST: they were
+        # taken on (potentially) poisoned state, and the re-run of the
+        # rolled-back iterations re-saves those steps cleanly.
+        ckpt.discard_after(good)
+        ckpt.restore_step(good, trainer.state, trainer)
+        # Metrics observed on the rolled-back timeline must not leak into
+        # the next LogReport window.
+        trainer.drain_observations()
+        self._consecutive_skips = 0
+        self._rollbacks.append(
+            {"step": int(good), "at_iteration": at_it, "reason": reason}
+        )
+        self._health_line(
+            f"resumed at iteration {trainer.iteration} from known-good "
+            f"step {good}"
+        )
+
+    # ---------------------------------------------------------- stragglers
+    def _note_step_time(self, it: int, dt_s: float) -> None:
+        self._step_times.append(float(dt_s))
+        self._steps_timed += 1
+        if self.detector is not None and \
+                hasattr(self.detector, "set_local_stats"):
+            self.detector.set_local_stats(self.step_time_stats(it))
+
+    def step_time_stats(self, it: Optional[int] = None) -> dict:
+        w = list(self._step_times)
+        ms = 1000.0
+        return {
+            "iteration": int(it if it is not None else self._steps_timed),
+            "n": self._steps_timed,
+            "last_ms": round(w[-1] * ms, 3) if w else None,
+            "mean_ms": round(sum(w) / len(w) * ms, 3) if w else None,
+            "max_ms": round(max(w) * ms, 3) if w else None,
+        }
+
+    def _check_stragglers(self, it: int) -> None:
+        if self.detector is None or \
+                not hasattr(self.detector, "peer_stats"):
+            return
+        stats = self.detector.peer_stats()
+        means = {
+            int(r): s.get("mean_ms")
+            for r, s in stats.items()
+            if s.get("mean_ms") is not None
+        }
+        if len(means) < 2:
+            return
+        ordered = sorted(means.values())
+        median = ordered[len(ordered) // 2]
+        if median <= 0:
+            return
+        for r, m in sorted(means.items()):
+            if m > self.straggler_factor * median:
+                self._stragglers[r] = {
+                    "step": it, "mean_ms": m, "median_ms": median,
+                }
+                self._health_line(
+                    f"straggler: rank {r} mean step {m:.1f}ms vs fleet "
+                    f"median {median:.1f}ms "
+                    f"(> {self.straggler_factor:g}x)"
+                )
+
+    # ------------------------------------------------------------- reporting
+    def guard_report(self) -> dict:
+        """Everything the guard knows, one JSON-serializable dict: per-rank
+        skip counts, vote history, rollbacks, step-time stats, straggler
+        verdicts."""
+        return {
+            "rank": self.rank,
+            "skips": {
+                "total": self._total_skips,
+                "consecutive": self._consecutive_skips,
+                "budget": self.skip_budget,
+                "steps": list(self._skip_steps),
+            },
+            "votes": list(self._votes),
+            "rollbacks": {
+                "count": len(self._rollbacks),
+                "budget": self.rollback_budget,
+                "events": list(self._rollbacks),
+            },
+            "step_time": self.step_time_stats(),
+            "peer_step_time": (
+                self.detector.peer_stats()
+                if self.detector is not None
+                and hasattr(self.detector, "peer_stats")
+                else {}
+            ),
+            "stragglers": dict(self._stragglers),
+            "last_divergence": (
+                {
+                    "divergent": self.last_divergence.divergent,
+                    "step": self.last_divergence.step,
+                    "no_majority": self.last_divergence.no_majority,
+                }
+                if self.last_divergence is not None
+                else None
+            ),
+        }
+
+    def finalize(self, trainer) -> None:
+        """End-of-run health line (every rank — the supervisor log is the
+        one place all ranks' health folds together)."""
+        r = self.guard_report()
+        st = r["step_time"]
+        self._health_line(
+            f"report: skips={r['skips']['total']} "
+            f"votes={len(r['votes'])} "
+            f"rollbacks={r['rollbacks']['count']} "
+            f"mean_step_ms={st['mean_ms']} "
+            f"stragglers={sorted(r['stragglers'])}"
+        )
+
+    def _health_line(self, msg: str) -> None:
+        sys.stderr.write(
+            f"[chainermn_tpu.guard] rank {self.rank}: {msg}\n"
+        )
+        sys.stderr.flush()
+
+    @staticmethod
+    def _find_checkpointer_static(trainer):
+        from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
+
+        for ext in getattr(trainer, "extensions", []):
+            if isinstance(ext, MultiNodeCheckpointer):
+                return ext
+        return None
+
+    def _find_checkpointer(self, trainer):
+        return self.checkpointer or self._find_checkpointer_static(trainer)

@@ -237,10 +237,20 @@ class Trainer:
         :class:`~chainermn_tpu.resilience.PreemptionGuard`, polled once per
         iteration — converts SIGTERM into a rank-synchronized emergency
         checkpoint + distinguished exit (see ``docs/resilience.md``).
+      health_guard: optional
+        :class:`~chainermn_tpu.resilience.TrainingHealthGuard` — adds
+        in-graph step anomaly detection (the guard's kwargs merge into
+        ``step_kwargs`` and its health carry is seeded on the state),
+        cadenced cross-rank consistency votes, rollback recovery, and
+        step-time/straggler stats (see ``docs/resilience.md``).
 
     The loop is also a ``CMN_FAULT`` hook point: ``crash@iter:N`` raises an
     :class:`~chainermn_tpu.resilience.InjectedFault` at iteration N through
-    the exact path a user exception would take.
+    the exact path a user exception would take, and the fail-silent kinds
+    corrupt this loop's values at the same per-iteration hook points —
+    ``nan@grad:N``/``spike@loss:N`` poison the incoming batch,
+    ``flip@param:N`` corrupts the local replica after the update,
+    ``skew@step:N:ms`` stretches every step from N on (fail-slow).
     """
 
     def __init__(self, optimizer, state, loss_fn, train_iter,
@@ -248,7 +258,7 @@ class Trainer:
                  extensions: Optional[List[Extension]] = None,
                  has_aux: bool = False, stateful: bool = False,
                  step_kwargs: Optional[dict] = None,
-                 preemption_guard=None):
+                 preemption_guard=None, health_guard=None):
         self.optimizer = optimizer
         self.state = state
         self.loss_fn = loss_fn
@@ -268,6 +278,11 @@ class Trainer:
         self._fault_injector = _faults.process_injector()
         self.iteration = 0
         self._observations: List[dict] = []
+        # Bind LAST: the guard merges its in-graph kwargs into step_kwargs
+        # and seeds state.health on the state set above.
+        self.health_guard = health_guard
+        if health_guard is not None:
+            health_guard.bind(self)
 
     @property
     def epoch(self) -> int:
@@ -285,25 +300,50 @@ class Trainer:
         return tick >= self.stop_n
 
     def run(self):
+        inj = self._fault_injector
         while not self._done():
+            t0 = time.perf_counter()
             batch = next(self.train_iter)
+            if inj is not None:
+                # Fail-silent injection, pre-step: nan@grad / spike@loss
+                # poison THIS iteration's batch (counted 1-based like the
+                # iter site).
+                batch = _faults.poison_batch(inj, batch, self.iteration + 1)
             self.state, metrics = self.optimizer.update(
                 self.state, batch, self.loss_fn, has_aux=self.has_aux,
                 stateful=self.stateful, **self.step_kwargs,
             )
             self.iteration += 1
+            if inj is not None:
+                # Fail-silent injection, post-step: flip@param corrupts the
+                # local replica (checkpoints taken this iteration snapshot
+                # the corruption, exactly like real silent divergence);
+                # skew@step stretches the step (fail-slow straggler).
+                self.state = _faults.corrupt_params(
+                    inj, self.state, self.iteration
+                )
+                inj.hook("step", count=self.iteration)
             # Keep raw device arrays — no host sync on the hot path.
             self._observations.append(dict(metrics))
             for ext in self.extensions:
                 if ext.should_fire(self):
                     ext(self)
-            if self._fault_injector is not None:
-                self._fault_injector.hook("iter", count=self.iteration)
-            # Guard poll LAST, after the interval extensions: a periodic
-            # checkpoint that fired this very iteration makes the guard's
-            # emergency save an idempotent no-op.
+            if inj is not None:
+                inj.hook("iter", count=self.iteration)
+            # Health guard AFTER the interval extensions: a checkpoint
+            # saved this very iteration exists before the vote that may
+            # bless it as known-good (or roll back over it).
+            if self.health_guard is not None:
+                self.health_guard.post_step(
+                    self, metrics, time.perf_counter() - t0
+                )
+            # Preemption poll LAST: a periodic checkpoint that fired this
+            # very iteration makes the guard's emergency save an
+            # idempotent no-op.
             if self.preemption_guard is not None:
                 self.preemption_guard.poll(self)
         for ext in self.extensions:
             ext.finalize(self)
+        if self.health_guard is not None:
+            self.health_guard.finalize(self)
         return self.state

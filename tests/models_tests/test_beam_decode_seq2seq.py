@@ -15,6 +15,8 @@ from chainermn_tpu.models import (
     greedy_decode,
 )
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _models():
     yield Seq2Seq(vocab_src=20, vocab_tgt=20, embed=16, hidden=32)

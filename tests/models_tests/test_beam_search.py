@@ -11,6 +11,8 @@ import jax.numpy as jnp
 
 from chainermn_tpu.models import TransformerLM, lm_beam_search, lm_generate
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _model(**kw):
     cfg = dict(vocab=12, n_layers=2, d_model=32, n_heads=2, d_ff=64,

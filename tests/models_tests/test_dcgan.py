@@ -22,6 +22,8 @@ from chainermn_tpu.models import (
 )
 from chainermn_tpu.models.dcgan import _bce_logits
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 NZ = 16
 IMG = (32, 32, 1)

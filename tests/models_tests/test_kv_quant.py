@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from chainermn_tpu.models import TransformerLM, lm_generate
 from chainermn_tpu.models.decoding import lm_beam_search
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _model(T=32, quant=True, **kw):
     kw.setdefault("vocab", 40)

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 
 from chainermn_tpu.models import TransformerLM, lm_generate
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _model(T=32):
     return TransformerLM(vocab=40, n_layers=2, d_model=32, n_heads=2,

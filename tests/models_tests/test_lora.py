@@ -29,6 +29,8 @@ from chainermn_tpu.models import (
 )
 from chainermn_tpu.models.lora import DEFAULT_TARGETS
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _model(**kw):
     kw.setdefault("vocab", 50)

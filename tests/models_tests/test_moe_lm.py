@@ -21,6 +21,8 @@ from chainermn_tpu.models import (
     lm_loss_chunked,
 )
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _toks(B=2, T=32, vocab=64, seed=0):
     rng = np.random.RandomState(seed)

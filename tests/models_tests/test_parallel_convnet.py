@@ -20,6 +20,8 @@ from chainermn_tpu.models import (
     make_channel_parallel_train_step,
 )
 
+pytestmark = pytest.mark.tier1  # fast tier: stays in --quick / tier-1 (see tests/test_repo_health.py)
+
 
 WIDTHS = (16, 32)
 NUM_CLASSES = 10

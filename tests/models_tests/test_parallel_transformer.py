@@ -18,6 +18,8 @@ from chainermn_tpu.models.transformer import (
     parallel_lm_specs,
 )
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 CFG = ParallelLMConfig(
     vocab=64, n_stages=2, d_model=16, n_heads=4, d_ff=32, max_len=32,

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import chainermn_tpu as cmn
 from chainermn_tpu.models import ResNetTiny, resnet_loss
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 @pytest.mark.slow
 def test_resnet_forward_shapes(devices):

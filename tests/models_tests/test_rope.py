@@ -12,6 +12,8 @@ import jax.numpy as jnp
 from chainermn_tpu.models import TransformerLM, lm_loss
 from chainermn_tpu.ops.rope import apply_rope
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def test_rope_relative_property_and_norm():
     rng = np.random.RandomState(0)

@@ -21,6 +21,8 @@ from chainermn_tpu.models.resnet import (
     space_to_depth,
 )
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def test_s2d_stem_exact_equivalence():
     """conv7(stride 2, SAME) == conv4(stride 1, pad (1,2)) ∘ s2d(2) with

@@ -15,6 +15,8 @@ from chainermn_tpu.datasets.seq import (
 )
 from chainermn_tpu.models import Seq2Seq, seq2seq_loss
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def test_bucketing_static_shapes_and_padding_bound():
     pairs = make_synthetic_translation(512, vocab=30, min_len=3, max_len=24)

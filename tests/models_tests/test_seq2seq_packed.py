@@ -18,6 +18,8 @@ from chainermn_tpu.datasets import pack_pairs, packing_efficiency
 from chainermn_tpu.models import TransformerSeq2Seq, seq2seq_loss
 from chainermn_tpu.models.seq2seq import BOS, PAD
 
+pytestmark = pytest.mark.tier1  # fast tier: stays in --quick / tier-1 (see tests/test_repo_health.py)
+
 
 def _model():
     return TransformerSeq2Seq(

@@ -14,6 +14,8 @@ from chainermn_tpu.models import (
     lm_speculative_generate,
 )
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _model(seed=0, layers=2):
     return TransformerLM(vocab=40, n_layers=layers, d_model=32, n_heads=2,

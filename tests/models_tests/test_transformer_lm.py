@@ -10,6 +10,8 @@ import jax.numpy as jnp
 
 from chainermn_tpu.models import TransformerLM, lm_loss
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _toks(b=2, t=64, vocab=512, seed=0):
     rng = np.random.RandomState(seed)

@@ -16,6 +16,8 @@ from chainermn_tpu.models import (
     seq2seq_loss,
 )
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _model(attention="flash"):
     return TransformerSeq2Seq(vocab_src=30, vocab_tgt=30, d_model=32,

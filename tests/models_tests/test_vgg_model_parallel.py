@@ -21,6 +21,8 @@ from chainermn_tpu.models.vgg import (
     vgg_stage_modules,
 )
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 @pytest.fixture()
 def comm(devices):

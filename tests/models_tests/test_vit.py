@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import chainermn_tpu as cmn
 from chainermn_tpu.models import ViT, vit_loss
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _tiny(**kw):
     cfg = dict(num_classes=10, patch=8, d_model=64, n_heads=4, d_ff=128,

@@ -1,0 +1,143 @@
+"""Training-health-guard acceptance (real OS processes, deterministic CPU).
+
+The two contract scenarios from the guard's design:
+
+1. **nan@grad:5** — the poisoned step's update is skipped on every rank
+   (the verdict is psum'd, so no rank applies it) and the run thereafter
+   is BIT-IDENTICAL to an unfaulted oracle that merely consumed that batch
+   without updating: the injected NaN has zero side effects beyond the
+   skip — no contamination of optimizer state, EMA, iterator, or RNG.
+
+2. **flip@param:7 on rank 1 of 3** — the consistency vote localizes the
+   divergent rank by majority, every rank rolls back to the last
+   known-good snapshot IN-PROCESS (no relaunch), and the run resumes
+   bit-exact: the final parameters match an unfaulted oracle's exactly.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_HERE, "worker_health_guard.py")
+
+pytestmark = pytest.mark.resilience
+
+
+def _verdicts(tmp_path, log, nproc):
+    out = []
+    for pid in range(nproc):
+        p = tmp_path / f"verdict_{pid}.json"
+        assert p.exists(), f"rank {pid} wrote no verdict:\n{log[-3000:]}"
+        v = json.loads(p.read_text())
+        assert v.get("status") == "ok", v.get("traceback", v)
+        out.append(v)
+    return out
+
+
+def test_nan_step_skipped_and_bit_identical_to_oracle(launch_job, tmp_path):
+    # ---- faulted run: rank 1's batch goes NaN at iteration 5 ------------
+    fault_dir = tmp_path / "fault"
+    fault_dir.mkdir()
+    job = launch_job(
+        WORKER, nproc=2, timeout=300,
+        extra_env={
+            "CMN_FAULT": "nan@grad:5", "CMN_FAULT_RANK": "1",
+            "CMN_TEST_TMP": str(fault_dir),
+        },
+    )
+    assert job.returncode == 0, job.log[-3000:]
+    faulted = _verdicts(fault_dir, job.log, 2)
+
+    # ---- oracle run: no fault; batch 5 consumed without an update -------
+    oracle_dir = tmp_path / "oracle"
+    oracle_dir.mkdir()
+    job2 = launch_job(
+        WORKER, nproc=2, timeout=300,
+        extra_env={
+            "CMN_GUARD_DROP_BATCH": "5", "CMN_GUARD_STOP": "11",
+            "CMN_TEST_TMP": str(oracle_dir),
+        },
+    )
+    assert job2.returncode == 0, job2.log[-3000:]
+    oracle = _verdicts(oracle_dir, job2.log, 2)
+
+    for f, o in zip(faulted, oracle):
+        # The poisoned step was detected and skipped — on every rank.
+        assert f["report"]["skips"]["steps"] == [5], f["report"]["skips"]
+        assert f["step_ok"]["5"] == 0.0
+        assert math.isnan(f["losses"]["5"])
+        # Before the fault: trajectories identical.
+        for k in range(1, 5):
+            assert f["losses"][str(k)] == o["losses"][str(k)], k
+        # After the skip: the faulted run IS the oracle, one batch behind —
+        # bit-exact loss equality, not approximate.
+        for k in range(6, 13):
+            assert f["losses"][str(k)] == o["losses"][str(k - 1)], k
+        assert f["final_digest"] == o["final_digest"]
+        # No divergence, no rollback: the skip was the whole story.
+        assert f["report"]["rollbacks"]["count"] == 0
+        assert all(v["clean"] for v in f["report"]["votes"])
+    # The skip verdict and health line surfaced in the job log.
+    assert "SKIPPED" in job.log, job.log[-3000:]
+    # Both ranks agree bit-exactly with each other too.
+    assert faulted[0]["final_digest"] == faulted[1]["final_digest"]
+
+
+def test_flip_param_vote_localizes_rollback_resumes_bit_exact(
+    launch_job, tmp_path
+):
+    # ---- oracle: unfaulted 3-rank run -----------------------------------
+    plain_dir = tmp_path / "plain"
+    plain_dir.mkdir()
+    job0 = launch_job(
+        WORKER, nproc=3, timeout=360,
+        extra_env={"CMN_TEST_TMP": str(plain_dir)},
+    )
+    assert job0.returncode == 0, job0.log[-3000:]
+    plain = _verdicts(plain_dir, job0.log, 3)
+
+    # ---- faulted: rank 1's replica silently corrupted after iter 7 ------
+    flip_dir = tmp_path / "flip"
+    flip_dir.mkdir()
+    job = launch_job(
+        WORKER, nproc=3, timeout=360,
+        extra_env={
+            "CMN_FAULT": "flip@param:7", "CMN_FAULT_RANK": "1",
+            "CMN_TEST_TMP": str(flip_dir),
+        },
+    )
+    log = job.log
+    # The whole job self-healed in-process: exit 0, NO relaunch.
+    assert job.returncode == 0, log[-3000:]
+    flipped = _verdicts(flip_dir, log, 3)
+
+    for v in flipped:
+        rep = v["report"]
+        # The vote at iteration 8 named rank 1 — by majority, on every rank.
+        div = [e for e in rep["votes"] if not e["clean"]]
+        assert len(div) == 1 and div[0]["step"] == 8, rep["votes"]
+        assert div[0]["divergent"] == [1] and not div[0]["no_majority"]
+        assert rep["last_divergence"]["divergent"] == [1]
+        # Exactly one rollback, to the last known-good snapshot (step 6 —
+        # blessed by the clean vote at 6; 8 was saved post-corruption).
+        assert rep["rollbacks"]["count"] == 1, rep["rollbacks"]
+        ev = rep["rollbacks"]["events"][0]
+        assert ev["step"] == 6 and ev["at_iteration"] == 8, ev
+        # The re-run continued to the full stop and re-blessed the trail.
+        assert v["final_iteration"] == 12
+        assert 12 in v["known_good"], v["known_good"]
+
+    # Bit-exact resume: the corruption was fully undone — the faulted
+    # run's final params equal the unfaulted oracle's, on every rank.
+    assert {v["final_digest"] for v in flipped} == \
+        {plain[0]["final_digest"]}
+    for v in plain:
+        assert v["report"]["rollbacks"]["count"] == 0
+
+    # Attribution and recovery surfaced in the supervisor-visible log.
+    assert "diverged" in log, log[-3000:]
+    assert "rollback #1" in log, log[-3000:]
+    assert "resumed at iteration 6" in log, log[-3000:]

@@ -1,0 +1,140 @@
+"""Worker for the training-health-guard acceptance tests (real OS ranks).
+
+Trains a small DP MLP under a :class:`TrainingHealthGuard` with cadenced
+consistency votes and a known-good checkpoint ring.  The test drives it
+through env:
+
+* ``CMN_FAULT`` (+ ``CMN_FAULT_RANK``) — fail-silent injection
+  (``nan@grad:5``, ``flip@param:7``) through the trainer's hook points.
+* ``CMN_GUARD_DROP_BATCH=N`` — oracle mode: consume the N-th batch without
+  an update (exactly what a guarded skip leaves behind), so the test can
+  assert the faulted run is bit-identical to an unfaulted oracle.
+* ``CMN_GUARD_STOP`` / ``CMN_GUARD_VOTE_EVERY`` / ``CMN_GUARD_CKPT_EVERY``
+  — loop geometry.
+
+Writes one verdict JSON per rank: per-iteration losses and step verdicts,
+the final parameter digest, and the full ``guard_report()``.
+"""
+
+import json
+import os
+import sys
+import traceback
+
+import numpy as np
+
+
+class _DropNth:
+    """Iterator wrapper that silently consumes the N-th batch: the oracle
+    for a guarded skip (data advanced, no update)."""
+
+    def __init__(self, it, n):
+        self._it = it
+        self._n = int(n)
+        self._calls = 0
+
+    def __next__(self):
+        self._calls += 1
+        batch = next(self._it)
+        if self._calls == self._n:
+            batch = next(self._it)
+        return batch
+
+    def __getattr__(self, name):  # epoch, checkpoint hooks, ...
+        return getattr(self._it, name)
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid}
+
+    import optax
+
+    from chainermn_tpu.datasets import make_synthetic_classification
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.resilience import TrainingHealthGuard, tree_digest
+    from chainermn_tpu.training import Extension, Trainer
+
+    stop = int(os.environ.get("CMN_GUARD_STOP", "12"))
+    vote_every = int(os.environ.get("CMN_GUARD_VOTE_EVERY", "2"))
+    ckpt_every = int(os.environ.get("CMN_GUARD_CKPT_EVERY", "2"))
+    drop = os.environ.get("CMN_GUARD_DROP_BATCH")
+
+    comm = cmn.create_communicator("flat")
+    # 384 divides evenly by 2 AND 3 hosts into batch-32 chunks: every rank
+    # sees full-shape batches at every step (no ragged-tail recompiles).
+    ds = cmn.scatter_dataset(
+        make_synthetic_classification(384, 8, 4, seed=9), comm,
+        shuffle=True, seed=4,
+    )
+    model = MLP(hidden=(8,), n_out=4)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))[
+        "params"
+    ]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    it = SerialIterator(ds, 32, shuffle=True, seed=2)
+    if drop:
+        it = _DropNth(it, int(drop))
+
+    ckpt = create_multi_node_checkpointer(
+        "guard", comm, path=os.environ["CMN_TEST_TMP"],
+        trigger=(ckpt_every, "iteration"), async_save=False,
+        max_to_keep=8,
+    )
+    guard = TrainingHealthGuard(
+        comm=comm, checkpointer=ckpt, vote_every=vote_every,
+        skip_budget=3,
+    )
+
+    losses = {}
+    oks = {}
+
+    def capture(trainer):
+        m = trainer._observations[-1] if trainer._observations else {}
+        losses[trainer.iteration] = float(np.asarray(m.get("loss", np.nan)))
+        if "step_ok" in m:
+            oks[trainer.iteration] = float(np.asarray(m["step_ok"]))
+
+    trainer = Trainer(
+        opt, opt.init(params), classification_loss(model), it,
+        stop=(stop, "iteration"), has_aux=True, health_guard=guard,
+        extensions=[ckpt, Extension(capture, trigger=(1, "iteration"))],
+    )
+    _, resumed = ckpt.maybe_load(trainer.state, trainer)
+    out["resumed_from"] = int(resumed)
+
+    trainer.run()
+
+    out["losses"] = {str(k): v for k, v in sorted(losses.items())}
+    out["step_ok"] = {str(k): v for k, v in sorted(oks.items())}
+    out["final_iteration"] = trainer.iteration
+    out["final_digest"] = tree_digest(trainer.state.params)
+    out["checkpoint_steps"] = [int(s) for s in ckpt.all_steps()]
+    out["known_good"] = ckpt.known_good_steps()
+    out["report"] = guard.guard_report()
+    ckpt.close()
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    result_path = os.path.join(
+        os.environ["CMN_TEST_TMP"],
+        f"verdict_{os.environ['CMN_PROCESS_ID']}.json",
+    )
+    try:
+        verdict = main()
+    except BaseException:
+        verdict = {"status": "fail", "traceback": traceback.format_exc()}
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import chainermn_tpu as cmn
 from chainermn_tpu.ops import random_crop, random_crop_flip, random_flip
 
+pytestmark = pytest.mark.tier1  # fast tier: stays in --quick / tier-1 (see tests/test_repo_health.py)
+
 
 def _imgs(b=8, h=16, w=16, c=3, seed=0):
     rng = np.random.RandomState(seed)

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 
 from chainermn_tpu.ops import chunked_softmax_cross_entropy
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _case(n=24, d=16, v=100, seed=0):
     rng = np.random.RandomState(seed)

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 
 from chainermn_tpu.ops.conv_fused import conv1x1_bn_relu, matmul_affine
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _data(N=64, K=32, C=16, seed=0):
     rng = np.random.RandomState(seed)

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 
 from chainermn_tpu.ops import flash_attention, reference_attention
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _oracle(q, k, v, causal):
     # Thin alias of the shared fp32 oracle (single source of truth for every

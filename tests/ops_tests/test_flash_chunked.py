@@ -23,6 +23,8 @@ from chainermn_tpu.ops.flash_attention import (
     flash_attention_lse,
 )
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _inputs(B=2, T=256, H=2, D=32, S=None, KH=None, seed=0):
     rng = np.random.RandomState(seed)

@@ -15,6 +15,8 @@ import pytest
 
 from chainermn_tpu.ops.flash_attention import flash_attention_lse
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 def _random_config(rng):
     T = int(rng.choice([64, 128, 192, 256]))

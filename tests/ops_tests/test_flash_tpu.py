@@ -18,9 +18,14 @@ def _tpu_available() -> bool:
         return False
 
 
-pytestmark = pytest.mark.skipif(
-    not _tpu_available(), reason="needs a real TPU (CPU path: interpret tests)"
-)
+pytestmark = [
+    # tier1: on CPU CI this whole module skips in milliseconds.
+    pytest.mark.tier1,
+    pytest.mark.skipif(
+        not _tpu_available(),
+        reason="needs a real TPU (CPU path: interpret tests)",
+    ),
+]
 
 
 @pytest.mark.parametrize("causal", [False, True])

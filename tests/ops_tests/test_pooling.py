@@ -13,6 +13,8 @@ from flax import linen as nn
 
 from chainermn_tpu.ops import max_pool_fused
 
+pytestmark = pytest.mark.slow  # full-CI tier: long-pole battery (see tests/test_repo_health.py marker hygiene)
+
 
 CONFIGS = [
     # (H, W, window, strides, padding) — the ResNet stem config first.

@@ -10,6 +10,10 @@ result/bench_tpu_vit_auto.json).  And 'auto' is backend-aware: off-TPU the
 Pallas path is interpret mode (a numerics vehicle, never a perf win), so
 auto always resolves 'xla' there."""
 
+import pytest
+
+pytestmark = pytest.mark.tier1  # fast tier: stays in --quick / tier-1 (see tests/test_repo_health.py)
+
 import numpy as np
 
 from chainermn_tpu.ops import resolve_attention

@@ -223,6 +223,7 @@ def test_optimizer_state_specs_structural():
     assert sched_state.count == P()
 
 
+@pytest.mark.slow  # ~60s: the single longest tier-1 straggler (r5 budget)
 def test_zero_mixed_param_dtypes_bf16_storage(devices):
     """ZeRO over a MIXED-dtype param tree — the bf16-storage LM layout
     (`TransformerLM(param_dtype=bfloat16)`: bf16 leaves + the fp32 MoE

@@ -1,0 +1,135 @@
+"""Consistency-vote protocol (tier-1, CPU-only): digest determinism,
+majority localization incl. the 2-rank no-majority case, error taxonomy,
+and the exchange wire over a fake object plane."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.resilience import (
+    PeerFailedError,
+    RankDivergedError,
+    majority_vote,
+    tree_digest,
+)
+from chainermn_tpu.resilience.consistency import (
+    VoteResult,
+    exchange_and_vote,
+    exchange_digests,
+)
+
+
+# ------------------------------------------------------------------ digests
+def test_digest_deterministic_and_content_sensitive():
+    tree = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(5, np.int32)}
+    same = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(5, np.int32)}
+    assert tree_digest(tree) == tree_digest(same)
+
+    flipped = {"a": same["a"].copy(), "b": same["b"]}
+    # One element, one ULP — the smallest representable corruption.
+    flipped["a"][2, 3] = np.nextafter(
+        flipped["a"][2, 3], np.float32(np.inf), dtype=np.float32
+    )
+    assert tree_digest(tree) != tree_digest(flipped)
+
+
+def test_digest_shape_and_dtype_sensitive():
+    a = np.zeros((2, 3), np.float32)
+    assert tree_digest({"x": a}) != tree_digest({"x": a.reshape(3, 2)})
+    assert tree_digest({"x": a}) != tree_digest(
+        {"x": np.zeros((2, 3), np.int32)}
+    )
+
+
+# ------------------------------------------------------------------- voting
+def test_unanimous_vote_is_clean():
+    v = majority_vote(["d"] * 4, step=7)
+    assert v.clean and v.majority == "d" and v.divergent == []
+    v.raise_if_diverged()  # no-op
+
+
+def test_majority_localizes_single_divergent_rank():
+    v = majority_vote(["good", "good", "BAD", "good"], step=9)
+    assert not v.clean
+    assert v.majority == "good"
+    assert v.divergent == [2]
+    assert not v.no_majority
+    with pytest.raises(RankDivergedError) as ei:
+        v.raise_if_diverged(rank=0)
+    err = ei.value
+    assert err.peer == 2 and err.divergent == [2] and err.step == 9
+    # Same taxonomy as every resilience error: attributed, kind-tagged,
+    # and catchable by pre-resilience TimeoutError call sites.
+    assert isinstance(err, PeerFailedError)
+    assert isinstance(err, TimeoutError)
+    assert err.kind == "diverged"
+
+
+def test_two_rank_disagreement_has_no_majority():
+    v = majority_vote(["a", "b"], step=3)
+    assert v.no_majority and v.majority is None
+    assert v.divergent == [0, 1]  # everyone is a suspect
+    with pytest.raises(RankDivergedError) as ei:
+        v.raise_if_diverged(rank=1)
+    assert ei.value.no_majority
+    assert ei.value.peer == -1  # cannot localize
+
+
+def test_even_split_has_no_majority():
+    v = majority_vote(["a", "a", "b", "b"], step=1)
+    assert v.no_majority and v.divergent == [0, 1, 2, 3]
+
+
+def test_strict_majority_needed():
+    # 2-of-4 agreeing is NOT a majority even if it is the largest group.
+    v = majority_vote(["a", "a", "b", "c"], step=1)
+    assert v.no_majority
+
+
+def test_single_rank_trivially_clean():
+    assert majority_vote(["x"], step=0).clean
+
+
+def test_empty_vote_rejected():
+    with pytest.raises(ValueError):
+        majority_vote([], step=0)
+
+
+# ----------------------------------------------------------------- exchange
+class _FakeComm:
+    """Object-plane stub: allgather returns a preset per-rank payload."""
+
+    def __init__(self, payloads, rank=0):
+        self._payloads = payloads
+        self.rank = rank
+        self.size = len(payloads)
+
+    def allgather_obj(self, obj):
+        out = list(self._payloads)
+        out[self.rank] = obj
+        return out
+
+
+def test_exchange_digests_happy_path():
+    comm = _FakeComm([(5, "a"), (5, "a"), (5, "b")], rank=0)
+    assert exchange_digests(comm, "a", 5) == ["a", "a", "b"]
+
+
+def test_exchange_rejects_desynchronized_vote():
+    comm = _FakeComm([(5, "a"), (6, "a")], rank=0)
+    with pytest.raises(RuntimeError, match="desynchronized"):
+        exchange_digests(comm, "a", 5)
+
+
+def test_exchange_and_vote_single_process_short_circuits():
+    v = exchange_and_vote(None, {"w": np.ones(3)}, step=2)
+    assert isinstance(v, VoteResult) and v.clean
+
+
+def test_exchange_and_vote_localizes_over_fake_comm():
+    tree = {"w": np.ones(3, np.float32)}
+    mine = tree_digest(tree)
+    comm = _FakeComm([(4, mine), (4, mine), (4, "divergent")], rank=0)
+    v = exchange_and_vote(comm, tree, step=4)
+    assert v.divergent == [2] and v.majority == mine

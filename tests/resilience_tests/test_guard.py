@@ -1,0 +1,402 @@
+"""TrainingHealthGuard tier-1 tests (single process, 8 virtual CPU devices):
+in-graph anomaly verdicts (NaN / Inf / grad-norm spike) and their
+determinism, skip-budget escalation, known-good ring + rollback recovery,
+fail-silent fault injection semantics, and step-time stats piggybacking on
+the heartbeat payload."""
+
+import queue
+import time
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.datasets import make_synthetic_classification
+from chainermn_tpu.extensions import create_multi_node_checkpointer
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models import MLP, classification_loss
+from chainermn_tpu.resilience import (
+    HEALTH_EXIT_CODE,
+    FailureDetector,
+    FaultInjector,
+    HealthEscalationInterrupt,
+    TrainingHealthGuard,
+    parse_fault_spec,
+    tree_digest,
+)
+from chainermn_tpu.resilience import faults as faults_mod
+from chainermn_tpu.training import Extension, Trainer
+
+
+@pytest.fixture
+def inject(monkeypatch):
+    """Install a process-wide injector for the trainer's hook points
+    (restored after the test)."""
+
+    def _set(spec):
+        inj = FaultInjector(parse_fault_spec(spec))
+        monkeypatch.setitem(faults_mod._process_injector, "built", True)
+        monkeypatch.setitem(faults_mod._process_injector, "inj", inj)
+        return inj
+
+    return _set
+
+
+def _trainer(devices, guard=None, stop=(8, "iteration"), seed=0,
+             extensions=None):
+    comm = cmn.create_communicator("xla", devices=devices)
+    model = MLP(hidden=(8,), n_out=4)
+    params = model.init(
+        jax.random.PRNGKey(seed), np.zeros((1, 8), np.float32)
+    )["params"]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    ds = make_synthetic_classification(128, 8, 4, seed=3)
+    it = SerialIterator(ds, 32, shuffle=True, seed=5)
+    return Trainer(
+        opt, opt.init(params), classification_loss(model), it, stop=stop,
+        has_aux=True, health_guard=guard, extensions=list(extensions or []),
+    )
+
+
+def _digest_capture(store):
+    def cap(trainer):
+        store[trainer.iteration] = tree_digest(trainer.state.params)
+
+    return Extension(cap, trigger=(1, "iteration"), name="digest-capture")
+
+
+# ----------------------------------------------------- in-graph verdicts
+def test_nan_step_is_skipped_with_no_side_effects(devices, inject):
+    inject("nan@grad:3")
+    digests = {}
+    guard = TrainingHealthGuard(spike_warmup=3)
+    tr = _trainer(devices, guard, extensions=[_digest_capture(digests)])
+    tr.run()
+
+    rep = guard.guard_report()
+    assert rep["skips"]["steps"] == [3]
+    assert rep["skips"]["total"] == 1
+    # The poisoned step was a no-op: params after 3 == params after 2 —
+    # and training continued (params moved again at 4).
+    assert digests[3] == digests[2]
+    assert digests[4] != digests[3]
+    # The carry agrees: one skip, healthy steps resumed counting, and the
+    # final params are finite.
+    h = np.asarray(tr.state.health)
+    assert h[2] == 1.0 and h[1] == tr.iteration - 1
+    assert all(
+        np.isfinite(np.asarray(p)).all()
+        for p in jax.tree_util.tree_leaves(tr.state.params)
+    )
+
+
+def test_spike_step_is_skipped(devices, inject):
+    inject("spike@loss:5")
+    digests = {}
+    guard = TrainingHealthGuard(spike_warmup=2, spike_factor=10.0)
+    tr = _trainer(devices, guard, extensions=[_digest_capture(digests)])
+    tr.run()
+    rep = guard.guard_report()
+    assert rep["skips"]["steps"] == [5]
+    assert digests[5] == digests[4]
+    assert digests[6] != digests[5]
+
+
+def test_skip_verdict_is_deterministic(devices, inject):
+    """Two identical runs produce bit-identical verdicts and params —
+    the property every rank-synchronized decision rests on."""
+    reports = []
+    finals = []
+    for _ in range(2):
+        inject("nan@grad:2;spike@loss:6")
+        guard = TrainingHealthGuard(spike_warmup=2)
+        tr = _trainer(devices, guard)
+        tr.run()
+        reports.append(guard.guard_report()["skips"]["steps"])
+        finals.append(tree_digest(tr.state.params))
+    assert reports[0] == reports[1] == [2, 6]
+    assert finals[0] == finals[1]
+
+
+def test_unguarded_nan_poisons_params_forever(devices, inject):
+    """Control: WITHOUT the guard the same fault destroys the run — the
+    gap this PR closes."""
+    inject("nan@grad:3")
+    tr = _trainer(devices, guard=None)
+    tr.run()
+    leaves = jax.tree_util.tree_leaves(tr.state.params)
+    # Most leaves are NaN-poisoned and never recover (a leaf whose grad
+    # path is gated by a saturated relu' can stay finite).
+    assert any(not np.isfinite(np.asarray(p)).all() for p in leaves)
+    losses = [float(np.asarray(o["loss"])) for o in tr.drain_observations()]
+    assert all(np.isfinite(losses[:2])) and not np.isfinite(losses[-1])
+
+
+# ------------------------------------------------------- skip budget
+def test_skip_budget_escalates_without_checkpointer(devices, inject):
+    inject("nan@grad:2;nan@grad:3;nan@grad:4")
+    guard = TrainingHealthGuard(skip_budget=2, spike_warmup=3)
+    tr = _trainer(devices, guard)
+    with pytest.raises(HealthEscalationInterrupt) as ei:
+        tr.run()
+    assert ei.value.code == HEALTH_EXIT_CODE
+    assert "skip budget" in ei.value.reason
+    assert guard.guard_report()["skips"]["consecutive"] == 3
+
+
+def test_healthy_step_resets_consecutive_count(devices, inject):
+    inject("nan@grad:2;nan@grad:4")  # non-consecutive skips
+    guard = TrainingHealthGuard(skip_budget=1, spike_warmup=4)
+    tr = _trainer(devices, guard)
+    tr.run()  # never escalates: budget counts CONSECUTIVE skips
+    assert guard.guard_report()["skips"]["steps"] == [2, 4]
+
+
+# ------------------------------------------- known-good ring + rollback
+def test_rollback_recovers_from_skip_storm(devices, inject, tmp_path):
+    """Votes bless snapshots; a skip storm escalates; the guard rolls back
+    to the newest known-good snapshot IN-PROCESS and the run completes."""
+    inject("nan@grad:4;nan@grad:5")
+    guard = TrainingHealthGuard(skip_budget=1, spike_warmup=3, vote_every=1)
+    comm = cmn.create_communicator("xla", devices=devices)
+    ckpt = create_multi_node_checkpointer(
+        "guard", comm, path=str(tmp_path), trigger=(1, "iteration"),
+        async_save=False,
+    )
+    digests = {}
+    tr = _trainer(devices, guard,
+                  extensions=[ckpt, _digest_capture(digests)])
+    tr.run()
+    ckpt.finalize(tr)
+
+    rep = guard.guard_report()
+    assert rep["rollbacks"]["count"] == 1
+    ev = rep["rollbacks"]["events"][0]
+    # Escalated at iteration 5 (2nd consecutive skip > budget 1); the
+    # newest blessed snapshot at that point was step 4 (clean vote at 4:
+    # the skipped step left params untouched, so the vote was clean).
+    assert ev["at_iteration"] == 5 and ev["step"] == 4
+    # Training completed the full stop after rolling back.
+    assert tr.iteration == 8
+    # Post-rollback snapshots were re-saved over the discarded trail.
+    assert ckpt.all_steps()[-1] == 8
+    assert ckpt.latest_known_good() == 8
+    ckpt.close()
+
+
+def test_known_good_ring_marking_and_discard(devices, tmp_path):
+    comm = cmn.create_communicator("xla", devices=devices)
+    ckpt = create_multi_node_checkpointer(
+        "ring", comm, path=str(tmp_path), trigger=(1, "iteration"),
+        async_save=False, known_good_keep=2, max_to_keep=10,
+    )
+    tr = _trainer(devices, extensions=[ckpt], stop=(5, "iteration"))
+    tr.run()
+    assert ckpt.all_steps() == [1, 2, 3, 4, 5]
+    # Blessing respects the vote iteration (nothing newer than 3)...
+    assert ckpt.mark_known_good_upto(3) == [2, 3]  # ring keeps last K=2
+    assert ckpt.latest_known_good() == 3
+    assert ckpt.known_good_steps() == [2, 3]
+    # ...is idempotent...
+    assert ckpt.mark_known_good_upto(3) == []
+    # ...and the ring survives a reconstruction (persisted to disk).
+    ckpt2 = create_multi_node_checkpointer(
+        "ring", comm, path=str(tmp_path), known_good_keep=2,
+    )
+    assert ckpt2.known_good_steps() == [2, 3]
+    # discard_after prunes disk AND the ring.
+    doomed = ckpt.discard_after(2)
+    assert doomed == [3, 4, 5]
+    assert ckpt.all_steps() == [1, 2]
+    assert ckpt.latest_known_good() == 2
+    ckpt.close()
+
+
+def test_latest_known_good_ignores_gc_reaped_steps(devices, tmp_path):
+    comm = cmn.create_communicator("xla", devices=devices)
+    ckpt = create_multi_node_checkpointer(
+        "gc", comm, path=str(tmp_path), trigger=(1, "iteration"),
+        async_save=False, max_to_keep=2, known_good_keep=3,
+    )
+    tr = _trainer(devices, extensions=[ckpt], stop=(3, "iteration"))
+    tr.run()
+    ckpt.mark_known_good_upto(3)
+    # max_to_keep=2 reaped step 1: it must not be offered as a rollback
+    # target even though it was once blessed.
+    assert ckpt.all_steps() == [2, 3]
+    assert 1 not in set(ckpt.known_good_steps()) or \
+        ckpt.latest_known_good() == 3
+    ckpt.close()
+
+
+# --------------------------------------------------- fail-silent faults
+def test_flip_param_changes_local_digest(devices, inject):
+    inject("flip@param:4")
+    digests = {}
+    tr = _trainer(devices, extensions=[_digest_capture(digests)],
+                  stop=(5, "iteration"))
+    before = None
+    tr.run()
+    # The flip lands AFTER iteration 4's update: captured digest at 4
+    # reflects the corruption, and it differs from a clean re-run.
+    clean = {}
+    tr2 = _trainer(devices, extensions=[_digest_capture(clean)],
+                   stop=(5, "iteration"))
+    tr2.run()
+    assert digests[3] == clean[3]
+    assert digests[4] != clean[4]
+    assert before is None
+
+
+def test_skew_step_parses_and_sleeps():
+    (s,) = parse_fault_spec("skew@step:3:50ms")
+    assert s.kind == "skew" and s.n == 3 and s.duration_s == \
+        pytest.approx(0.05)
+    (bare,) = parse_fault_spec("skew@step:80ms")
+    assert bare.n == 1 and bare.duration_s == pytest.approx(0.08)
+    assert s.text == "skew@step:3:0.05s"
+
+    slept = []
+    inj = FaultInjector([s], sleep=slept.append)
+    for it in range(1, 6):
+        inj.hook("step", count=it)
+    # Fires on EVERY hit from 3 on — a persistent straggler, not one-shot.
+    assert slept == [0.05, 0.05, 0.05]
+
+
+def test_poison_batch_raises_on_all_int_batch():
+    """A nan/spike fault firing into a batch with no float leaves would be
+    a silent no-op — the loud-injection contract forbids that."""
+    from chainermn_tpu.resilience import InjectedFault
+
+    inj = FaultInjector(parse_fault_spec("nan@grad:1"))
+    with pytest.raises(InjectedFault, match="no floating-point"):
+        faults_mod.poison_batch(
+            inj, (np.zeros(4, np.int32), np.ones(4, np.int64)), 1
+        )
+    # Mixed batches corrupt only the float leaves, silently and correctly.
+    inj2 = FaultInjector(parse_fault_spec("nan@grad:1"))
+    x, y = faults_mod.poison_batch(
+        inj2, (np.zeros(4, np.float32), np.ones(4, np.int64)), 1
+    )
+    assert np.isnan(x).all() and (y == 1).all()
+
+
+def test_fail_silent_kind_parse_rejects_malformed():
+    from chainermn_tpu.resilience import FaultSpecError
+
+    for bad in ("nan@grad:0", "spike@loss:abc", "flip@param:",
+                "skew@step:0:50ms", "skew@step:50"):
+        with pytest.raises(FaultSpecError):
+            parse_fault_spec(bad)
+
+
+# ------------------------------------------------ stats over heartbeats
+class _MockTransport:
+    def __init__(self, rank, size):
+        self.rank, self.size = rank, size
+        self.sent = []
+        self._in = {r: queue.Queue() for r in range(size)}
+
+    def send_obj(self, obj, dest, **kw):
+        self.sent.append((dest, obj))
+
+    def deliver(self, source, obj):
+        self._in[source].put(obj)
+
+    def recv_obj(self, source, timeout_ms=-1, **kw):
+        try:
+            return self._in[source].get(timeout=max(timeout_ms, 1) / 1000.0)
+        except queue.Empty:
+            raise TimeoutError("empty")
+
+
+def test_heartbeats_carry_and_merge_step_time_stats():
+    # dead_after is huge: this test exercises the stats piggyback, and the
+    # deliberately sparse beat delivery must not latch the (sticky)
+    # death verdict mid-test.
+    det = FailureDetector(_MockTransport(0, 3), interval_s=0.02,
+                          suspect_after=2.0, dead_after=10000.0)
+    tp = det._tp
+    det.set_local_stats({"mean_ms": 12.5, "n": 4})
+    det.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not tp.sent and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert tp.sent
+        _, payload = tp.sent[0]
+        assert len(payload) == 4
+        assert payload[3][0][1]["mean_ms"] == 12.5
+        # Gossip from the predecessor (rank 2) carrying rank 1's stats
+        # (relayed): freshest-wins merge makes both visible.
+        tp.deliver(2, ("hb", 1, [], {
+            2: (1, {"mean_ms": 40.0}), 1: (7, {"mean_ms": 99.0}),
+        }))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            stats = det.peer_stats()
+            if 1 in stats and 2 in stats:
+                break
+            time.sleep(0.005)
+        assert stats[2]["mean_ms"] == 40.0
+        assert stats[1]["mean_ms"] == 99.0
+        assert stats[0]["mean_ms"] == 12.5  # self included
+        # A STALER relay for rank 1 must not clobber the fresher entry.
+        tp.deliver(2, ("hb", 2, [], {1: (3, {"mean_ms": 1.0})}))
+        time.sleep(0.1)
+        assert det.peer_stats()[1]["mean_ms"] == 99.0
+        # Pre-stats 3-tuple heartbeats still count as beats.
+        tp.deliver(2, ("hb", 3, []))
+        assert det.dead_ranks() == set()
+    finally:
+        det.stop()
+
+
+class _StatsDetectorStub:
+    def __init__(self, peers):
+        self._peers = peers
+        self.local = None
+
+    def set_local_stats(self, stats):
+        self.local = stats
+
+    def peer_stats(self):
+        # Peers only: rank 0's local CPU-test step times (jit compiles
+        # inflate them wildly) must not skew the median under test.
+        return dict(self._peers)
+
+
+def test_straggler_flagged_from_peer_stats(devices):
+    stub = _StatsDetectorStub({
+        1: {"mean_ms": 10.0}, 2: {"mean_ms": 11.0}, 3: {"mean_ms": 95.0},
+    })
+    # No voting: straggler surfacing must work from the detector alone.
+    guard = TrainingHealthGuard(detector=stub, stats_every=2,
+                                straggler_factor=3.0)
+    tr = _trainer(devices, guard, stop=(2, "iteration"))
+    tr.run()
+    rep = guard.guard_report()
+    assert 3 in rep["stragglers"]
+    assert rep["stragglers"][3]["mean_ms"] == 95.0
+    # Rank 0's own (fast CPU-step) stats went to the detector too.
+    assert stub.local is not None and stub.local["n"] == 2
+    assert rep["step_time"]["mean_ms"] is not None
+
+
+def test_guard_report_shape(devices):
+    guard = TrainingHealthGuard(vote_every=2)
+    tr = _trainer(devices, guard, stop=(4, "iteration"))
+    tr.run()
+    rep = guard.guard_report()
+    import json
+
+    json.dumps(rep)  # report is JSON-serializable by contract
+    assert rep["rank"] == 0
+    assert [v["step"] for v in rep["votes"]] == [2, 4]
+    assert all(v["clean"] for v in rep["votes"])
+    assert rep["step_time"]["n"] == 4
+    assert rep["rollbacks"] == {"count": 0, "budget": 2, "events": []}

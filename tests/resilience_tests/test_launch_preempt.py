@@ -74,6 +74,47 @@ def test_health_line_per_attempt(tmp_path):
     assert "attempt 0: nproc=1 rc=0 (ok) duration=" in log, log[-3000:]
 
 
+def test_health_exit_uses_separate_allowance(tmp_path):
+    """A training-health escalation (exit 76) relaunches via its own
+    --health-restarts allowance, never the crash budget — and the attempt
+    line names the kind."""
+    health_once = """
+        import os, sys
+        from chainermn_tpu.resilience import HEALTH_EXIT_CODE
+        if os.environ.get("CMN_LAUNCH_ATTEMPT", "0") == "0":
+            sys.exit(HEALTH_EXIT_CODE)
+        sys.exit(0)
+    """
+    res, log = _run_supervised(
+        tmp_path, health_once,
+        args=("--restarts", "0", "--restart-backoff", "0.1"),
+    )
+    assert res.returncode == 0, log[-3000:]
+    assert "(health)" in log, log[-3000:]
+    assert "health allowance" in log, log[-3000:]
+    assert "job failed" not in log, log[-3000:]
+
+
+def test_health_allowance_is_bounded(tmp_path):
+    from chainermn_tpu.resilience import HEALTH_EXIT_CODE
+
+    always = """
+        import sys
+        from chainermn_tpu.resilience import HEALTH_EXIT_CODE
+        sys.exit(HEALTH_EXIT_CODE)
+    """
+    res, log = _run_supervised(
+        tmp_path, always,
+        args=("--restarts", "5", "--health-restarts", "1",
+              "--restart-backoff", "0.1"),
+    )
+    # Surfaces the health code after 1 retry; the 5-deep crash budget was
+    # never touched.
+    assert res.returncode == HEALTH_EXIT_CODE, log[-3000:]
+    assert log.count("(health)") == 2, log[-3000:]
+    assert "(failure)" not in log, log[-3000:]
+
+
 def test_ordinary_failure_still_consumes_restart_budget(tmp_path):
     fail_once = """
         import os, sys

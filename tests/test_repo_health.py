@@ -10,6 +10,7 @@ empty namespace package, silently).  This tier-1 guard fails on:
 """
 
 import os
+import re
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -44,6 +45,36 @@ def test_every_pycache_has_adjacent_sources():
     assert not orphans, (
         "stale bytecode with no adjacent source (a pyc-only ghost package "
         f"in the making — delete it): {orphans}"
+    )
+
+
+#: Dirs whose tests dominate tier-1 wall clock (the flash interpret
+#: sweeps, model oracles, decode batteries): every test FILE here must
+#: declare its tier explicitly — `pytestmark` with `slow` (full-CI only)
+#: or `tier1` (fast, stays in --quick).  Without the marker, a new
+#: long-pole lands in tier-1 by default and the budgeted verify command
+#: times out mid-suite, which reads as mysterious breakage.
+_TIERED_DIRS = (
+    os.path.join("tests", "models_tests"),
+    os.path.join("tests", "ops_tests"),
+)
+def test_long_pole_dirs_declare_test_tiers():
+    undeclared = []
+    for d in _TIERED_DIRS:
+        for f in sorted(os.listdir(os.path.join(REPO, d))):
+            if not (f.startswith("test_") and f.endswith(".py")):
+                continue
+            path = os.path.join(REPO, d, f)
+            with open(path) as fh:
+                src = fh.read()
+            if not re.search(r"^pytestmark\s*=", src, re.M) or \
+                    not re.search(r"pytest\.mark\.(slow|tier1)\b", src):
+                undeclared.append(os.path.relpath(path, REPO))
+    assert not undeclared, (
+        "test files in tier-budgeted dirs without an explicit tier marker "
+        "(add `pytestmark = pytest.mark.tier1` if it is fast, or "
+        "`pytest.mark.slow` if it belongs to full CI only): "
+        f"{undeclared}"
     )
 
 

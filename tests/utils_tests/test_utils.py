@@ -3,6 +3,7 @@
 import os
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +47,7 @@ def test_pvary_inside_checked_shard_map(devices):
     assert out.shape == (32,)  # per-rank (4,) stacked over the 8 ranks
 
 
+@pytest.mark.slow  # ~25s: profiler spin-up dominates (tier-1 budget)
 def test_trace_writes_profile(tmp_path):
     with trace(str(tmp_path)):
         jax.block_until_ready(jnp.ones((16, 16)) @ jnp.ones((16, 16)))
