@@ -132,15 +132,22 @@ def _decode_headline() -> dict | None:
     """The decode family's strongest on-chip generated-tokens/sec, same
     composite policy as :func:`_lm_headline`.  The glob covers every
     decode artifact family (``decode_tpu*``, ``decode_spec*``,
-    ``decode_streaming*``); embedded arms (``kv_int8``, ``rolling``,
-    ``speculative``) compete against the plain number with a tag saying
-    which arm won."""
+    ``decode_streaming*``).
+
+    Only OUTPUT-EQUIVALENT arms compete for the headline — plain,
+    ``kv_int8``, ``speculative`` all produce (modulo documented bf16
+    argmax tie-flips) the target model's greedy generation, so their
+    tokens/sec answer the same question.  ``rolling`` decodes through an
+    O(window) ring cache — a *different function* (bounded attention
+    context) whose higher tokens/sec must not beat the full-attention
+    arms at their own metric; its best capture is reported separately
+    under ``windowed_decode``."""
 
     def cands(rec):
         if rec.get("metric") != "lm_decode_tokens_per_sec":
             return
         arms = [(rec.get("value"), "plain")]
-        for arm in ("kv_int8", "rolling", "speculative"):
+        for arm in ("kv_int8", "speculative"):
             if isinstance(rec.get(arm), dict):
                 arms.append((rec[arm].get("tokens_per_sec"), arm))
         for tps, arm in arms:
@@ -152,7 +159,54 @@ def _decode_headline() -> dict | None:
                 "config": rec.get("config"),
             }
 
-    return _best_result("decode*tpu*.json", cands)
+    best = _best_result("decode*tpu*.json", cands)
+
+    def windowed(rec):
+        if rec.get("metric") != "lm_decode_tokens_per_sec":
+            return
+        if isinstance(rec.get("rolling"), dict):
+            yield rec["rolling"].get("tokens_per_sec"), {
+                "tokens_per_sec": rec["rolling"].get("tokens_per_sec"),
+                "arm": "rolling",
+                "cache_slots": rec["rolling"].get("cache_slots"),
+                "batch": rec.get("batch"),
+                "config": rec.get("config"),
+            }
+
+    win = _best_result("decode*tpu*.json", windowed)
+    if best is not None and win is not None:
+        best["windowed_decode"] = win
+    elif best is None and win is not None:
+        best = {"metric": "lm_decode_tokens_per_sec",
+                "tokens_per_sec": None, "windowed_decode": win}
+    return best
+
+
+def _obs_overhead_headline() -> dict | None:
+    """Newest on-chip observability-overhead capture
+    (``benchmarks/observability.py`` → ``result/obs_overhead*.json``):
+    the default-on cost of the metrics/tracing stack as a % of LM step
+    time, carried in the composite payload + final summary line so the
+    <1% contract (docs/observability.md) is checkable from the driver
+    tail without opening artifacts."""
+
+    def cands(rec):
+        if rec.get("metric") != "observability_overhead_pct":
+            return
+        # Newest capture wins (not the smallest overhead — this is a
+        # contract check, not a leaderboard).
+        yield rec.get("measured_at") or "", {
+            "metric": "observability_overhead_pct",
+            "overhead_pct": rec.get("value"),
+            "step_ms_obs_on": rec.get("step_ms_obs_on"),
+            "step_ms_obs_off": rec.get("step_ms_obs_off"),
+            "within_contract": (
+                rec.get("value") is not None and rec["value"] < 1.0
+            ),
+            "config": rec.get("config"),
+        }
+
+    return _best_result("obs_overhead*.json", cands)
 
 
 def _emit(payload: dict) -> None:
@@ -166,6 +220,9 @@ def _emit(payload: dict) -> None:
     dec = _decode_headline()
     if dec is not None:
         payload["decode_headline"] = dec
+    obs = _obs_overhead_headline()
+    if obs is not None:
+        payload["observability_overhead"] = obs
     print(json.dumps(payload))
     # Compact FINAL summary line (VERDICT r5 items 2 & 8): the composite
     # payload above has grown past tail windows that capture only the last
@@ -191,6 +248,12 @@ def _emit(payload: dict) -> None:
         ),
         "decode_tokens_per_sec": (
             dec.get("tokens_per_sec") if dec is not None else None
+        ),
+        # Observability-stack cost on the LM step (default-on vs off) —
+        # the <1% contract, visible from the tail summary alone.  None
+        # until an on-chip obs_overhead capture lands.
+        "obs_overhead_pct": (
+            obs.get("overhead_pct") if obs is not None else None
         ),
     }
     for k in ("cache_age_hours", "cache_source_commit", "error"):

@@ -221,6 +221,35 @@ def main():
         payload["window"] = args.window
     if args.rope:
         payload["pos_enc"] = "rope"
+    if args.kv_int8:
+        # BEFORE the speculative block: --draft-mode distilled mutates
+        # `params` in place (zeroing tail-block write-backs), so an int8
+        # arm run after it would time — and compare agreement against —
+        # the zero-tail model while `dt`/`plain_toks` came from the real
+        # one.  Same params (kv_dtype only changes cache storage), same
+        # prompt, same process: the ratio isolates the cache-bandwidth
+        # halving.  Token agreement vs the float cache is reported with
+        # the same divergence structure as the speculative check — int8
+        # absmax noise can flip near-argmax-ties, a logic bug flips row 0
+        # step 0.
+        q8_model = model.clone(kv_dtype=jnp.int8)
+        q8_dt, q8_toks = timed(False, m=q8_model)
+        payload["kv_int8"] = {
+            "tokens_per_sec": round(
+                args.batch * args.new * args.iters / q8_dt, 1
+            ),
+            "ms_per_gen_step": round(
+                q8_dt / args.iters / steps * 1000.0, 3
+            ),
+            "speedup_vs_float_cache": round(dt / q8_dt, 3),
+            # k+v int8 payload plus the two fp32 scale planes.
+            "cache_bytes_per_layer": (
+                2 * args.batch * model.max_len
+                * (args.kv_heads or args.heads)
+                * (args.d_model // args.heads + 4)
+            ),
+            "greedy_agreement": _divergence_stats(q8_toks, plain_toks),
+        }
     if args.speculative:
         # Draft-propose / target-verify: output is EXACTLY the target's
         # greedy generation (asserted below on real outputs), so the
@@ -349,30 +378,6 @@ def main():
             payload["speculative_sweep"] = spec_recs
         else:
             payload["speculative"] = spec_recs[0]
-    if args.kv_int8:
-        # Same params (kv_dtype only changes cache storage), same prompt,
-        # same process: the ratio isolates the cache-bandwidth halving.
-        # Token agreement vs the float cache is reported with the same
-        # divergence structure as the speculative check — int8 absmax
-        # noise can flip near-argmax-ties, a logic bug flips row 0 step 0.
-        q8_model = model.clone(kv_dtype=jnp.int8)
-        q8_dt, q8_toks = timed(False, m=q8_model)
-        payload["kv_int8"] = {
-            "tokens_per_sec": round(
-                args.batch * args.new * args.iters / q8_dt, 1
-            ),
-            "ms_per_gen_step": round(
-                q8_dt / args.iters / steps * 1000.0, 3
-            ),
-            "speedup_vs_float_cache": round(dt / q8_dt, 3),
-            # k+v int8 payload plus the two fp32 scale planes.
-            "cache_bytes_per_layer": (
-                2 * args.batch * model.max_len
-                * (args.kv_heads or args.heads)
-                * (args.d_model // args.heads + 4)
-            ),
-            "greedy_agreement": _divergence_stats(q8_toks, plain_toks),
-        }
     if rolling_dt is not None:
         payload["rolling"] = {
             "tokens_per_sec": round(

@@ -44,6 +44,7 @@ from chainermn_tpu.extensions import (  # noqa: E402
     create_multi_node_evaluator,
 )
 from chainermn_tpu import global_except_hook  # noqa: E402
+from chainermn_tpu import observability  # noqa: E402
 from chainermn_tpu import resilience  # noqa: E402
 from chainermn_tpu.resilience import (  # noqa: E402
     HEALTH_EXIT_CODE,
@@ -102,6 +103,7 @@ __all__ = [
     "create_multi_node_iterator",
     "create_synchronized_iterator",
     "create_device_prefetch_iterator",
+    "observability",
     "resilience",
     "FailureDetector",
     "PeerFailedError",

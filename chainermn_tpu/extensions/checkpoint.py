@@ -18,6 +18,7 @@ so resume is exact.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import os
@@ -28,6 +29,9 @@ from typing import Any, List, Optional, Tuple
 import jax
 import numpy as np
 
+from chainermn_tpu import observability as _obs
+from chainermn_tpu.observability import metrics as _omet
+from chainermn_tpu.observability import tracing as _otrace
 from chainermn_tpu.resilience.policy import RetryPolicy
 from chainermn_tpu.training import Extension
 
@@ -128,7 +132,15 @@ class MultiNodeCheckpointer(Extension):
                 force=attempt > 0,
             )
 
-        self._save_retry.call(_commit)
+        # Span + counter: the save DISPATCH is what blocks the loop
+        # (async commits flush later); the span records that cost and
+        # the flight recorder can name a rank dying mid-save.
+        obs_on = _obs.enabled()
+        with (_otrace.tracer().span("ckpt_save", detail=f"step={step}")
+              if obs_on else contextlib.nullcontext()):
+            self._save_retry.call(_commit)
+        if obs_on:
+            _omet.registry().counter("ckpt.saves").inc()
         self._last_saved_step = step
 
     def emergency_save(self, trainer) -> int:
@@ -195,9 +207,16 @@ class MultiNodeCheckpointer(Extension):
     def _restore(self, step, template):
         import orbax.checkpoint as ocp
 
-        return self._restore_retry.call(
-            self._mngr.restore, step, args=ocp.args.StandardRestore(template)
-        )
+        obs_on = _obs.enabled()
+        with (_otrace.tracer().span("ckpt_restore", detail=f"step={step}")
+              if obs_on else contextlib.nullcontext()):
+            out = self._restore_retry.call(
+                self._mngr.restore, step,
+                args=ocp.args.StandardRestore(template),
+            )
+        if obs_on:
+            _omet.registry().counter("ckpt.restores").inc()
+        return out
 
     def maybe_load(self, state, trainer=None) -> Tuple[Any, int]:
         """Reference anchor: ``_MultiNodeCheckpointer.maybe_load`` — restore

@@ -38,6 +38,17 @@ def _global_except_hook(exctype, value, tb):
     # backend failure), the process tag is the part we can afford to lose.
     traceback.print_exception(exctype, value, tb)
     try:
+        # Flight record BEFORE teardown (observability/flight.py): this is
+        # the last chance to persist what the dying rank was doing — the
+        # in-flight span, the span ring, metrics, guard/detector state.
+        # PeerFailedError / RankDivergedError attribution rides along.
+        # No-op unless CMN_OBS_FLIGHT_DIR is set; never raises.
+        from chainermn_tpu.observability import flight as _flight
+
+        _flight.snapshot_on_crash(value)
+    except Exception:
+        pass
+    try:
         import jax
 
         nproc = jax.process_count()

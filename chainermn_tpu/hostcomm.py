@@ -30,17 +30,28 @@ Resilience integration (``chainermn_tpu/resilience/``):
   dense CI hosts no longer kill the job on the first dial).
 * **Fault injection** — ``CMN_FAULT`` hook points on barrier/send/recv
   (see :mod:`chainermn_tpu.resilience.faults`).
+
+Observability (``chainermn_tpu/observability/``): every op records a span
+into the process tracer's bounded ring — fine-grained ``send_obj`` /
+``recv_obj`` spans carrying peer + byte count (``detail`` names the
+composite they serve), and coarse spans around each composite so the
+flight recorder can say *which collective* a dead rank was sitting in.
+Auxiliary meshes built with ``enable_faults=False`` (the heartbeat plane)
+are untraced by default — a 2 Hz heartbeat would churn the span ring out
+of anything useful — overridable via ``enable_trace``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import pickle
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
-from chainermn_tpu import _native
+from chainermn_tpu import _native, observability as _obs
+from chainermn_tpu.observability import tracing as _tracing
 from chainermn_tpu.resilience import faults as _faults
 from chainermn_tpu.resilience.detector import PeerFailedError
 from chainermn_tpu.resilience.policy import RetryPolicy
@@ -62,6 +73,7 @@ class HostComm:
         timeout_ms: int = 30000,
         bootstrap_retry: Optional[RetryPolicy] = None,
         enable_faults: bool = True,
+        enable_trace: Optional[bool] = None,
     ):
         if hosts is None:
             spec = os.environ.get("CMN_TPU_HOSTS", "")
@@ -88,6 +100,14 @@ class HostComm:
         # The PROCESS-WIDE injector is shared with the trainer loop so a
         # hang fired from any site freezes the callbacks registered here.
         self._faults = _faults.process_injector() if enable_faults else None
+        # Span tracing follows ``enable_faults`` by default: auxiliary
+        # meshes (heartbeats) opt out of both for the same reason — they
+        # are not the data plane being observed.
+        if enable_trace is None:
+            enable_trace = enable_faults
+        self._trace = (
+            _tracing.tracer() if enable_trace and _obs.enabled() else None
+        )
         self._lib = _native.load_hostcomm()
         if self._lib is None:
             raise RuntimeError("native hostcomm unavailable (g++ missing?)")
@@ -168,6 +188,16 @@ class HostComm:
             )
 
     # ------------------------------------------------------- point-to-point
+    def _span(self, op: str, peer: Optional[int] = None,
+              parent_op: Optional[str] = None):
+        """Fine-grained p2p span; ``parent_op`` (the composite being
+        served) lands in ``detail`` so op-level metrics stay per-primitive
+        while the ring still says which collective the frame belonged to."""
+        if self._trace is None:
+            return contextlib.nullcontext()
+        detail = parent_op if parent_op != op else None
+        return self._trace.span(op, peer=peer, detail=detail)
+
     def send_obj(
         self,
         obj: Any,
@@ -175,6 +205,10 @@ class HostComm:
         timeout_ms: Optional[int] = None,
         op: str = "send_obj",
     ) -> None:
+        with self._span("send_obj", peer=dest, parent_op=op) as sp:
+            self._send_obj(obj, dest, timeout_ms, op, sp)
+
+    def _send_obj(self, obj, dest, timeout_ms, op, span) -> None:
         if self._faults is not None:
             if self._faults.hook("send") == "drop":
                 # Injected drop: the message is lost on the wire — the
@@ -183,6 +217,8 @@ class HostComm:
                 return
         timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
         payload = pickle.dumps(obj)
+        if span is not None:
+            span.nbytes = len(payload)
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
         rc = self._lib.hostcomm_send(
             self._h, dest, buf, len(payload), timeout_ms
@@ -203,13 +239,17 @@ class HostComm:
         timeout_ms: Optional[int] = None,
         op: str = "recv_obj",
     ) -> Any:
-        if self._faults is not None:
-            if self._faults.hook("recv") == "drop":
-                # Injected drop: consume and discard one frame, then
-                # deliver the next as if the first never arrived.
-                self._pop_frame(source, timeout_ms, op)
-        timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
-        return pickle.loads(self._pop_frame(source, timeout_ms, op))
+        with self._span("recv_obj", peer=source, parent_op=op) as sp:
+            if self._faults is not None:
+                if self._faults.hook("recv") == "drop":
+                    # Injected drop: consume and discard one frame, then
+                    # deliver the next as if the first never arrived.
+                    self._pop_frame(source, timeout_ms, op)
+            timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
+            frame = self._pop_frame(source, timeout_ms, op)
+            if sp is not None:
+                sp.nbytes = len(frame)
+            return pickle.loads(frame)
 
     def _pop_frame(
         self, source: int, timeout_ms: Optional[int], op: str
@@ -228,18 +268,30 @@ class HostComm:
         return bytes(buf[: int(n)])
 
     # ----------------------------------------------------------- composites
+    def _composite_span(self, op: str, peer: Optional[int] = None):
+        """Coarse span around a whole composed collective — "which
+        collective is this rank sitting in" for the flight recorder."""
+        if self._trace is None:
+            return contextlib.nullcontext()
+        return self._trace.span(op, peer=peer)
+
     def barrier(self) -> None:
         """Dissemination barrier: log2(size) rounds of paired send/recv."""
-        if self._faults is not None:
-            self._faults.hook("barrier")
-        k = 1
-        while k < self.size:
-            self.send_obj((), (self.rank + k) % self.size, op="barrier")
-            self.recv_obj((self.rank - k) % self.size, op="barrier")
-            k *= 2
+        with self._composite_span("barrier"):
+            if self._faults is not None:
+                self._faults.hook("barrier")
+            k = 1
+            while k < self.size:
+                self.send_obj((), (self.rank + k) % self.size, op="barrier")
+                self.recv_obj((self.rank - k) % self.size, op="barrier")
+                k *= 2
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
         """Binomial-tree broadcast rooted at ``root`` (log2(size) depth)."""
+        with self._composite_span("bcast_obj", peer=root):
+            return self._bcast_obj(obj, root)
+
+    def _bcast_obj(self, obj: Any, root: int) -> Any:
         rel = (self.rank - root) % self.size
         mask = 1
         while mask < self.size:
@@ -259,26 +311,29 @@ class HostComm:
         return obj
 
     def gather_obj(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
-        if self.rank == root:
-            out: List[Any] = [None] * self.size
-            out[self.rank] = obj
-            for r in range(self.size):
-                if r != root:
-                    out[r] = self.recv_obj(r, op="gather_obj")
-            return out
-        self.send_obj(obj, root, op="gather_obj")
-        return None
+        with self._composite_span("gather_obj", peer=root):
+            if self.rank == root:
+                out: List[Any] = [None] * self.size
+                out[self.rank] = obj
+                for r in range(self.size):
+                    if r != root:
+                        out[r] = self.recv_obj(r, op="gather_obj")
+                return out
+            self.send_obj(obj, root, op="gather_obj")
+            return None
 
     def allgather_obj(self, obj: Any) -> List[Any]:
-        gathered = self.gather_obj(obj, root=0)
-        return self.bcast_obj(gathered, root=0)
+        with self._composite_span("allgather_obj"):
+            gathered = self.gather_obj(obj, root=0)
+            return self.bcast_obj(gathered, root=0)
 
     def allreduce_obj(self, obj: Any, op: Callable[[Any, Any], Any]) -> Any:
-        vals = self.allgather_obj(obj)
-        acc = vals[0]
-        for v in vals[1:]:
-            acc = op(acc, v)
-        return acc
+        with self._composite_span("allreduce_obj"):
+            vals = self.allgather_obj(obj)
+            acc = vals[0]
+            for v in vals[1:]:
+                acc = op(acc, v)
+            return acc
 
     def close(self) -> None:
         if getattr(self, "_h", None):

@@ -43,6 +43,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _flight_dir(env_extra: dict = None) -> str:
+    """Per-attempt flight-record directory exported to every rank as
+    ``CMN_OBS_FLIGHT_DIR`` (observability/flight.py).  An explicit value
+    (caller env_extra or the launcher's own environment) wins; otherwise
+    ``$CMN_OBS_DIR|flightrecords`` / ``attempt<N>`` — per-attempt so a
+    supervised relaunch never clobbers the records being debugged."""
+    explicit = (env_extra or {}).get(
+        "CMN_OBS_FLIGHT_DIR", os.environ.get("CMN_OBS_FLIGHT_DIR")
+    )
+    if explicit:
+        return explicit
+    attempt = (env_extra or {}).get(
+        "CMN_LAUNCH_ATTEMPT", os.environ.get("CMN_LAUNCH_ATTEMPT", "0")
+    )
+    return os.path.join(
+        os.environ.get("CMN_OBS_DIR", "flightrecords"), f"attempt{attempt}"
+    )
+
+
 def launch(
     nproc: int,
     argv: list,
@@ -60,6 +79,7 @@ def launch(
     # plane's per-source FIFOs with real messages.
     hb_ports = [_free_port() for _ in range(nproc)]
     hb_hosts = ",".join(f"127.0.0.1:{p}" for p in hb_ports)
+    flight_dir = _flight_dir(env_extra)
 
     procs = []
     for pid in range(nproc):
@@ -73,6 +93,10 @@ def launch(
                 "CMN_TPU_HOSTS": hosts,
                 "CMN_TPU_RANK": str(pid),
                 "CMN_TPU_HB_HOSTS": hb_hosts,
+                # Per-attempt flight-record path: a crashed/preempted/
+                # escalated rank leaves its black box here (written lazily
+                # — the dir only materializes when a record lands).
+                "CMN_OBS_FLIGHT_DIR": flight_dir,
             }
         )
         # Own session per rank so the launcher can kill a rank's whole
@@ -213,6 +237,13 @@ def supervise(
             f"[chainermn_tpu.launch] attempt {attempt}: nproc={n} rc={rc} "
             f"({kind}) duration={time.time() - t0:.1f}s\n"
         )
+        if rc != 0:
+            # Post-mortem pointer: where this attempt's ranks left their
+            # flight records (if any rank got far enough to write one).
+            sys.stderr.write(
+                f"[chainermn_tpu.launch] attempt {attempt}: flight records "
+                f"(if any) under {_flight_dir(env)}\n"
+            )
         if rc == 0:
             return 0
         if rc == PREEMPTION_EXIT_CODE:

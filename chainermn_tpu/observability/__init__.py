@@ -1,0 +1,118 @@
+"""Observability — see a run, not just its rank-0 stdout.
+
+The MPMD design makes every job N opaque host processes: the resilience
+layer (PRs 1–2) can say a run is *alive* and *healthy*, but nothing could
+say what a run is *doing* — which collective a rank sits in, how step time
+distributes across ranks, what a dead rank was executing when it died.
+(The seed once shipped an ``observability/`` package as pyc-only ghosts;
+this is the real one — ``tests/test_repo_health.py`` guards the ghosts.)
+
+Four cooperating pieces, all default-on and all bounded:
+
+* :mod:`~chainermn_tpu.observability.metrics` — per-rank registry of
+  counters / gauges / histograms (fixed bucket edges, so the cross-rank
+  merge is *exact*).  The Trainer, HostComm, checkpointer, failure
+  detector, and training-health guard publish into it.
+* :mod:`~chainermn_tpu.observability.tracing` — span records of host-plane
+  ops (send/recv/bcast_obj/…, checkpoint save/restore, guard votes) in a
+  bounded in-memory ring, plus ``jax.profiler`` trace annotations around
+  the train step so device profiles line up with host spans.
+* :mod:`~chainermn_tpu.observability.flight` — flight recorder: snapshots
+  the span ring + last-K metric samples + resilience state to a per-rank
+  JSONL file on :class:`~chainermn_tpu.resilience.PeerFailedError` /
+  :class:`~chainermn_tpu.resilience.RankDivergedError` crashes, on the
+  preemption (75) and health-escalation (76) exits, and on ``SIGUSR1`` —
+  post-mortems of dead ranks.
+* :mod:`~chainermn_tpu.observability.aggregate` — rank-0 aggregation over
+  the *existing* host object plane (no new meshes): a merged per-step
+  JSONL feed plus an optional Prometheus-style textfile.
+
+Env knobs (see ``docs/observability.md`` for the full table):
+
+* ``CMN_OBS=0`` — master off-switch: publishers skip the registry, span
+  hooks vanish, per-step trace annotations are not emitted.
+* ``CMN_OBS_SPAN_RING`` — span-ring capacity (default 512).
+* ``CMN_OBS_SAMPLES`` — metric-sample ring capacity (default 64).
+* ``CMN_OBS_FLIGHT_DIR`` — where flight records land (the launcher sets a
+  per-attempt path); ``CMN_OBS_FLIGHT=0`` disables the recorder.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: Process-wide override (``set_enabled``); None = follow the env.
+_enabled_override: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Default-on master switch: ``CMN_OBS=0`` turns every publisher into
+    a no-op.
+
+    Hot-path publishers LATCH this at construction (``HostComm``,
+    ``Trainer``, the guard, the detector resolve their instruments once
+    — re-checking per op would put an env read on the hot path), so flip
+    it BEFORE building them; ``MetricsReport`` re-checks at each fire.
+    The overhead bench honors this by rebuilding its Trainer per arm."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("CMN_OBS", "1") != "0"
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Force observability on/off in-process (``None`` = follow the env).
+    The A/B lever for the overhead benchmark and tests."""
+    global _enabled_override
+    _enabled_override = value
+
+
+from chainermn_tpu.observability.metrics import (  # noqa: E402
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    merge_snapshots,
+    registry,
+)
+from chainermn_tpu.observability.tracing import (  # noqa: E402
+    Span,
+    SpanRing,
+    Tracer,
+    step_annotation,
+    tracer,
+)
+from chainermn_tpu.observability.flight import (  # noqa: E402
+    FLIGHT_SCHEMA,
+    FlightRecorder,
+    recorder,
+    register_provider,
+    snapshot_on_crash,
+)
+from chainermn_tpu.observability.aggregate import (  # noqa: E402
+    MetricsAggregator,
+    render_prometheus,
+)
+
+__all__ = [
+    "enabled",
+    "set_enabled",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "merge_snapshots",
+    "registry",
+    "Span",
+    "SpanRing",
+    "Tracer",
+    "tracer",
+    "step_annotation",
+    "FLIGHT_SCHEMA",
+    "FlightRecorder",
+    "recorder",
+    "register_provider",
+    "snapshot_on_crash",
+    "MetricsAggregator",
+    "render_prometheus",
+]

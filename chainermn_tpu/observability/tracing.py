@@ -1,0 +1,261 @@
+"""Span tracing of host-plane ops + device-profile trace annotations.
+
+Every host-plane operation that can block a rank — an object-plane
+send/recv, a composed collective, a checkpoint commit, a consistency vote —
+records a :class:`Span` into a bounded in-memory ring: *op*, *peer rank*,
+*bytes*, *wall time*, and whether it raised.  The ring is what the flight
+recorder dumps when a rank dies, so a post-mortem can say "rank 2 spent its
+last 28 s inside ``bcast_obj`` from rank 0" instead of guessing from a
+truncated stdout.
+
+Two integration layers:
+
+* **Host spans** — :meth:`Tracer.span` context manager, called from
+  :class:`~chainermn_tpu.hostcomm.HostComm` (at the same hook points the
+  fault injector uses), the checkpointer, and the health guard.  Each span
+  also feeds the metrics registry (``host_op.<op>`` count/bytes/latency),
+  so the aggregated feed carries op rates without reading the ring.
+* **Device annotations** — :func:`step_annotation` wraps the train step in
+  a ``jax.profiler.TraceAnnotation`` (and guard-relevant regions in
+  ``jax.named_scope``), so an xprof capture lines device streams up with
+  the host spans by step number.
+
+Overhead discipline: a span is one ``perf_counter`` pair, one small object,
+one deque append, and three instrument updates — all gated on
+:func:`chainermn_tpu.observability.enabled`.  Nothing here ever touches a
+device buffer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from chainermn_tpu.observability import metrics as _metrics
+
+#: Bucket edges for host-op latency histograms (ms) — the registry default.
+_OP_EDGES = _metrics.DEFAULT_MS_EDGES
+
+
+@dataclass
+class Span:
+    """One completed (or failed) host-plane operation."""
+
+    op: str
+    peer: Optional[int] = None
+    nbytes: Optional[int] = None
+    #: wall-clock start, seconds since epoch (for cross-rank alignment).
+    wall_start: float = 0.0
+    ms: float = 0.0
+    ok: bool = True
+    error: Optional[str] = None
+    #: free-form detail (e.g. ``step=120`` for checkpoint spans).
+    detail: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d = {"op": self.op, "wall_start": self.wall_start,
+             "ms": round(self.ms, 3), "ok": self.ok}
+        for k in ("peer", "nbytes", "error", "detail"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+
+class SpanRing:
+    """Bounded ring of completed spans (oldest evicted first)."""
+
+    def __init__(self, capacity: int = 512):
+        if capacity < 1:
+            raise ValueError(f"span ring capacity must be >= 1: {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        #: Total ever appended (evictions = total - len).
+        self.total = 0
+
+    def append(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            self.total += 1
+            if len(self._spans) > self.capacity:
+                del self._spans[: len(self._spans) - self.capacity]
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self._spans]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+class _OpenSpan:
+    __slots__ = ("span", "t0")
+
+    def __init__(self, span: Span, t0: float):
+        self.span = span
+        self.t0 = t0
+
+
+class Tracer:
+    """Process-wide span recorder.
+
+    Tracks per-thread stacks of *open* spans so the flight recorder can
+    name what a rank is blocked in **right now** (``in_flight()``), and
+    keeps the most recent errored span (``last_error()``) for post-mortems
+    taken after the stack has already unwound — the crash path: by the
+    time ``sys.excepthook`` runs, the failing span has closed.
+    """
+
+    def __init__(self, ring: Optional[SpanRing] = None,
+                 publish_metrics: bool = True):
+        # `is None`, not `or`: an EMPTY ring is falsy (__len__ == 0) and
+        # `or` would silently replace the caller's ring with a fresh one.
+        self.ring = ring if ring is not None else SpanRing(
+            int(os.environ.get("CMN_OBS_SPAN_RING", "512"))
+        )
+        self._publish = publish_metrics
+        self._lock = threading.Lock()
+        #: thread ident -> stack of open spans (dict, not thread-local:
+        #: the flight recorder reads OTHER threads' stacks).
+        self._open: Dict[int, List[_OpenSpan]] = {}
+        self._last_error: Optional[Span] = None
+
+    # ----------------------------------------------------------------- spans
+    def span(self, op: str, peer: Optional[int] = None,
+             nbytes: Optional[int] = None, detail: Optional[str] = None):
+        """Context manager recording one host-plane op.  The yielded
+        :class:`Span` is mutable — callers that only learn the byte count
+        mid-op (recv) set ``span.nbytes`` before exit."""
+        return _SpanCtx(self, Span(op=op, peer=peer, nbytes=nbytes,
+                                   detail=detail, wall_start=time.time()))
+
+    def _push(self, open_span: _OpenSpan) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            self._open.setdefault(tid, []).append(open_span)
+
+    def _pop(self, open_span: _OpenSpan, error: Optional[BaseException]):
+        span = open_span.span
+        span.ms = (time.perf_counter() - open_span.t0) * 1000.0
+        if error is not None:
+            span.ok = False
+            span.error = f"{type(error).__name__}: {error}"[:300]
+        tid = threading.get_ident()
+        with self._lock:
+            stack = self._open.get(tid)
+            if stack and stack[-1] is open_span:
+                stack.pop()
+            elif stack and open_span in stack:  # defensive: odd unwind order
+                stack.remove(open_span)
+            if error is not None:
+                self._last_error = span
+        self.ring.append(span)
+        if self._publish:
+            reg = _metrics.registry()
+            reg.counter(f"host_op.{span.op}.total").inc()
+            if not span.ok:
+                reg.counter(f"host_op.{span.op}.errors").inc()
+            if span.nbytes is not None:
+                reg.counter(f"host_op.{span.op}.bytes").inc(span.nbytes)
+            reg.histogram(f"host_op.{span.op}.ms", _OP_EDGES).observe(span.ms)
+
+    # ------------------------------------------------------------ inspection
+    def in_flight(self) -> List[dict]:
+        """Currently open spans across ALL threads, innermost last per
+        thread — what each thread of this rank is sitting in right now."""
+        now = time.perf_counter()
+        out = []
+        with self._lock:
+            for tid, stack in self._open.items():
+                for os_ in stack:
+                    d = os_.span.to_dict()
+                    d["open_ms"] = round((now - os_.t0) * 1000.0, 3)
+                    d["thread"] = tid
+                    del d["ms"]  # not finished; open_ms is the honest number
+                    out.append(d)
+        return out
+
+    def last_error(self) -> Optional[dict]:
+        with self._lock:
+            return self._last_error.to_dict() if self._last_error else None
+
+    def current_span_name(self) -> Optional[str]:
+        """The innermost in-flight op (any thread; main thread preferred),
+        falling back to the last *errored* span — the flight recorder's
+        "what was this rank doing" one-liner."""
+        main_id = threading.main_thread().ident
+        with self._lock:
+            stack = self._open.get(main_id)
+            if stack:
+                return stack[-1].span.op
+            for other in self._open.values():
+                if other:
+                    return other[-1].span.op
+            if self._last_error is not None:
+                return self._last_error.op
+        return None
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_open")
+
+    def __init__(self, tracer_: Tracer, span: Span):
+        self._tracer = tracer_
+        self._open = _OpenSpan(span, 0.0)
+
+    def __enter__(self) -> Span:
+        self._open.t0 = time.perf_counter()
+        self._tracer._push(self._open)
+        return self._open.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._tracer._pop(self._open, exc)
+        return False  # never swallow
+
+
+# ------------------------------------------------------- device annotations
+def step_annotation(step: int):
+    """``jax.profiler.TraceAnnotation`` for one train step, so an xprof
+    device timeline carries the host step number; a null context when the
+    profiler API is unavailable (or observability is off — checked by the
+    caller, not here)."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation("cmn_train_step", step=int(step))
+    except Exception:  # pragma: no cover - profiler API missing
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def named_scope(name: str):
+    """``jax.named_scope`` pass-through (HLO op-name prefix inside traced
+    code — the in-graph counterpart of :func:`step_annotation`)."""
+    try:
+        import jax
+
+        return jax.named_scope(name)
+    except Exception:  # pragma: no cover
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+#: Process-wide tracer (lazy singleton, like the metrics registry).
+_tracer: Optional[Tracer] = None
+_tracer_lock = threading.Lock()
+
+
+def tracer() -> Tracer:
+    global _tracer
+    if _tracer is None:
+        with _tracer_lock:
+            if _tracer is None:
+                _tracer = Tracer()
+    return _tracer

@@ -8,6 +8,10 @@ code in interpret mode).
 """
 
 from chainermn_tpu.ops.chunked_ce import chunked_softmax_cross_entropy
+from chainermn_tpu.ops.decode_attention import (
+    MAX_FUSED_LEN,
+    fused_decode_attention,
+)
 from chainermn_tpu.ops.rope import apply_rope
 from chainermn_tpu.ops.augment import (
     random_crop,
@@ -32,6 +36,8 @@ __all__ = [
     "FLASH_MIN_SEQ",
     "FLASH_MIN_SEQ_NONCAUSAL",
     "max_pool_fused",
+    "fused_decode_attention",
+    "MAX_FUSED_LEN",
     "chunked_softmax_cross_entropy",
     "apply_rope",
     "random_crop",

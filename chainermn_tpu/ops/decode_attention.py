@@ -11,10 +11,14 @@ kernel streams each K/V byte through VMEM ONCE at its storage width
 in-register) and fuses score → mask → softmax → value-weighting in one
 program.
 
-Layout: the fused path stores the cache **(B, KH, L, Dh)** (kv-head
+Layout: the fused path expects the cache **(B, KH, L, Dh)** (kv-head
 major) so each grid program ``(b, kh)`` reads a contiguous ``(L, Dh)``
-panel — `TransformerLM(decode_attention="fused")` selects this layout in
-``init_cache`` and the block's write path.  Grid ``(B, KH)``; each
+panel.  NOT YET WIRED into :class:`TransformerLM` — its decode branch
+still runs the einsum path over the (B, L, KH, Dh) cache; adopting this
+kernel means a model knob that selects the kv-head-major layout in
+``init_cache`` and the block's write path (future work).  Until then the
+public entry point is :func:`fused_decode_attention` itself (exported
+from ``chainermn_tpu.ops``).  Grid ``(B, KH)``; each
 program stages its panel in VMEM (L·Dh·itemsize — ~1 MB at L=4096,
 Dh=128 bf16), computes the G=H/KH query heads' scores against it, masks
 positions ``>= valid_len`` (causality at decode = a length bound), and
@@ -25,8 +29,9 @@ lengths beyond the VMEM budget fall back to the einsum path upstream.
 No reference counterpart (the reference has no incremental-decode stack;
 SURVEY §2.9's examples are training-side) — this extends the repo's
 Pallas hot-op family (``ops/flash_attention.py``) to the inference loop.
-On non-TPU backends the kernel runs in Pallas interpret mode, so the CPU
-suite pins numerics against the einsum oracle.
+On non-TPU backends the kernel runs in Pallas interpret mode;
+``tests/ops_tests/test_decode_attention.py`` pins its numerics against
+an einsum oracle (MHA/GQA, ragged ``valid_len``, int8 cache + scales).
 """
 
 from __future__ import annotations

@@ -284,8 +284,13 @@ class MultiNodeOptimizer:
     def _allreduce_grads(self, grads: Any) -> Any:
         """In-graph gradient mean — the ``allreduce_grad`` hot path, delegated
         to the per-leaf reducer (wire-dtype aware; identity for
-        DummyCommunicator; model-axis-aware when ``grad_reduce`` was given)."""
-        return jax.tree_util.tree_map(self.grad_reduce, grads)
+        DummyCommunicator; model-axis-aware when ``grad_reduce`` was given).
+
+        Named-scoped so the collective region is identifiable in a device
+        profile next to the host-side step annotations
+        (``docs/observability.md``)."""
+        with jax.named_scope("cmn_allreduce_grads"):
+            return jax.tree_util.tree_map(self.grad_reduce, grads)
 
     # ----------------------------------------------------------- train step
     def make_train_step(

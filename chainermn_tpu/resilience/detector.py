@@ -176,6 +176,21 @@ class FailureDetector:
         self._tp = transport
         self._own_tp = own_transport
         self._clock = clock
+        # Observability: heartbeat liveness in the shared registry, and a
+        # flight-record provider so a post-mortem carries this rank's view
+        # of who was dead (imported lazily — detector must stay importable
+        # before the package facade).
+        from chainermn_tpu import observability as _obs
+        from chainermn_tpu.observability import flight as _oflight
+        from chainermn_tpu.observability import metrics as _omet
+
+        self._obs_on = _obs.enabled()
+        if self._obs_on:
+            reg = _omet.registry()
+            self._m_beats_sent = reg.counter("hb.beats_sent")
+            self._m_beats_recv = reg.counter("hb.beats_received")
+            self._m_dead = reg.gauge("hb.dead_ranks")
+            _oflight.register_provider("detector", self.liveness_report)
         self._mu = threading.Lock()
         self._stop = threading.Event()
         self._threads = []
@@ -201,6 +216,21 @@ class FailureDetector:
     def dead_ranks(self) -> Set[int]:
         with self._mu:
             return self.core.dead()
+
+    def liveness_report(self) -> dict:
+        """This rank's liveness view, for the flight recorder: who is
+        dead (with the detector's attributed reasons) and the freshest
+        gossiped step-time stats."""
+        with self._mu:
+            dead = sorted(self.core.dead())
+            reasons = {str(r): self.core.reason(r) for r in dead}
+        return {
+            "rank": self.core.rank,
+            "interval_s": self.core.interval_s,
+            "dead": dead,
+            "dead_reasons": reasons,
+            "peer_stats": self.peer_stats(),
+        }
 
     # ------------------------------------------------------ stats piggyback
     def set_local_stats(self, stats: dict) -> None:
@@ -285,6 +315,8 @@ class FailureDetector:
                 )
             try:
                 self._tp.send_obj(payload, self.core.succ)
+                if self._obs_on:
+                    self._m_beats_sent.inc()
             except Exception:
                 # A failed beat to the successor is the successor's
                 # successor's problem to detect; ours is only to keep
@@ -300,6 +332,8 @@ class FailureDetector:
                 # valid beats; 4-tuples carry the stats gossip map.
                 if isinstance(msg, tuple) and len(msg) in (3, 4) \
                         and msg[0] == "hb":
+                    if self._obs_on:
+                        self._m_beats_recv.inc()
                     with self._mu:
                         self.core.note_heartbeat(
                             self.core.pred, self._clock(), dead_ranks=msg[2]
@@ -325,6 +359,9 @@ class FailureDetector:
                     return
             with self._mu:
                 self.core.evaluate(self._clock())
+                n_dead = len(self.core.dead())
+            if self._obs_on:
+                self._m_dead.set(n_dead)
 
     # ------------------------------------------------------------ wiring
     def attach(self, hostcomm) -> "FailureDetector":

@@ -51,10 +51,15 @@ inject at the trainer's hook points — see ``docs/resilience.md``.
 
 from __future__ import annotations
 
+import contextlib
 import sys
 from collections import deque
 from typing import Dict, List, Optional
 
+from chainermn_tpu import observability as _obs
+from chainermn_tpu.observability import flight as _oflight
+from chainermn_tpu.observability import metrics as _omet
+from chainermn_tpu.observability import tracing as _otrace
 from chainermn_tpu.resilience import consistency as _consistency
 from chainermn_tpu.resilience.consistency import RankDivergedError
 
@@ -158,6 +163,20 @@ class TrainingHealthGuard:
         self._step_times = deque(maxlen=int(stats_window))
         self._steps_timed = 0
         self.last_divergence: Optional[RankDivergedError] = None
+        # Observability: the guard's counters live in the shared registry
+        # (instead of ONLY the ad-hoc dicts above, which remain the
+        # guard_report() source of truth), and guard_report feeds the
+        # flight recorder's resilience section — a dead rank's record
+        # carries its full health history.
+        self._obs_on = _obs.enabled()
+        if self._obs_on:
+            reg = _omet.registry()
+            self._m_skips = reg.counter("guard.skips")
+            self._m_votes = reg.counter("guard.votes")
+            self._m_votes_dirty = reg.counter("guard.votes_dirty")
+            self._m_rollbacks = reg.counter("guard.rollbacks")
+            self._m_consecutive = reg.gauge("guard.consecutive_skips")
+            _oflight.register_provider("guard_report", self.guard_report)
 
     # ------------------------------------------------------------------ wire
     @property
@@ -223,10 +242,15 @@ class TrainingHealthGuard:
         ok = float(metrics["step_ok"]) >= 0.5
         if ok:
             self._consecutive_skips = 0
+            if self._obs_on:
+                self._m_consecutive.set(0)
             return
         self._consecutive_skips += 1
         self._total_skips += 1
         self._skip_steps.append(it)
+        if self._obs_on:
+            self._m_skips.inc()
+            self._m_consecutive.set(self._consecutive_skips)
         # The step LIST is bounded (history); the total is a counter and
         # never trimmed.
         del self._skip_steps[: -self._history_limit]
@@ -245,9 +269,17 @@ class TrainingHealthGuard:
 
     # -------------------------------------------------------------- voting
     def _vote(self, trainer, it: int) -> None:
-        vote = _consistency.exchange_and_vote(
-            self.comm, trainer.state.params, it
-        )
+        # The vote is a host-plane collective a rank can block in — span
+        # it so a flight record names it, and count outcomes.
+        with (_otrace.tracer().span("guard_vote", detail=f"step={it}")
+              if self._obs_on else contextlib.nullcontext()):
+            vote = _consistency.exchange_and_vote(
+                self.comm, trainer.state.params, it
+            )
+        if self._obs_on:
+            self._m_votes.inc()
+            if not vote.clean:
+                self._m_votes_dirty.inc()
         entry = {
             "step": it,
             "clean": vote.clean,
@@ -300,7 +332,11 @@ class TrainingHealthGuard:
                 ckpt.discard_after(int(good))
             except Exception:
                 pass
-        raise HealthEscalationInterrupt(reason, trainer.iteration)
+        err = HealthEscalationInterrupt(reason, trainer.iteration)
+        # Exit-76 flight record BEFORE raising: the interrupt is a
+        # SystemExit, which bypasses the except hook's crash snapshot.
+        _oflight.snapshot_on_crash(err)
+        raise err
 
     def _rollback(self, trainer, ckpt, good: int, reason: str) -> None:
         n = len(self._rollbacks) + 1
@@ -318,6 +354,9 @@ class TrainingHealthGuard:
         # the next LogReport window.
         trainer.drain_observations()
         self._consecutive_skips = 0
+        if self._obs_on:
+            self._m_rollbacks.inc()
+            self._m_consecutive.set(0)
         self._rollbacks.append(
             {"step": int(good), "at_iteration": at_it, "reason": reason}
         )

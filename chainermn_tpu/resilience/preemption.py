@@ -161,7 +161,13 @@ class PreemptionGuard:
             f"{PREEMPTION_EXIT_CODE}\n"
         )
         sys.stderr.flush()
-        raise PreemptionInterrupt(it)
+        err = PreemptionInterrupt(it)
+        # Exit-75 flight record BEFORE raising: a SystemExit bypasses the
+        # except hook's crash snapshot (observability/flight.py).
+        from chainermn_tpu.observability import flight as _oflight
+
+        _oflight.snapshot_on_crash(err)
+        raise err
 
     @staticmethod
     def _find_checkpointer(trainer):

@@ -11,6 +11,7 @@ every reference example).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import os
@@ -21,6 +22,11 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from chainermn_tpu import observability as _obs
+from chainermn_tpu.observability import aggregate as _oagg
+from chainermn_tpu.observability import flight as _oflight
+from chainermn_tpu.observability import metrics as _omet
+from chainermn_tpu.observability import tracing as _otrace
 from chainermn_tpu.resilience import faults as _faults
 
 
@@ -110,6 +116,93 @@ class LogReport(Extension):
                 os.makedirs(os.path.dirname(self._out) or ".", exist_ok=True)
                 with open(self._out, "w") as f:
                     json.dump(self.log, f, indent=1)
+
+
+class MetricsReport(Extension):
+    """Observability counterpart of :class:`LogReport`: publishes the
+    newest step metrics into the per-rank registry, writes a per-rank
+    JSONL feed, and (collectively) ships the same entry to rank 0's
+    merged feed over the host object plane.
+
+    Where :class:`LogReport` prints rank-0 interval means and discards the
+    rest, this extension keeps every rank's view: each tick it
+
+    1. converts the trainer's newest metrics to floats (at the trigger
+       interval only — the hot loop never syncs on metric values, same
+       policy as LogReport) and sets them as ``train.<name>`` gauges;
+    2. takes a stamped registry sample (the flight recorder's last-K ring);
+    3. appends ``{"step", "rank", "metrics", "registry"}`` to
+       ``<out_dir>/metrics.rank<R>.jsonl``;
+    4. with a communicator, gathers every rank's entry to rank 0, which
+       appends one merged line to ``<out_dir>/metrics.merged.jsonl``
+       (``per_rank`` carries each entry verbatim — byte-comparable with
+       the per-rank feeds) and optionally a Prometheus textfile
+       (see :class:`~chainermn_tpu.observability.MetricsAggregator`).
+
+    The gather is a collective: attach with the same ``trigger`` on every
+    rank (interval triggers fire at identical iterations by construction).
+    ``CMN_OBS=0`` turns the whole extension into a no-op — set it for the
+    *job*, never for a subset of ranks, or the enabled ranks block in a
+    gather the disabled ones skip.
+    """
+
+    def __init__(self, comm=None, trigger=(10, "iteration"),
+                 out_dir: str = "obs", prometheus: bool = False,
+                 aggregate: bool = True):
+        super().__init__(self._fire, trigger=trigger, name="MetricsReport")
+        self.comm = comm
+        self.out_dir = out_dir
+        self._rank = int(getattr(comm, "rank", 0)) if comm is not None \
+            else int(jax.process_index())
+        self._agg = (
+            _oagg.MetricsAggregator(comm, out_dir=out_dir,
+                                    prometheus=prometheus)
+            if aggregate else None
+        )
+        self._last_step: Optional[int] = None
+
+    @property
+    def rank_path(self) -> str:
+        return os.path.join(self.out_dir, f"metrics.rank{self._rank}.jsonl")
+
+    def _fire(self, trainer: "Trainer"):
+        if not _obs.enabled():
+            return
+        it = int(trainer.iteration)
+        if it == self._last_step:  # finalize after an on-trigger last step
+            return
+        self._last_step = it
+        means = {}
+        if trainer.last_metrics is not None:
+            for k, v in trainer.last_metrics.items():
+                try:
+                    means[k] = float(np.asarray(v))
+                except (TypeError, ValueError):
+                    continue
+        reg = _omet.registry()
+        for k, v in means.items():
+            reg.gauge(f"train.{k}").set(v)
+        sample = reg.sample(it)
+        entry = {
+            "step": it,
+            "rank": self._rank,
+            "metrics": means,
+            "registry": sample["metrics"],
+        }
+        os.makedirs(self.out_dir, exist_ok=True)
+        # Same strict-JSON sanitization the merged-feed writer applies
+        # (non-finite → null), keeping the two feeds verbatim-comparable
+        # even on NaN-loss steps.
+        with open(self.rank_path, "a") as f:
+            f.write(json.dumps(_oagg.sanitize_json(entry)) + "\n")
+        if self._agg is not None:
+            self._agg.collect(it, entry)
+
+    def finalize(self, trainer: "Trainer"):
+        """Flush a final tick so a stop between triggers still lands the
+        closing window (skipped when the last iteration already fired —
+        a duplicate step would desync feed consumers)."""
+        self._fire(trainer)
 
 
 class PrintReport(Extension):
@@ -278,6 +371,24 @@ class Trainer:
         self._fault_injector = _faults.process_injector()
         self.iteration = 0
         self._observations: List[dict] = []
+        #: Newest step's raw metrics dict (device arrays — no host sync);
+        #: what MetricsReport converts at ITS cadence without consuming
+        #: the LogReport observation window.
+        self.last_metrics: Optional[dict] = None
+        # Per-step observability publishers, resolved once (default-on,
+        # CMN_OBS=0 removes even the instrument lookups): a host-side
+        # counter + step-time histogram per iteration, nothing that could
+        # sync the device stream.
+        self._obs_on = _obs.enabled()
+        if self._obs_on:
+            _reg = _omet.registry()
+            self._obs_iterations = _reg.counter("train.iterations")
+            self._obs_step_ms = _reg.histogram("train.step_ms")
+        # Arm the flight recorder (installs the SIGUSR1 live-snapshot
+        # handler) UNGATED by CMN_OBS: the recorder is governed by its own
+        # knobs (CMN_OBS_FLIGHT_DIR / CMN_OBS_FLIGHT), matching the
+        # crash path, which builds it lazily regardless of CMN_OBS.
+        _oflight.recorder()
         # Bind LAST: the guard merges its in-graph kwargs into step_kwargs
         # and seeds state.health on the state set above.
         self.health_guard = health_guard
@@ -309,10 +420,15 @@ class Trainer:
                 # poison THIS iteration's batch (counted 1-based like the
                 # iter site).
                 batch = _faults.poison_batch(inj, batch, self.iteration + 1)
-            self.state, metrics = self.optimizer.update(
-                self.state, batch, self.loss_fn, has_aux=self.has_aux,
-                stateful=self.stateful, **self.step_kwargs,
-            )
+            # Host-side profiler annotation around the step dispatch: an
+            # xprof capture lines its device stream up with these step
+            # numbers (and with the host spans in the ring).
+            with (_otrace.step_annotation(self.iteration + 1)
+                  if self._obs_on else contextlib.nullcontext()):
+                self.state, metrics = self.optimizer.update(
+                    self.state, batch, self.loss_fn, has_aux=self.has_aux,
+                    stateful=self.stateful, **self.step_kwargs,
+                )
             self.iteration += 1
             if inj is not None:
                 # Fail-silent injection, post-step: flip@param corrupts the
@@ -325,6 +441,12 @@ class Trainer:
                 inj.hook("step", count=self.iteration)
             # Keep raw device arrays — no host sync on the hot path.
             self._observations.append(dict(metrics))
+            self.last_metrics = dict(metrics)
+            if self._obs_on:
+                self._obs_iterations.inc()
+                self._obs_step_ms.observe(
+                    (time.perf_counter() - t0) * 1000.0
+                )
             for ext in self.extensions:
                 if ext.should_fire(self):
                     ext(self)

@@ -26,6 +26,7 @@ BENCHES = {
     "longcontext": ["benchmarks/longcontext.py", "--smoke"],
     "memory_fitprobe": ["benchmarks/memory.py", "--smoke", "--fitprobe",
                         "--allow-cpu"],
+    "observability": ["benchmarks/observability.py", "--smoke"],
 }
 
 

@@ -127,6 +127,10 @@ def launch_job(tmp_path):
                 "PYTHONPATH": REPO,
                 "JAX_PLATFORMS": "cpu",
                 "CMN_TEST_TMP": str(tmp_path),
+                # Flight records (observability/flight.py) land in the
+                # test tmp dir, not the launcher's repo-relative default
+                # — a preemption/crash test must not litter the repo.
+                "CMN_OBS_FLIGHT_DIR": str(tmp_path / "flight"),
             }
         )
         env.update(extra_env or {})

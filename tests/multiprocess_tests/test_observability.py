@@ -1,0 +1,129 @@
+"""Observability acceptance: real-OS-rank aggregation + dead-rank records.
+
+Two contracts from ISSUE 4:
+
+1. **Aggregation exactness** — in a clean 2-process run, rank 0's merged
+   JSONL feed carries every rank's per-step entry VERBATIM (field-for-
+   field equal to the per-rank files each rank wrote locally), and the
+   merged registry fold is the exact sum of the per-rank snapshots.
+2. **Dead-rank flight record** — a rank killed mid-run from inside a
+   host-plane send (``crash@send:N``, the injected crash firing inside
+   the op's span) leaves a parseable flight record NAMING that in-flight
+   op, written through the global except hook before teardown.
+"""
+
+import json
+import os
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_HERE, "worker_observability.py")
+
+pytestmark = pytest.mark.resilience
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _verdicts(tmp_path, n):
+    out = []
+    for pid in range(n):
+        with open(tmp_path / f"verdict_{pid}.json") as f:
+            out.append(json.load(f))
+    return out
+
+
+def test_rank0_aggregation_matches_per_rank_feeds(launch_job, tmp_path):
+    job = launch_job(WORKER, nproc=2, timeout=420,
+                     extra_env={"CMN_OBSW_STOP": "6", "CMN_OBSW_EVERY": "2"})
+    assert job.returncode == 0, job.tail()
+    v0, v1 = _verdicts(tmp_path, 2)
+    assert v0["status"] == "ok" and v1["status"] == "ok"
+
+    obs_dir = tmp_path / "obs"
+    rank_feeds = {
+        r: _read_jsonl(obs_dir / f"metrics.rank{r}.jsonl") for r in (0, 1)
+    }
+    merged = _read_jsonl(obs_dir / "metrics.merged.jsonl")
+    assert merged, "rank 0 wrote no merged feed"
+    # Cadence 2 over 6 iterations -> steps 2, 4, 6 on every feed.
+    assert [m["step"] for m in merged] == [2, 4, 6]
+    for r in (0, 1):
+        assert [e["step"] for e in rank_feeds[r]] == [2, 4, 6]
+
+    for i, line in enumerate(merged):
+        assert line["nranks"] == 2
+        for r in (0, 1):
+            # THE acceptance property: the merged feed's per_rank entry is
+            # the per-rank file's line, exactly.
+            assert line["per_rank"][str(r)] == rank_feeds[r][i], (
+                f"step {line['step']}: merged per_rank[{r}] diverges from "
+                f"rank {r}'s local feed"
+            )
+        # Exact registry fold: counters sum across ranks.
+        per_rank_iters = [
+            line["per_rank"][str(r)]["registry"]["train.iterations"]["value"]
+            for r in (0, 1)
+        ]
+        assert line["merged"]["train.iterations"]["value"] == \
+            sum(per_rank_iters)
+        # Histogram merge stayed exact (counts sum bucketwise).
+        h = line["merged"]["train.step_ms"]
+        assert h["count"] == sum(
+            line["per_rank"][str(r)]["registry"]["train.step_ms"]["count"]
+            for r in (0, 1)
+        )
+        assert sum(h["counts"]) == h["count"]
+
+    # The host object plane got traced: the aggregation gather itself
+    # leaves send/recv spans in the registry of every rank.
+    assert any(
+        k.startswith("host_op.send_obj") or k.startswith("host_op.recv_obj")
+        for k in v1["hostcomm_ops_traced"]
+    ), v1["hostcomm_ops_traced"]
+    # rank 0 also rendered the Prometheus textfile.
+    assert (obs_dir / "metrics.prom").exists()
+
+
+def test_killed_rank_leaves_flight_record_naming_inflight_op(
+        launch_job, tmp_path):
+    flight_dir = tmp_path / "flight"
+    job = launch_job(
+        WORKER, nproc=2, timeout=420,
+        extra_env={
+            "CMN_OBSW_STOP": "8", "CMN_OBSW_EVERY": "2",
+            # Crash rank 1 from INSIDE its 3rd host-plane send: the
+            # InjectedFault fires within the op's span, the except hook
+            # snapshots before teardown — the "rank killed mid-step"
+            # post-mortem path.
+            "CMN_FAULT": "crash@send:3",
+            "CMN_FAULT_RANK": "1",
+            "CMN_OBS_FLIGHT_DIR": str(flight_dir),
+        },
+    )
+    assert job.returncode != 0, "the injected crash must fail the job"
+
+    record_path = flight_dir / "flight.rank1.jsonl"
+    assert record_path.exists(), (
+        f"dead rank left no flight record; log tail: {job.tail()}"
+    )
+    records = _read_jsonl(record_path)
+    assert records, "flight record file exists but holds no records"
+    entry = records[-1]
+    assert entry["schema"] == "cmn-flight-1"
+    assert entry["reason"] == "crash"
+    assert entry["rank"] == 1
+    assert entry["error"]["type"] == "InjectedFault"
+    # The record NAMES the op the rank died inside.
+    assert entry["in_flight_span"] == "send_obj", entry["in_flight_span"]
+    assert entry["last_error_span"]["op"] == "send_obj"
+    assert entry["last_error_span"]["ok"] is False
+    # The span ring carried history, bounded.
+    assert entry["spans"], "span ring empty in the flight record"
+    assert entry["spans_evicted"] >= 0
+    # The surviving rank was torn down by the launcher (no deadlock) and
+    # the launcher pointed at the flight records.
+    assert "flight records" in job.log

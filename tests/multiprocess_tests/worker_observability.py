@@ -1,0 +1,103 @@
+"""Worker for the observability acceptance tests (real OS ranks).
+
+Trains a small DP MLP with a :class:`MetricsReport` extension aggregating
+to rank 0 over the host object plane.  The test drives it through env:
+
+* ``CMN_OBSW_STOP`` / ``CMN_OBSW_EVERY`` — loop geometry / report cadence.
+* ``CMN_FAULT=crash@send:N`` (+ ``CMN_FAULT_RANK``) — kill one rank from
+  INSIDE a host-plane send (the injected crash fires inside the op's
+  span), so the test can assert the dead rank's flight record names the
+  in-flight op.  ``CMN_OBS_FLIGHT_DIR`` comes from the launcher.
+
+Writes one verdict JSON per rank with the observability artifact paths.
+"""
+
+import json
+import os
+import sys
+import traceback
+
+import numpy as np
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid}
+
+    import optax
+
+    from chainermn_tpu.datasets import make_synthetic_classification
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.training import MetricsReport, Trainer
+
+    stop = int(os.environ.get("CMN_OBSW_STOP", "6"))
+    every = int(os.environ.get("CMN_OBSW_EVERY", "2"))
+    obs_dir = os.path.join(os.environ["CMN_TEST_TMP"], "obs")
+
+    comm = cmn.create_communicator("flat")
+    ds = cmn.scatter_dataset(
+        make_synthetic_classification(384, 8, 4, seed=9), comm,
+        shuffle=True, seed=4,
+    )
+    model = MLP(hidden=(8,), n_out=4)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))[
+        "params"
+    ]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    it = SerialIterator(ds, 32, shuffle=True, seed=2)
+
+    report = MetricsReport(
+        comm=comm, trigger=(every, "iteration"), out_dir=obs_dir,
+        prometheus=(pid == 0),
+    )
+    trainer = Trainer(
+        opt, opt.init(params), classification_loss(model), it,
+        stop=(stop, "iteration"), has_aux=True, extensions=[report],
+    )
+    trainer.run()
+
+    out["final_iteration"] = trainer.iteration
+    out["rank_feed"] = report.rank_path
+    out["merged_feed"] = os.path.join(obs_dir, "metrics.merged.jsonl")
+    out["flight_dir"] = os.environ.get("CMN_OBS_FLIGHT_DIR", "")
+    # A few registry facts the test can cross-check against the feeds.
+    from chainermn_tpu.observability import registry
+
+    snap = registry().snapshot()
+    out["train_iterations"] = snap["train.iterations"]["value"]
+    out["hostcomm_ops_traced"] = sorted(
+        k for k in snap if k.startswith("host_op.")
+    )
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    result_path = os.path.join(
+        os.environ["CMN_TEST_TMP"],
+        f"verdict_{os.environ['CMN_PROCESS_ID']}.json",
+    )
+    try:
+        verdict = main()
+    except BaseException:
+        # Record the verdict for the test, then RE-RAISE: the uncaught
+        # exception must reach the global except hook — that is the path
+        # that writes the flight record and hard-exits past jax's atexit
+        # shutdown barrier (a swallowed crash here would leave this rank
+        # hanging in atexit against its blocked peer, recordless).
+        with open(result_path, "w") as f:
+            json.dump(
+                {"status": "fail", "traceback": traceback.format_exc()}, f
+            )
+        raise
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)

@@ -1,0 +1,166 @@
+"""Flight-record schema round-trip + the crash/exit snapshot paths.
+
+A flight record is only useful if a post-mortem six months later can
+parse it blind: every record must be one self-contained JSON line with
+the schema tag, the in-flight span naming, the bounded span/metric
+history, and the registered resilience sections — asserted here by
+writing records through every entry point and reading them back cold.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from chainermn_tpu.observability import FLIGHT_SCHEMA, FlightRecorder
+from chainermn_tpu.observability import flight as oflight
+from chainermn_tpu.observability import tracing as otrace
+from chainermn_tpu.resilience import PeerFailedError, RankDivergedError
+from chainermn_tpu.resilience.guard import HealthEscalationInterrupt
+from chainermn_tpu.resilience.preemption import PreemptionInterrupt
+
+pytestmark = pytest.mark.tier1
+
+
+def _read_records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_record_schema_round_trip(tmp_path):
+    rec = FlightRecorder(str(tmp_path), rank=3)
+    path = rec.record("sigusr1", extra={"note": "hello"})
+    assert path == str(tmp_path / "flight.rank3.jsonl")
+    (entry,) = _read_records(path)
+    assert entry["schema"] == FLIGHT_SCHEMA
+    assert entry["reason"] == "sigusr1"
+    assert entry["rank"] == 3
+    assert entry["pid"] == os.getpid()
+    for key in ("wall_time", "in_flight_span", "open_spans", "spans",
+                "spans_evicted", "metrics", "metric_samples", "resilience"):
+        assert key in entry
+    assert entry["extra"] == {"note": "hello"}
+
+
+def test_records_append_as_jsonl(tmp_path):
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    rec.record("one")
+    rec.record("two")
+    entries = _read_records(rec.path)
+    assert [e["reason"] for e in entries] == ["one", "two"]
+
+
+def test_attributed_error_lifted_into_record(tmp_path):
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    err = PeerFailedError(2, op="bcast_obj", rank=0,
+                          reason="no heartbeat", kind="dead")
+    rec.record("peer_failed", exc=err)
+    (entry,) = _read_records(rec.path)
+    e = entry["error"]
+    assert e["type"] == "PeerFailedError"
+    assert e["peer"] == 2 and e["op"] == "bcast_obj" and e["kind"] == "dead"
+
+
+def test_in_flight_span_named_while_open(tmp_path, monkeypatch):
+    # A record taken while an op is OPEN (SIGUSR1 on a blocked rank)
+    # names it directly; one taken after an errored unwind (the crash
+    # path) falls back to the last errored span.
+    tr = otrace.tracer()
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    with tr.span("allgather_obj"):
+        rec.record("sigusr1")
+    entries = _read_records(rec.path)
+    assert entries[0]["in_flight_span"] == "allgather_obj"
+    assert "allgather_obj" in [s["op"] for s in entries[0]["open_spans"]]
+
+
+def test_provider_sections_and_provider_errors(tmp_path):
+    oflight.register_provider("good", lambda: {"ok": 1})
+    oflight.register_provider("bad", lambda: 1 / 0)
+    try:
+        rec = FlightRecorder(str(tmp_path), rank=0)
+        rec.record("crash")
+        (entry,) = _read_records(rec.path)
+        assert entry["resilience"]["good"] == {"ok": 1}
+        assert "ZeroDivisionError" in entry["resilience"]["bad"]["error"]
+    finally:
+        with oflight._providers_lock:
+            oflight._providers.pop("good", None)
+            oflight._providers.pop("bad", None)
+
+
+def test_env_recorder_and_sigusr1(tmp_path, monkeypatch):
+    monkeypatch.setenv("CMN_OBS_FLIGHT_DIR", str(tmp_path))
+    oflight._reset_for_tests()
+    try:
+        rec = oflight.recorder()
+        assert rec is not None and rec.directory == str(tmp_path)
+        # SIGUSR1 handler installed as a side effect: poking ourselves
+        # must append a record without killing the process.  The write
+        # happens on a spawned thread (the handler itself must not take
+        # registry/tracer locks on the interrupted thread) — poll.
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        entries = []
+        while time.monotonic() < deadline and not entries:
+            if os.path.exists(rec.path):
+                entries = _read_records(rec.path)
+            time.sleep(0.02)
+        assert entries and entries[-1]["reason"] == "sigusr1"
+    finally:
+        oflight._reset_for_tests()
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+def test_env_recorder_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("CMN_OBS_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("CMN_OBS_FLIGHT", "0")
+    oflight._reset_for_tests()
+    try:
+        assert oflight.recorder() is None
+    finally:
+        oflight._reset_for_tests()
+
+
+@pytest.mark.parametrize("exc,reason", [
+    (PeerFailedError(1, op="recv_obj"), "peer_failed"),
+    (RankDivergedError([1], 5), "rank_diverged"),
+    (PreemptionInterrupt(7), "preemption_exit"),
+    (HealthEscalationInterrupt("skip budget", 9), "health_escalation_exit"),
+    (RuntimeError("anything"), "crash"),
+])
+def test_snapshot_on_crash_reason_taxonomy(tmp_path, monkeypatch,
+                                           exc, reason):
+    monkeypatch.setenv("CMN_OBS_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.delenv("CMN_OBS_FLIGHT", raising=False)
+    oflight._reset_for_tests()
+    try:
+        path = oflight.snapshot_on_crash(exc)
+        assert path is not None
+        entry = _read_records(path)[-1]
+        assert entry["reason"] == reason
+        assert entry["error"]["type"] == type(exc).__name__
+    finally:
+        oflight._reset_for_tests()
+
+
+def test_snapshot_on_crash_dormant_without_env(monkeypatch):
+    monkeypatch.delenv("CMN_OBS_FLIGHT_DIR", raising=False)
+    oflight._reset_for_tests()
+    try:
+        assert oflight.snapshot_on_crash(RuntimeError("x")) is None
+    finally:
+        oflight._reset_for_tests()
+
+
+def test_record_survives_unserializable_extra(tmp_path):
+    class Weird:
+        def __repr__(self):
+            return "<weird>"
+
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    assert rec.record("crash", extra={"obj": Weird()}) is not None
+    (entry,) = _read_records(rec.path)
+    assert entry["extra"]["obj"] == "<weird>"

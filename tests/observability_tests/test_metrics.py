@@ -1,0 +1,165 @@
+"""Metrics registry semantics + EXACT cross-rank histogram merge.
+
+The merge-exactness property is the registry's load-bearing design choice
+(fixed bucket edges, no sketches): merging per-rank snapshots must equal
+the histogram a single observer of every value would have built — bucket
+by bucket, not approximately.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.observability import (
+    MetricsRegistry,
+    merge_snapshots,
+    render_prometheus,
+)
+from chainermn_tpu.observability.metrics import DEFAULT_MS_EDGES
+
+pytestmark = pytest.mark.tier1
+
+
+# ------------------------------------------------------------ instruments
+def test_counter_accumulates_and_rejects_negative():
+    reg = MetricsRegistry()
+    c = reg.counter("x")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match="negative"):
+        c.inc(-1)
+    assert reg.counter("x") is c  # same name -> same instrument
+
+
+def test_gauge_holds_last_value():
+    reg = MetricsRegistry()
+    g = reg.gauge("loss")
+    assert g.value is None
+    g.set(2.0)
+    g.set(1.5)
+    assert g.value == 1.5
+
+
+def test_histogram_buckets_sum_count_min_max():
+    reg = MetricsRegistry()
+    h = reg.histogram("ms", edges=(1.0, 10.0))
+    for v in (0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    d = h.to_dict()
+    # v <= edge goes to that edge's bucket; > last edge overflows.
+    assert d["counts"] == [2, 1, 1]
+    assert d["count"] == 4
+    assert d["sum"] == pytest.approx(106.5)
+    assert d["min"] == 0.5 and d["max"] == 100.0
+
+
+def test_type_and_edge_conflicts_raise():
+    reg = MetricsRegistry()
+    reg.counter("a")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("a")
+    reg.histogram("h", edges=(1.0, 2.0))
+    with pytest.raises(ValueError, match="edges"):
+        reg.histogram("h", edges=(1.0, 3.0))
+
+
+def test_bad_edges_rejected():
+    reg = MetricsRegistry()
+    for bad in ((), (2.0, 1.0), (1.0, 1.0)):
+        with pytest.raises(ValueError):
+            reg.histogram(f"h{bad}", edges=bad)
+
+
+# --------------------------------------------------------------- snapshots
+def test_snapshot_is_json_serializable():
+    reg = MetricsRegistry()
+    reg.counter("c").inc(2)
+    reg.gauge("g").set(0.25)
+    reg.histogram("h").observe(3.0)
+    snap = reg.snapshot()
+    round_trip = json.loads(json.dumps(snap))
+    assert round_trip["c"]["value"] == 2
+    assert round_trip["h"]["count"] == 1
+
+
+def test_sample_ring_is_bounded():
+    reg = MetricsRegistry(sample_capacity=3)
+    reg.counter("c")
+    for step in range(10):
+        reg.sample(step)
+    samples = reg.last_samples()
+    assert [s["step"] for s in samples] == [7, 8, 9]
+
+
+# ------------------------------------------------------------------- merge
+def test_histogram_merge_is_exact():
+    """The headline property: per-rank merge == single global observer."""
+    rng = np.random.RandomState(7)
+    values = rng.lognormal(mean=1.0, sigma=2.0, size=400)
+    # One reference registry sees everything; 4 "ranks" see a partition.
+    ref = MetricsRegistry()
+    href = ref.histogram("step_ms")
+    ranks = [MetricsRegistry() for _ in range(4)]
+    for i, v in enumerate(values):
+        href.observe(v)
+        ranks[i % 4].histogram("step_ms").observe(v)
+    merged = merge_snapshots([r.snapshot() for r in ranks])
+    want = ref.snapshot()["step_ms"]
+    got = merged["step_ms"]
+    assert got["counts"] == want["counts"]  # exact, bucket by bucket
+    assert got["count"] == want["count"]
+    assert got["sum"] == pytest.approx(want["sum"], rel=1e-12)
+    assert got["min"] == want["min"] and got["max"] == want["max"]
+
+
+def test_counter_merge_sums_and_gauge_merge_keeps_per_rank():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("ops").inc(3)
+    b.counter("ops").inc(4)
+    a.gauge("loss").set(1.0)
+    b.gauge("loss").set(3.0)
+    m = merge_snapshots([a.snapshot(), b.snapshot()])
+    assert m["ops"]["value"] == 7
+    assert m["loss"]["per_rank"] == [1.0, 3.0]
+    assert m["loss"]["mean"] == 2.0
+    assert m["loss"]["min"] == 1.0 and m["loss"]["max"] == 3.0
+
+
+def test_merge_rejects_mismatched_edges_and_types():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("h", edges=(1.0,)).observe(0.5)
+    b.histogram("h", edges=(2.0,)).observe(0.5)
+    with pytest.raises(ValueError, match="edges differ"):
+        merge_snapshots([a.snapshot(), b.snapshot()])
+    c, d = MetricsRegistry(), MetricsRegistry()
+    c.counter("m").inc()
+    d.gauge("m").set(1)
+    with pytest.raises(ValueError, match="type mismatch"):
+        merge_snapshots([c.snapshot(), d.snapshot()])
+
+
+def test_merge_handles_disjoint_names():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("only_a").inc()
+    b.counter("only_b").inc(2)
+    m = merge_snapshots([a.snapshot(), b.snapshot()])
+    assert m["only_a"]["value"] == 1 and m["only_b"]["value"] == 2
+
+
+# -------------------------------------------------------------- prometheus
+def test_prometheus_rendering_cumulative_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("op.ms", edges=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    reg.counter("ops.total").inc(3)
+    text = render_prometheus(merge_snapshots([reg.snapshot()]))
+    lines = text.splitlines()
+    assert 'cmn_op_ms_bucket{le="1"} 1' in lines
+    assert 'cmn_op_ms_bucket{le="10"} 2' in lines
+    assert 'cmn_op_ms_bucket{le="+Inf"} 3' in lines
+    assert "cmn_op_ms_count 3" in lines
+    assert "cmn_ops_total 3" in lines
+    assert DEFAULT_MS_EDGES == tuple(sorted(set(DEFAULT_MS_EDGES)))

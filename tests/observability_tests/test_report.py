@@ -1,0 +1,151 @@
+"""MetricsReport + rank-0 aggregation, single-process.
+
+The per-rank feed / merged feed contract (``per_rank`` carries each
+rank's entry verbatim) is asserted here on the degenerate 1-rank mesh;
+the real multi-rank version (plus the killed-rank flight record) lives in
+``tests/multiprocess_tests/test_observability.py``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+import chainermn_tpu as cmn
+from chainermn_tpu import observability as obs
+from chainermn_tpu.datasets import make_synthetic_classification
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models import MLP, classification_loss
+from chainermn_tpu.observability.aggregate import render_prometheus
+from chainermn_tpu.training import MetricsReport, Trainer
+
+pytestmark = pytest.mark.tier1
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry(monkeypatch):
+    """The process registry is a singleton by design; tests isolate it so
+    one test's train.iterations can't leak into another's assertion."""
+    from chainermn_tpu.observability import metrics as omet
+
+    monkeypatch.setattr(omet, "_registry", omet.MetricsRegistry())
+
+
+def _train(tmp_path, n_iter=5, trigger=2, prometheus=False,
+           extensions=()):
+    comm = cmn.create_communicator("flat")
+    ds = cmn.scatter_dataset(
+        make_synthetic_classification(64, 8, 4, seed=9), comm
+    )
+    model = MLP(hidden=(8,), n_out=4)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.float32)
+    )["params"]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    it = SerialIterator(ds, 16, shuffle=True, seed=2)
+    report = MetricsReport(
+        comm=comm, trigger=(trigger, "iteration"), out_dir=str(tmp_path),
+        prometheus=prometheus,
+    )
+    trainer = Trainer(
+        opt, opt.init(params), classification_loss(model), it,
+        stop=(n_iter, "iteration"), has_aux=True,
+        extensions=[report, *extensions],
+    )
+    trainer.run()
+    return report, trainer
+
+
+def _lines(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_per_rank_feed_and_merged_feed_match(tmp_path):
+    report, trainer = _train(tmp_path, n_iter=5, trigger=2)
+    per_rank = _lines(report.rank_path)
+    merged = _lines(os.path.join(str(tmp_path), "metrics.merged.jsonl"))
+    # Trigger at 2/4, finalize flushes the stopping iteration 5.
+    assert [e["step"] for e in per_rank] == [2, 4, 5]
+    assert [m["step"] for m in merged] == [2, 4, 5]
+    for entry, line in zip(per_rank, merged):
+        # The merged feed's per_rank section carries the rank entry
+        # VERBATIM — the cross-checkable post-mortem contract.
+        assert line["per_rank"]["0"] == entry
+        assert line["nranks"] == 1
+        assert entry["rank"] == 0
+        assert "loss" in entry["metrics"]
+        # The registry snapshot rode along and merged exactly.
+        assert line["merged"]["train.iterations"]["value"] == \
+            entry["registry"]["train.iterations"]["value"]
+
+
+def test_registry_carries_trainer_instruments(tmp_path):
+    report, trainer = _train(tmp_path, n_iter=4, trigger=2)
+    last = _lines(report.rank_path)[-1]["registry"]
+    assert last["train.iterations"]["value"] == 4
+    assert last["train.step_ms"]["count"] == 4
+    assert last["train.loss"]["type"] == "gauge"
+    assert last["train.loss"]["value"] is not None
+
+
+def test_no_duplicate_final_tick_when_trigger_lands_on_stop(tmp_path):
+    report, _ = _train(tmp_path, n_iter=4, trigger=2)
+    steps = [e["step"] for e in _lines(report.rank_path)]
+    assert steps == [2, 4]  # finalize did NOT re-emit step 4
+
+
+def test_prometheus_textfile_written_atomically(tmp_path):
+    _train(tmp_path, n_iter=4, trigger=2, prometheus=True)
+    text = open(os.path.join(str(tmp_path), "metrics.prom")).read()
+    assert "cmn_train_iterations" in text
+    assert "cmn_train_step_ms_bucket" in text
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "metrics.prom.tmp")
+    )
+
+
+def test_disabled_observability_is_a_noop(tmp_path):
+    obs.set_enabled(False)
+    try:
+        report, trainer = _train(tmp_path, n_iter=4, trigger=2)
+        assert not os.path.exists(report.rank_path)
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "metrics.merged.jsonl")
+        )
+        # The trainer ran fine without any publisher attached.
+        assert trainer.iteration == 4
+    finally:
+        obs.set_enabled(None)
+
+
+def test_nan_metrics_keep_feeds_strict_json(tmp_path):
+    """A NaN loss (the guard's whole scenario) must not crash the report
+    tick or emit non-strict JSON — feeds stay parseable by jq-class
+    consumers, Prometheus gets its literal NaN."""
+    from chainermn_tpu.observability import metrics as omet
+
+    omet.registry().gauge("train.poisoned").set(float("nan"))
+    omet.registry().gauge("train.blown").set(float("inf"))
+    report, _ = _train(tmp_path, n_iter=4, trigger=2, prometheus=True)
+    for path in (report.rank_path,
+                 os.path.join(str(tmp_path), "metrics.merged.jsonl")):
+        raw = open(path).read()
+        assert "NaN" not in raw and "Infinity" not in raw
+        for line in raw.splitlines():
+            json.loads(line)  # strict enough: no literal tokens present
+    merged = _lines(os.path.join(str(tmp_path), "metrics.merged.jsonl"))
+    assert merged[-1]["merged"]["train.poisoned"]["per_rank"] == [None]
+    text = open(os.path.join(str(tmp_path), "metrics.prom")).read()
+    assert 'cmn_train_blown{stat="min"} +Inf' in text
+
+
+def test_render_prometheus_on_merged_feed_line(tmp_path):
+    report, _ = _train(tmp_path, n_iter=4, trigger=2)
+    merged = _lines(os.path.join(str(tmp_path), "metrics.merged.jsonl"))
+    text = render_prometheus(merged[-1]["merged"])
+    assert "cmn_train_loss" in text

@@ -1,0 +1,138 @@
+"""fused_decode_attention vs the einsum oracle (Pallas interpret on CPU).
+
+The oracle is the math the TransformerLM decode branch runs — fp32
+score/softmax/value einsums with the length-bound mask — written directly
+over the kernel's kv-head-major (B, KH, L, Dh) layout.  Covers MHA, GQA
+grouping, ragged ``valid_len`` rows, the int8 cache with per-(position,
+kv-head) scales, and the argument-validation contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.ops import fused_decode_attention
+
+pytestmark = pytest.mark.tier1  # small shapes; interpret mode is fast here
+
+
+def _oracle(q, kc, vc, valid_len, k_scale=None, v_scale=None):
+    """fp32 einsum reference over the kv-head-major cache layout."""
+    B, H, Dh = q.shape
+    _, KH, L, _ = kc.shape
+    G = H // KH
+    qg = np.asarray(q, np.float32).reshape(B, KH, G, Dh) / np.sqrt(Dh)
+    k = np.asarray(kc, np.float32)
+    v = np.asarray(vc, np.float32)
+    s = np.einsum("bhgd,bhld->bhgl", qg, k)
+    if k_scale is not None:
+        s = s * np.asarray(k_scale, np.float32)[:, :, None, :]
+    pos = np.arange(L)[None, None, None, :]
+    mask = pos < np.asarray(valid_len, np.int64)[:, None, None, None]
+    s = np.where(mask, s, -1e30)
+    m = s.max(axis=-1, keepdims=True)
+    p = np.exp(s - m)
+    l = p.sum(axis=-1)
+    if v_scale is not None:
+        p = p * np.asarray(v_scale, np.float32)[:, :, None, :]
+    o = np.einsum("bhgl,bhld->bhgd", p, v) / np.maximum(l, 1e-30)[..., None]
+    return o.reshape(B, H, Dh)
+
+
+def _setup(B=2, H=4, KH=4, L=32, Dh=8, seed=0):
+    rng = np.random.RandomState(seed)
+    q = rng.randn(B, H, Dh).astype(np.float32)
+    kc = rng.randn(B, KH, L, Dh).astype(np.float32)
+    vc = rng.randn(B, KH, L, Dh).astype(np.float32)
+    return q, kc, vc
+
+
+def test_mha_full_length_matches_oracle():
+    q, kc, vc = _setup()
+    valid = np.array([32, 32], np.int32)
+    got = fused_decode_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc), jnp.asarray(valid)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), _oracle(q, kc, vc, valid), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_gqa_grouping_matches_oracle():
+    q, kc, vc = _setup(B=2, H=8, KH=2, L=16, Dh=8, seed=1)
+    valid = np.array([16, 16], np.int32)
+    got = fused_decode_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc), jnp.asarray(valid)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), _oracle(q, kc, vc, valid), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ragged_valid_len_masks_tail():
+    q, kc, vc = _setup(B=3, H=4, KH=4, L=24, Dh=8, seed=2)
+    valid = np.array([24, 7, 1], np.int32)
+    got = np.asarray(fused_decode_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc), jnp.asarray(valid)
+    ))
+    np.testing.assert_allclose(
+        got, _oracle(q, kc, vc, valid), rtol=1e-5, atol=1e-5
+    )
+    # The masked tail must be INERT: corrupting positions >= valid_len
+    # cannot change the output (the real ragged-row guarantee, not just
+    # agreement-on-this-sample).
+    kc2, vc2 = kc.copy(), vc.copy()
+    kc2[1, :, 7:, :] = 1e3
+    vc2[1, :, 7:, :] = -1e3
+    got2 = np.asarray(fused_decode_attention(
+        jnp.asarray(q), jnp.asarray(kc2), jnp.asarray(vc2),
+        jnp.asarray(valid)
+    ))
+    np.testing.assert_allclose(got2[1], got[1], rtol=1e-6, atol=1e-6)
+
+
+def test_int8_cache_matches_dequantized_oracle():
+    q, kc, vc = _setup(B=2, H=4, KH=2, L=16, Dh=8, seed=3)
+    q = q.astype(np.float32)
+    # Symmetric absmax per (b, kh, l) row — the kv-quant cache contract.
+    k_scale = (np.abs(kc).max(axis=-1) / 127.0 + 1e-8).astype(np.float32)
+    v_scale = (np.abs(vc).max(axis=-1) / 127.0 + 1e-8).astype(np.float32)
+    k8 = np.clip(np.round(kc / k_scale[..., None]), -127, 127)
+    v8 = np.clip(np.round(vc / v_scale[..., None]), -127, 127)
+    valid = np.array([16, 11], np.int32)
+    got = fused_decode_attention(
+        jnp.asarray(q), jnp.asarray(k8, np.int8), jnp.asarray(v8, np.int8),
+        jnp.asarray(valid), k_scale=jnp.asarray(k_scale),
+        v_scale=jnp.asarray(v_scale),
+    )
+    # Oracle over the int8 codes with the scales folded exactly where the
+    # kernel folds them (k scale on scores, v scale on probabilities).
+    want = _oracle(q, k8, v8, valid, k_scale=k_scale, v_scale=v_scale)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_output_dtype_follows_query():
+    q, kc, vc = _setup(B=1, H=2, KH=2, L=8, Dh=8, seed=4)
+    got = fused_decode_attention(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(kc, jnp.bfloat16),
+        jnp.asarray(vc, jnp.bfloat16), jnp.asarray([8], jnp.int32)
+    )
+    assert got.dtype == jnp.bfloat16
+    assert got.shape == (1, 2, 8)
+
+
+def test_validation_errors():
+    q, kc, vc = _setup(B=1, H=3, KH=2, L=8, Dh=8, seed=5)
+    with pytest.raises(ValueError, match="multiple of KH"):
+        fused_decode_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray([8], jnp.int32)
+        )
+    q, kc, vc = _setup(B=1, H=2, KH=2, L=8, Dh=8, seed=6)
+    with pytest.raises(ValueError, match="int8 cache needs"):
+        fused_decode_attention(
+            jnp.asarray(q), jnp.asarray(kc, jnp.int8),
+            jnp.asarray(vc, jnp.int8), jnp.asarray([8], jnp.int32)
+        )

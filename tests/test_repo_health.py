@@ -57,6 +57,7 @@ def test_every_pycache_has_adjacent_sources():
 _TIERED_DIRS = (
     os.path.join("tests", "models_tests"),
     os.path.join("tests", "ops_tests"),
+    os.path.join("tests", "observability_tests"),
 )
 def test_long_pole_dirs_declare_test_tiers():
     undeclared = []
