@@ -1,0 +1,401 @@
+"""Serving throughput: static batching vs continuous batching.
+
+Mixed-length traffic is where static batching loses: ``lm_generate`` pads
+every member of a batch to the longest prompt and decodes until the
+longest ``max_new_tokens``, so short requests burn chip time generating
+tokens nobody asked for, and the whole batch holds its slots until the
+straggler finishes.  The continuous-batching arm streams the same
+requests through the fixed-shape paged-KV engine
+(``chainermn_tpu/serving``): a slot is recycled the moment its request
+completes, so the device only ever decodes requested tokens.
+
+Traffic model: open-loop Poisson arrivals; prompt lengths and
+``max_new_tokens`` drawn per request from ranges wide enough that a
+static batch's padded work is a multi-x of the useful work.  Both arms
+see the identical request list and arrival times.  Reported tokens/sec
+counts USEFUL tokens only (each request's own ``max_new_tokens``) over
+the arm's makespan; per-token latency is a request's
+(completion - arrival) / generated tokens, reported at p50/p95.
+
+The static arm's wall clock is assembled from real measured batch service
+times on a simulated arrival clock (batch i starts when its last member
+has arrived and batch i-1 is done) — the same idle-skipping semantics the
+scheduler's clock gives the continuous arm, so neither arm pays
+real-world sleeps.
+
+    python benchmarks/serving.py --out result/serving_tpu.json  # real chip
+    JAX_PLATFORMS=cpu python benchmarks/serving.py --smoke      # plumbing
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _pct(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8,
+                    help="static arm's batch size AND the engine's slot "
+                         "capacity — same concurrency budget both arms")
+    ap.add_argument("--prompt-min", type=int, default=16)
+    ap.add_argument("--prompt-max", type=int, default=128)
+    ap.add_argument("--new-min", type=int, default=8)
+    ap.add_argument("--new-max", type=int, default=192)
+    ap.add_argument("--len-sigma", type=float, default=1.4,
+                    help="lognormal sigma for prompt/new lengths (0 = "
+                         "uniform in [min, max]).  Serving traces are "
+                         "heavy-tailed: most requests are short, a few "
+                         "are long — exactly the regime where a static "
+                         "batch pads everything to its straggler.  The "
+                         "default matches trace studies (ShareGPT-style "
+                         "output lengths are lognormal with sigma ~1-1.5 "
+                         "in log space); sweep it to see the speedup "
+                         "collapse toward 1x as traffic turns uniform")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="Poisson arrival rate, requests/sec (0 = derive "
+                         "one that keeps the system busy: requests "
+                         "arrive ~4x faster than the static arm serves)")
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--kv-heads", type=int, default=0)
+    ap.add_argument("--d-ff", type=int, default=3072)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--block-len", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=0,
+                    help="physical KV blocks (0 = sized so the pool "
+                         "covers ~batch x mean request length: real "
+                         "contention, occasional eviction)")
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--decode-attention", default=None,
+                    choices=("einsum", "fused"),
+                    help="engine decode path: the paged Pallas kernel or "
+                         "the gathered einsum fallback.  Default resolves "
+                         "by platform — fused on TPU, einsum elsewhere "
+                         "(off-TPU the Pallas kernels run in interpret "
+                         "mode, never a perf win: the same policy as "
+                         "ops.resolve_attention)")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="int8 KV pool + cache (both arms)")
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="measure each arm this many times and keep the "
+                         "least-contended (fastest) pass — both arms' "
+                         "phases are seconds-long, so a background blip "
+                         "on the host otherwise decides the comparison")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from chainermn_tpu.utils import respect_jax_platforms_env
+
+    respect_jax_platforms_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models import TransformerLM, lm_generate
+    from chainermn_tpu.serving import DecodeEngine, Request, Scheduler
+
+    platform = jax.devices()[0].platform
+    if args.decode_attention is None:
+        args.decode_attention = "fused" if platform == "tpu" else "einsum"
+    if platform != "tpu" and not args.smoke:
+        print(json.dumps({
+            "error": f"serving bench needs a TPU (got {platform}); "
+                     "pass --smoke for a CPU plumbing check"
+        }))
+        return
+    if args.smoke:
+        # Small enough to finish in a couple of minutes on CPU, big
+        # enough that a decode step's compute amortizes the engine's
+        # per-step host dispatch (a 128-wide toy model measures dispatch,
+        # not serving) and that the drain tail — the last long request
+        # finishing alone — doesn't dominate the makespan.  Explicitly
+        # passed flags win over these smoke defaults.
+        # repeats=4: on a small shared-CPU host both arms' phases sit
+        # inside the noise floor of background load — min-of-4 passes is
+        # the cheapest way to recover the uncontended service times the
+        # comparison is about (on-chip runs keep the default).
+        smoke_over = dict(
+            requests=48, batch=8, prompt_min=8, prompt_max=48,
+            new_min=4, new_max=64, layers=4, d_model=512, heads=8,
+            d_ff=1024, vocab=4096, block_len=8, prefill_chunk=16,
+            repeats=4,
+        )
+        for k, v in smoke_over.items():
+            if getattr(args, k) == ap.get_default(k):
+                setattr(args, k, v)
+    # NOTE: async CPU dispatch stays ON (the jax default).  Both arms'
+    # timings sync on actual value readbacks — the static arm
+    # materializes its scan output, the engine reads every step's sampled
+    # tokens — so async cannot inflate either number.  Disabling it (as
+    # the training benches do for step-time stability) would serialize
+    # the engine's ~4 small control-vector uploads per decode step behind
+    # each other, a pure dispatch-latency tax on the continuous arm that
+    # the static arm's single-dispatch lax.scan never pays.
+
+    rng = np.random.RandomState(args.seed)
+
+    def draw_lens(lo, hi, n):
+        if not args.len_sigma:
+            return rng.randint(lo, hi + 1, size=n)
+        # Clipped lognormal with the median at the low quartile of the
+        # range: a realistic length mix (mostly short, occasional long).
+        med = max(lo, (lo + hi) // 8)
+        return np.clip(
+            np.round(np.exp(rng.normal(np.log(med), args.len_sigma,
+                                       size=n))),
+            lo, hi,
+        ).astype(int)
+
+    plens = draw_lens(args.prompt_min, args.prompt_max, args.requests)
+    prompts = [
+        rng.randint(1, args.vocab, size=int(n)).astype(np.int32)
+        for n in plens
+    ]
+    new_counts = draw_lens(args.new_min, args.new_max, args.requests)
+    max_total = args.prompt_max + int(new_counts.max()) + args.prefill_chunk
+
+    model = TransformerLM(
+        vocab=args.vocab, n_layers=args.layers, d_model=args.d_model,
+        n_heads=args.heads, d_ff=args.d_ff, max_len=max_total,
+        pos_enc="rope", n_kv_heads=args.kv_heads,
+        kv_dtype=jnp.int8 if args.kv_int8 else None,
+        decode_attention=args.decode_attention,
+    )
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))
+    )(jax.random.PRNGKey(0))["params"]
+
+    useful_tokens = int(new_counts.sum())
+
+    # ------------------------------------------------------- static arm
+    # Batches in arrival order; every batch padded to its longest prompt
+    # (right-padding + prompt_lengths gives lm_generate ragged semantics)
+    # and decoded to its longest max_new.  One compiled program per
+    # (prompt_pad, n_new) geometry — rounding the pad up to the prefill
+    # chunk bounds the variant count, exactly as real static servers
+    # bucket shapes.
+    def pad_to(n, q):
+        return int(-(-n // q) * q)
+
+    order = list(range(args.requests))
+    batches = [order[i:i + args.batch]
+               for i in range(0, args.requests, args.batch)]
+    gen = jax.jit(
+        lambda p, pr, lens, n_new: lm_generate(
+            model, p, pr, n_new, prompt_lengths=lens
+        ),
+        static_argnums=(3,),
+    )
+    # Warm every geometry first so the timed loop measures steady-state
+    # service, not compiles (a long-lived server's regime).
+    geoms = set()
+    for b in batches:
+        pp = pad_to(max(len(prompts[i]) for i in b), args.prefill_chunk)
+        nn = int(max(new_counts[i] for i in b))
+        geoms.add((pp, nn))
+    for pp, nn in sorted(geoms):
+        pr = jnp.zeros((args.batch, pp), jnp.int32)
+        lens = jnp.ones((args.batch,), jnp.int32)
+        np.asarray(gen(params, pr, lens, nn)[:1, -1:])
+
+    repeats = max(1, args.repeats)
+    service = [float("inf")] * len(batches)
+    static_tokens = {}
+    for _ in range(repeats):
+        for bi, b in enumerate(batches):
+            pp = pad_to(
+                max(len(prompts[i]) for i in b), args.prefill_chunk
+            )
+            nn = int(max(new_counts[i] for i in b))
+            pr = np.zeros((args.batch, pp), np.int32)
+            lens = np.zeros((args.batch,), np.int32)
+            for row, i in enumerate(b):
+                pr[row, :len(prompts[i])] = prompts[i]
+                lens[row] = len(prompts[i])
+            lens = np.maximum(lens, 1)  # tail batch's empty rows
+            t0 = time.perf_counter()
+            out = gen(params, jnp.asarray(pr), jnp.asarray(lens), nn)
+            out = np.asarray(out)
+            service[bi] = min(
+                service[bi], time.perf_counter() - t0
+            )
+            for row, i in enumerate(b):
+                static_tokens[i] = out[row, :new_counts[i]].tolist()
+
+    # Arrival schedule shared by both arms.  Default rate: fast enough
+    # that the queue never starves (throughput measures the server, not
+    # the arrival process).
+    static_service = sum(service)
+    rate = args.rate or (4.0 * args.requests / max(static_service, 1e-9))
+    gaps = rng.exponential(1.0 / rate, size=args.requests)
+    arrivals = np.cumsum(gaps)
+
+    # Simulated static makespan on the shared arrival clock.
+    t = 0.0
+    static_lat = []
+    done_at = {}
+    for b, dt in zip(batches, service):
+        t = max(t, float(arrivals[b[-1]])) + dt
+        for i in b:
+            done_at[i] = t
+    static_makespan = max(done_at.values()) - float(arrivals.min())
+    for i in range(args.requests):
+        static_lat.append(
+            (done_at[i] - float(arrivals[i])) / int(new_counts[i])
+        )
+    static_tps = useful_tokens / static_makespan
+
+    # --------------------------------------------------- continuous arm
+    # Pool sized to the DRAWN traffic (p85 of total request length), not
+    # the range midpoint — lognormal draws sit far below the midpoint, and
+    # a pool sized to the midpoint is several x the working set, silently
+    # skipping the eviction/backpressure path this benchmark claims to
+    # exercise.  p85 is the provisioning a real server would pick: tail
+    # draws above it still force occasional evictions (reported in the
+    # payload), while a mean-sized pool thrashes — every above-mean slot
+    # evicts and recomputes, and the benchmark measures recompute waste
+    # instead of steady-state serving.
+    p85 = float(np.percentile(plens + new_counts, 85))
+    num_blocks = args.num_blocks or (
+        1 + args.batch * (1 + int(p85) // args.block_len + 1)
+    )
+    # Block tables sized to the drawn traffic's LONGEST request (padded to
+    # the prefill chunk), not the model's max_len: the einsum fallback
+    # gathers the full table width every step, so table slack is pure
+    # masked compute in the hot loop.  A real deployment knows its length
+    # cap the same way.
+    from chainermn_tpu.serving.kv_pool import blocks_for
+
+    longest = int((plens + new_counts).max())
+    padded_longest = pad_to(longest, args.prefill_chunk)
+    eng = DecodeEngine(
+        model, params, capacity=args.batch, num_blocks=num_blocks,
+        block_len=args.block_len, prefill_chunk=args.prefill_chunk,
+        max_blocks_per_slot=blocks_for(padded_longest, args.block_len),
+    )
+    reqs = [
+        Request(id=i, prompt=prompts[i].tolist(),
+                max_new_tokens=int(new_counts[i]),
+                arrival=float(arrivals[i]))
+        for i in range(args.requests)
+    ]
+    # Warm the engine programs off the clock (same steady-state policy
+    # as the static arm) — one request per prefill-ladder geometry plus
+    # the decode step — then run the measured traffic, keeping the
+    # least-contended of `repeats` passes, mirroring the static arm.
+    warm_eng = Scheduler(eng)
+    warm_eng.run([
+        Request(id=-(i + 1), prompt=[1] * c, max_new_tokens=2)
+        for i, c in enumerate(eng.prefill_ladder)
+    ])
+    comps, cont_makespan = None, float("inf")
+    for _ in range(repeats):
+        sched = Scheduler(eng)
+        cs = sched.run(reqs)
+        span = (
+            max(c.finished_at for c in cs)
+            - min(c.arrival for c in cs)
+        )
+        if span < cont_makespan:
+            comps, cont_makespan = cs, span
+    cont_tps = useful_tokens / cont_makespan
+    cont_lat = [
+        (c.finished_at - c.arrival) / len(c.tokens) for c in comps
+    ]
+    evictions = sum(c.evictions for c in comps)
+
+    # Greedy equivalence spot-check: the continuous arm must produce the
+    # static arm's tokens request for request, or the speedup compares
+    # different functions.  Exact in fp32 (pinned by the serving oracle
+    # tests); under bf16 the gathered/paged attention and the contiguous
+    # einsum are different XLA kernels whose logits differ in the last
+    # bits, so a near-argmax-tie can flip and everything after diverges —
+    # report the divergence structure (a logic bug diverges at step ~0 on
+    # every request) exactly as benchmarks/decode.py does for its arms.
+    per_req = []
+    for c in comps:
+        want = static_tokens[c.id]
+        mm = [i for i, (a, b) in enumerate(zip(c.tokens, want)) if a != b]
+        per_req.append((c.id, mm[0] if mm else None))
+    diverged = [(i, f) for i, f in per_req if f is not None]
+    agreement = {
+        "requests_exact": len(per_req) - len(diverged),
+        "requests": len(per_req),
+        "min_first_divergence": min(
+            (f for _, f in diverged), default=None
+        ),
+        "diverged_request_ids": [i for i, _ in diverged][:8],
+    }
+
+    payload = {
+        "metric": "serving_tokens_per_sec",
+        "value": round(cont_tps, 1),
+        "unit": "useful generated tokens/sec",
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "requests": args.requests,
+        "capacity": args.batch,
+        "repeats": repeats,
+        "traffic": {
+            "prompt_len": [args.prompt_min, args.prompt_max],
+            "max_new": [args.new_min, args.new_max],
+            "len_sigma": args.len_sigma,
+            "poisson_rate_per_sec": round(rate, 3),
+            "useful_tokens": useful_tokens,
+        },
+        "config": {"layers": args.layers, "d_model": args.d_model,
+                   "heads": args.heads, "d_ff": args.d_ff,
+                   "vocab": args.vocab, "kv_heads": args.kv_heads,
+                   "decode_attention": args.decode_attention,
+                   "kv_int8": bool(args.kv_int8)},
+        "pool": {"num_blocks": num_blocks, "block_len": args.block_len,
+                 "bytes_per_block": eng.pool.bytes_per_block,
+                 "prefill_ladder": list(eng.prefill_ladder),
+                 "evictions": evictions},
+        "continuous": {
+            "tokens_per_sec": round(cont_tps, 1),
+            "makespan_s": round(cont_makespan, 3),
+            "token_latency_ms_p50": round(_pct(cont_lat, 0.5) * 1e3, 3),
+            "token_latency_ms_p95": round(_pct(cont_lat, 0.95) * 1e3, 3),
+            "decode_compiles": eng.decode_compiles,
+            "prefill_compiles": eng.prefill_compiles,
+        },
+        "static": {
+            "tokens_per_sec": round(static_tps, 1),
+            "makespan_s": round(static_makespan, 3),
+            "token_latency_ms_p50": round(_pct(static_lat, 0.5) * 1e3, 3),
+            "token_latency_ms_p95": round(_pct(static_lat, 0.95) * 1e3, 3),
+            "batches": len(batches),
+            "padded_token_overhead": round(
+                args.batch * sum(
+                    max(new_counts[i] for i in b) for b in batches
+                ) / useful_tokens, 3,
+            ),
+        },
+        "speedup_vs_static": round(cont_tps / static_tps, 3),
+        "greedy_agreement_vs_static": agreement,
+    }
+    print(json.dumps(payload))
+    if args.out:
+        from chainermn_tpu.utils import atomic_json_dump
+
+        atomic_json_dump(payload, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,44 @@ def segment_positions(segment_ids: jax.Array) -> jax.Array:
     return idx - starts  # (B, T)
 
 
+def _attend_kv_major(q, kc, vc, q_pos, window, ks_c=None, vs_c=None):
+    """Grouped-query attention of a ``(B, T, H, Dh)`` query chunk against a
+    kv-head-major ``(B, KH, L, Dh)`` cache — the einsum fallback for the
+    fused-kernel cache layout (prefill chunks, sliding-window models,
+    ``L > MAX_FUSED_LEN``) and for the gathered paged-pool view.
+
+    Mask semantics mirror the legacy ``(B, L, KH, Dh)`` einsum path exactly
+    (causal length bound per row; optional sliding window); only the cache
+    axis order differs.  ``ks_c``/``vs_c`` are the int8 cache's
+    per-(kv-head, position) scales, ``(B, KH, L)``.
+    """
+    B, T, H, Dh = q.shape
+    KH = kc.shape[1]
+    qg = q.reshape(B, T, KH, H // KH, Dh)
+    s = jnp.einsum(
+        "btkgd,bkld->bkgtl", qg.astype(jnp.float32),
+        kc.astype(jnp.float32),
+    ) / math.sqrt(Dh)
+    if ks_c is not None:
+        s = s * ks_c[:, :, None, None, :]
+    t_idx = jnp.arange(kc.shape[2])
+    visible = (
+        t_idx[None, None, None, None, :]
+        <= q_pos[:, None, None, :, None]
+    )
+    if window:
+        visible &= (
+            t_idx[None, None, None, None, :]
+            > q_pos[:, None, None, :, None] - window
+        )
+    s = jnp.where(visible, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    if vs_c is not None:
+        p = p * vs_c[:, :, None, None, :]
+    a = jnp.einsum("bkgtl,bkld->btkgd", p, vc.astype(jnp.float32))
+    return a.reshape(B, T, H, Dh).astype(q.dtype)
+
+
 # =====================================================================
 # Flax tier (single-chip / DP)
 # =====================================================================
@@ -83,6 +121,13 @@ class _DecoderBlock(nn.Module):
     #: last ``window`` positions only; the flash kernel skips out-of-window
     #: blocks (O(T·window) attention compute).
     window: int = 0
+    #: decode-path attention impl: "einsum" (the original XLA path over the
+    #: (B, L, KH, Dh) cache, unchanged) or "fused" — kv-head-major
+    #: (B, KH, L, Dh) cache layout with single-token steps dispatched to
+    #: the Pallas kernel (:func:`~chainermn_tpu.ops.fused_decode_attention`),
+    #: einsum fallback for prefill chunks / window models / lengths past
+    #: ``MAX_FUSED_LEN``.  Training paths are untouched either way.
+    decode_attention: str = "einsum"
     #: "learned" (parent adds a position table to the embeddings) or
     #: "rope" (this block rotates q/k — the parent adds nothing to ``h``
     #: and passes shared per-step cos/sin ``rope`` tables instead).
@@ -107,14 +152,27 @@ class _DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, h, segment_ids=None, cache=None, decode_pos=None,
-                 rope=None, rolling=False):
+                 rope=None, rolling=False, block_tables=None,
+                 slot_mask=None):
         """Full path: ``h`` (B, T, D) → (B, T, D).  Decode path (``cache``
         given): ``h`` (B, 1, D) for position ``decode_pos``, attends against
         the KV cache, returns ``(h, new_cache)``.  Both paths create the
         identical parameters (Dense/LayerNorm shapes are length-free), so
-        one set of weights serves training and generation."""
+        one set of weights serves training and generation.
+
+        ``block_tables`` (``(B, max_blocks)`` int32) switches the decode
+        path to the PAGED cache: the cache entries are physical block
+        pools ``(KH, num_blocks, block_len, Dh)`` shared by all rows, and
+        each row's positions are mapped through its block table
+        (``chainermn_tpu/serving``).  ``slot_mask`` (``(B,)`` bool) marks
+        live decode slots — masked rows write nothing (their scatter is
+        redirected to the reserved parking block with their own current
+        value, keeping duplicate-index writes deterministic)."""
         from chainermn_tpu.ops import (
+            MAX_FUSED_LEN,
             flash_attention,
+            fused_decode_attention,
+            paged_decode_attention,
             reference_attention,
             resolve_attention,
         )
@@ -134,6 +192,11 @@ class _DecoderBlock(nn.Module):
             # paths — softmax over all-NEG_INF rows degenerates to uniform
             # (causality-violating) weights with no error.
             raise ValueError(f"window must be >= 0, got {self.window}")
+        if self.decode_attention not in ("einsum", "fused"):
+            raise ValueError(
+                f"decode_attention={self.decode_attention!r}: expected "
+                "'einsum' or 'fused'"
+            )
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(h)
         if KH == H:
             qkv = nn.DenseGeneral(
@@ -160,6 +223,17 @@ class _DecoderBlock(nn.Module):
             # causal masking then keeps the not-yet-overwritten pad slots
             # of shorter rows unattended.
             B = k.shape[0]
+            paged = block_tables is not None
+            kv_major = paged or self.decode_attention == "fused"
+            if rolling and kv_major:
+                # The ring-buffer slot arithmetic is implemented on the
+                # legacy layout only; streaming decode wants the einsum
+                # path's O(window) cache, not the fused kernel.
+                raise ValueError(
+                    "rolling decode requires decode_attention='einsum' "
+                    "and a non-paged cache (got decode_attention="
+                    f"{self.decode_attention!r}, paged={paged})"
+                )
             if rolling:
                 # Ring-buffer cache of size `window`: slot = pos mod W.
                 # O(window) memory for unbounded streaming decode — slot s
@@ -231,80 +305,211 @@ class _DecoderBlock(nn.Module):
             write_pos = (
                 decode_pos % self.window if rolling else decode_pos
             )
-            if jnp.ndim(decode_pos) == 0:
-                kc = lax.dynamic_update_slice(
-                    cache["k"], k_w, (0, write_pos, 0, 0)
-                )
-                vc = lax.dynamic_update_slice(
-                    cache["v"], v_w, (0, write_pos, 0, 0)
-                )
+            if paged:
+                # Paged pool write: each row's positions map through its
+                # block table to physical pool blocks; one scatter per
+                # pool.  Masked (idle) slots redirect to the reserved
+                # parking block 0 and write back their own current value —
+                # duplicate indices then carry duplicate VALUES, keeping
+                # the scatter deterministic.
+                pool_k, pool_v = cache["k"], cache["v"]
+                BL = pool_k.shape[2]
+                pb = jnp.take_along_axis(
+                    block_tables, q_pos // BL, axis=1
+                )  # (B, T) physical block per written position
+                off = q_pos % BL
+                if slot_mask is not None:
+                    live = slot_mask.astype(bool)[:, None]
+                    pb = jnp.where(live, pb, 0)
+                    off = jnp.where(live, off, 0)
+                k_t = jnp.transpose(k_w, (2, 0, 1, 3))  # (KH, B, T, Dh)
+                v_t = jnp.transpose(v_w, (2, 0, 1, 3))
+                if slot_mask is not None:
+                    lv = live[None, :, :, None]
+                    k_t = jnp.where(lv, k_t, pool_k[:, pb, off])
+                    v_t = jnp.where(lv, v_t, pool_v[:, pb, off])
+                kc = pool_k.at[:, pb, off].set(k_t)
+                vc = pool_v.at[:, pb, off].set(v_t)
                 if quant:
-                    ks_c = lax.dynamic_update_slice(
-                        cache["k_scale"], k_scale, (0, write_pos, 0)
+                    ks_t = jnp.transpose(k_scale, (2, 0, 1))  # (KH, B, T)
+                    vs_t = jnp.transpose(v_scale, (2, 0, 1))
+                    if slot_mask is not None:
+                        ks_t = jnp.where(
+                            live[None], ks_t, cache["k_scale"][:, pb, off]
+                        )
+                        vs_t = jnp.where(
+                            live[None], vs_t, cache["v_scale"][:, pb, off]
+                        )
+                    ks_c = cache["k_scale"].at[:, pb, off].set(ks_t)
+                    vs_c = cache["v_scale"].at[:, pb, off].set(vs_t)
+                valid = q_pos[:, -1] + 1
+                if slot_mask is not None:
+                    valid = jnp.where(slot_mask.astype(bool), valid, 0)
+                if (T == 1 and self.decode_attention == "fused"
+                        and not self.window):
+                    a = paged_decode_attention(
+                        q[:, 0], kc, vc, block_tables, valid,
+                        k_scale=ks_c if quant else None,
+                        v_scale=vs_c if quant else None,
+                    )[:, None]
+                else:
+                    # Gathered fallback (prefill chunks; einsum engines):
+                    # materialize each row's logical kv-head-major view of
+                    # its blocks and run the shared einsum path.
+                    kg = jnp.swapaxes(kc[:, block_tables], 0, 1)
+                    vg = jnp.swapaxes(vc[:, block_tables], 0, 1)
+                    Lg = kg.shape[2] * kg.shape[3]
+                    kg = kg.reshape(B, KH, Lg, D // H)
+                    vg = vg.reshape(B, KH, Lg, D // H)
+                    ksg = vsg = None
+                    if quant:
+                        ksg = jnp.swapaxes(
+                            ks_c[:, block_tables], 0, 1
+                        ).reshape(B, KH, Lg)
+                        vsg = jnp.swapaxes(
+                            vs_c[:, block_tables], 0, 1
+                        ).reshape(B, KH, Lg)
+                    a = _attend_kv_major(
+                        q, kg, vg, q_pos, self.window, ksg, vsg
                     )
-                    vs_c = lax.dynamic_update_slice(
-                        cache["v_scale"], v_scale, (0, write_pos, 0)
+                new_cache = (
+                    {"k": kc, "v": vc, "k_scale": ks_c, "v_scale": vs_c}
+                    if quant else {"k": kc, "v": vc}
+                )
+            elif kv_major:
+                # kv-head-major contiguous cache (B, KH, L, Dh) — the
+                # fused kernel's layout.  Single-token full-attention steps
+                # run the Pallas kernel; prefill chunks, window models and
+                # L > MAX_FUSED_LEN take the layout-matched einsum.
+                k_t = jnp.swapaxes(k_w, 1, 2)  # (B, KH, T, Dh)
+                v_t = jnp.swapaxes(v_w, 1, 2)
+                if jnp.ndim(decode_pos) == 0:
+                    kc = lax.dynamic_update_slice(
+                        cache["k"], k_t, (0, 0, write_pos, 0)
                     )
+                    vc = lax.dynamic_update_slice(
+                        cache["v"], v_t, (0, 0, write_pos, 0)
+                    )
+                    if quant:
+                        ks_c = lax.dynamic_update_slice(
+                            cache["k_scale"],
+                            jnp.swapaxes(k_scale, 1, 2), (0, 0, write_pos),
+                        )
+                        vs_c = lax.dynamic_update_slice(
+                            cache["v_scale"],
+                            jnp.swapaxes(v_scale, 1, 2), (0, 0, write_pos),
+                        )
+                else:
+                    rows = jnp.arange(B)[:, None]
+                    cols = write_pos[:, None] + jnp.arange(T)[None]
+                    # Advanced indices (rows, cols) straddling the KH
+                    # slice land the broadcast axes up front: the indexed
+                    # view is (B, T, KH, ...), exactly k_w's layout.
+                    kc = cache["k"].at[rows, :, cols].set(k_w)
+                    vc = cache["v"].at[rows, :, cols].set(v_w)
+                    if quant:
+                        ks_c = cache["k_scale"].at[rows, :, cols].set(
+                            k_scale
+                        )
+                        vs_c = cache["v_scale"].at[rows, :, cols].set(
+                            v_scale
+                        )
+                if (T == 1 and not self.window
+                        and cache["k"].shape[2] <= MAX_FUSED_LEN):
+                    a = fused_decode_attention(
+                        q[:, 0], kc, vc, q_pos[:, 0] + 1,
+                        k_scale=ks_c if quant else None,
+                        v_scale=vs_c if quant else None,
+                    )[:, None]
+                else:
+                    a = _attend_kv_major(
+                        q, kc, vc, q_pos, self.window,
+                        ks_c if quant else None,
+                        vs_c if quant else None,
+                    )
+                new_cache = (
+                    {"k": kc, "v": vc, "k_scale": ks_c, "v_scale": vs_c}
+                    if quant else {"k": kc, "v": vc}
+                )
             else:
-                # Per-row chunk scatter: row r writes its T slots starting
-                # at write_pos[r].
-                rows = jnp.arange(B)[:, None]
-                cols = write_pos[:, None] + jnp.arange(T)[None]
-                kc = cache["k"].at[rows, cols].set(k_w)
-                vc = cache["v"].at[rows, cols].set(v_w)
+                if jnp.ndim(decode_pos) == 0:
+                    kc = lax.dynamic_update_slice(
+                        cache["k"], k_w, (0, write_pos, 0, 0)
+                    )
+                    vc = lax.dynamic_update_slice(
+                        cache["v"], v_w, (0, write_pos, 0, 0)
+                    )
+                    if quant:
+                        ks_c = lax.dynamic_update_slice(
+                            cache["k_scale"], k_scale, (0, write_pos, 0)
+                        )
+                        vs_c = lax.dynamic_update_slice(
+                            cache["v_scale"], v_scale, (0, write_pos, 0)
+                        )
+                else:
+                    # Per-row chunk scatter: row r writes its T slots
+                    # starting at write_pos[r].
+                    rows = jnp.arange(B)[:, None]
+                    cols = write_pos[:, None] + jnp.arange(T)[None]
+                    kc = cache["k"].at[rows, cols].set(k_w)
+                    vc = cache["v"].at[rows, cols].set(v_w)
+                    if quant:
+                        ks_c = cache["k_scale"].at[rows, cols].set(k_scale)
+                        vs_c = cache["v_scale"].at[rows, cols].set(v_scale)
+                # Grouped attention against the (B, L, KH, Dh) cache: query
+                # head h reads kv head h // (H // KH).  KH == H reduces to
+                # classic multi-head (group axis of size 1).
+                G = H // KH
+                qg = q.reshape(q.shape[0], T, KH, G, D // H)
+                s = jnp.einsum(
+                    "bqkgd,btkd->bkgqt", qg.astype(jnp.float32),
+                    kc.astype(jnp.float32),
+                ) / math.sqrt(D // H)
                 if quant:
-                    ks_c = cache["k_scale"].at[rows, cols].set(k_scale)
-                    vs_c = cache["v_scale"].at[rows, cols].set(v_scale)
-            # Grouped attention against the (B, L, KH, Dh) cache: query head
-            # h reads kv head h // (H // KH).  KH == H reduces to classic
-            # multi-head (group axis of size 1).
-            G = H // KH
-            qg = q.reshape(q.shape[0], T, KH, G, D // H)
-            s = jnp.einsum(
-                "bqkgd,btkd->bkgqt", qg.astype(jnp.float32),
-                kc.astype(jnp.float32),
-            ) / math.sqrt(D // H)
-            if quant:
-                # Per-(t, kv-head) k scale commutes out of the head_dim
-                # contraction: apply it on the (b, k, g, q, t) scores.
-                s = s * jnp.transpose(ks_c, (0, 2, 1))[:, :, None, None, :]
-            t_idx = jnp.arange(kc.shape[1])
-            if rolling:
-                # Slot s holds absolute position pos − ((pos − s) mod W):
-                # the latest position ≡ s that is ≤ pos.  Negative ⇒ the
-                # slot was never written (early steps) — mask it.  Window
-                # and causality are automatic: every held position lies in
-                # (pos − W, pos].
-                pos_b = q_pos[:, 0]  # (B,), T == 1
-                p_s = pos_b[:, None] - (
-                    (pos_b[:, None] - t_idx[None, :]) % self.window
-                )
-                visible = (p_s >= 0)[:, None, None, None, :]
-            else:
-                visible = (
-                    t_idx[None, None, None, None, :]
-                    <= q_pos[:, None, None, :, None]
-                )
-                if self.window:
-                    # Decode twin of the training-time sliding window: only
-                    # the last `window` positions stay attendable.
-                    visible &= (
+                    # Per-(t, kv-head) k scale commutes out of the head_dim
+                    # contraction: apply it on the (b, k, g, q, t) scores.
+                    s = s * jnp.transpose(
+                        ks_c, (0, 2, 1)
+                    )[:, :, None, None, :]
+                t_idx = jnp.arange(kc.shape[1])
+                if rolling:
+                    # Slot s holds absolute position pos − ((pos − s) mod
+                    # W): the latest position ≡ s that is ≤ pos.  Negative
+                    # ⇒ the slot was never written (early steps) — mask
+                    # it.  Window and causality are automatic: every held
+                    # position lies in (pos − W, pos].
+                    pos_b = q_pos[:, 0]  # (B,), T == 1
+                    p_s = pos_b[:, None] - (
+                        (pos_b[:, None] - t_idx[None, :]) % self.window
+                    )
+                    visible = (p_s >= 0)[:, None, None, None, :]
+                else:
+                    visible = (
                         t_idx[None, None, None, None, :]
-                        > q_pos[:, None, None, :, None] - self.window
+                        <= q_pos[:, None, None, :, None]
                     )
-            s = jnp.where(visible, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            if quant:
-                # v scale folds into the probability operand (per t, kv
-                # head) — the int8 cache feeds the einsum directly.
-                p = p * jnp.transpose(vs_c, (0, 2, 1))[:, :, None, None, :]
-            a = jnp.einsum(
-                "bkgqt,btkd->bqkgd", p, vc.astype(jnp.float32)
-            ).reshape(q.shape[0], T, H, D // H).astype(q.dtype)
-            new_cache = (
-                {"k": kc, "v": vc, "k_scale": ks_c, "v_scale": vs_c}
-                if quant else {"k": kc, "v": vc}
-            )
+                    if self.window:
+                        # Decode twin of the training-time sliding window:
+                        # only the last `window` positions stay attendable.
+                        visible &= (
+                            t_idx[None, None, None, None, :]
+                            > q_pos[:, None, None, :, None] - self.window
+                        )
+                s = jnp.where(visible, s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                if quant:
+                    # v scale folds into the probability operand (per t, kv
+                    # head) — the int8 cache feeds the einsum directly.
+                    p = p * jnp.transpose(
+                        vs_c, (0, 2, 1)
+                    )[:, :, None, None, :]
+                a = jnp.einsum(
+                    "bkgqt,btkd->bqkgd", p, vc.astype(jnp.float32)
+                ).reshape(q.shape[0], T, H, D // H).astype(q.dtype)
+                new_cache = (
+                    {"k": kc, "v": vc, "k_scale": ks_c, "v_scale": vs_c}
+                    if quant else {"k": kc, "v": vc}
+                )
         else:
             if self.attention not in ("flash", "xla", "auto"):
                 raise ValueError(
@@ -478,6 +683,18 @@ class TransformerLM(nn.Module):
     #: out-of-window blocks — O(T·window)) AND in KV-cache decode (same
     #: mask, so generation bit-matches training semantics).
     window: int = 0
+    #: decode-path attention impl.  "einsum" (default): the original XLA
+    #: path over the (B, L, KH, Dh) cache — unchanged semantics.  "fused":
+    #: ``init_cache`` lays the cache out kv-head major (B, KH, L, Dh) and
+    #: every single-token decode step runs the Pallas kernel
+    #: (:func:`~chainermn_tpu.ops.fused_decode_attention`) — each K/V byte
+    #: streams through VMEM once at storage width instead of the einsum's
+    #: two fp32 passes; prefill chunks, sliding-window models and caches
+    #: past ``ops.MAX_FUSED_LEN`` fall back to a layout-matched einsum.
+    #: Composes with ``n_kv_heads`` (GQA) and ``kv_dtype=jnp.int8``;
+    #: ``rolling`` streaming decode requires "einsum".  Training paths are
+    #: untouched either way.
+    decode_attention: str = "einsum"
     #: Rematerialize each block in the backward pass (``jax.checkpoint``):
     #: activation memory drops from O(n_layers) residuals+intermediates to
     #: O(n_layers) residuals only, for one extra forward of compute — the
@@ -503,10 +720,14 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, segment_ids=None, return_hidden: bool = False,
-                 cache=None, decode_pos=None, rolling: bool = False):
+                 cache=None, decode_pos=None, rolling: bool = False,
+                 block_tables=None, slot_mask=None):
         """(B, T) int32 → (B, T, vocab) fp32 logits; with
         ``return_hidden=True``, the pre-head (B, T, d_model) hidden states
-        instead (for :func:`lm_loss_chunked`, which streams the head).
+        instead (for :func:`lm_loss_chunked`, which streams the head, and
+        the serving engine's prefill, which applies the head at one
+        position only; on the decode path the updated cache still rides
+        along: ``(hidden, new_cache)``).
 
         ``segment_ids`` (``(B, T)`` int32, from
         :func:`~chainermn_tpu.datasets.pack_sequences`) trains PACKED rows:
@@ -516,7 +737,12 @@ class TransformerLM(nn.Module):
 
         Decode path (``cache`` from :meth:`init_cache`, ``decode_pos``
         scalar): ``tokens`` is the (B, 1) token at that position; returns
-        ``(logits, new_cache)``.  See :func:`lm_generate`."""
+        ``(logits, new_cache)``.  See :func:`lm_generate`.
+
+        ``block_tables``/``slot_mask`` switch the decode path to the PAGED
+        cache (``cache`` entries are the serving engine's physical block
+        pools; see :class:`_DecoderBlock.__call__` and
+        ``chainermn_tpu/serving``)."""
         B, T = tokens.shape
         D = self.d_model
         if self.pos_enc not in ("learned", "rope"):
@@ -587,18 +813,20 @@ class TransformerLM(nn.Module):
                 moe_k=self.moe_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_group=self.moe_group,
+                decode_attention=self.decode_attention,
                 param_dtype=self.param_dtype, name=f"block_{i}",
             )
             if cache is not None:
                 h, c = blk(h, None, cache[i], decode_pos, rope=rope,
-                           rolling=rolling)
+                           rolling=rolling, block_tables=block_tables,
+                           slot_mask=slot_mask)
                 new_cache.append(c)
             else:
                 h = blk(h, segment_ids, rope=rope)
         h = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_f")(h)
         if return_hidden:
-            return h
+            return (h, new_cache) if cache is not None else h
         logits = nn.Dense(self.vocab, dtype=jnp.float32,
                           param_dtype=self.param_dtype, name="lm_head")(h)
         return (logits, new_cache) if cache is not None else logits
@@ -611,10 +839,23 @@ class TransformerLM(nn.Module):
         batches fit in HBM).  With ``kv_dtype=jnp.int8`` the entries are
         int8 plus per-(token, kv-head) fp32 ``{"k_scale","v_scale"}`` of
         shape ``(batch, max_len, kv_heads)`` — half the bf16 bytes (the
-        scale adds 2/head_dim fp32 words per row)."""
+        scale adds 2/head_dim fp32 words per row).
+
+        Under ``decode_attention="fused"`` the layout is kv-head major —
+        ``{"k","v"}`` of ``(batch, kv_heads, max_len, head_dim)`` and
+        scales ``(batch, kv_heads, max_len)`` — so each fused-kernel grid
+        program reads a contiguous ``(L, head_dim)`` panel."""
+        if self.decode_attention not in ("einsum", "fused"):
+            raise ValueError(
+                f"decode_attention={self.decode_attention!r}: expected "
+                "'einsum' or 'fused'"
+            )
         L = max_len or self.max_len
         kvh = self.n_kv_heads or self.n_heads
-        shape = (batch, L, kvh, self.d_model // self.n_heads)
+        if self.decode_attention == "fused":
+            shape = (batch, kvh, L, self.d_model // self.n_heads)
+        else:
+            shape = (batch, L, kvh, self.d_model // self.n_heads)
         kvd = self.kv_dtype if self.kv_dtype is not None else self.dtype
         if jnp.dtype(kvd) == jnp.int8:
             return [
@@ -707,6 +948,12 @@ def lm_generate(
         if not model.window:
             raise ValueError(
                 "rolling=True needs a sliding-window model (window > 0)"
+            )
+        if model.decode_attention == "fused":
+            # The ring-collapse below and the block's slot arithmetic are
+            # legacy-layout only.
+            raise ValueError(
+                "rolling=True requires decode_attention='einsum'"
             )
         if prompt_lengths is not None:
             raise ValueError(

@@ -11,6 +11,7 @@ from chainermn_tpu.ops.chunked_ce import chunked_softmax_cross_entropy
 from chainermn_tpu.ops.decode_attention import (
     MAX_FUSED_LEN,
     fused_decode_attention,
+    paged_decode_attention,
 )
 from chainermn_tpu.ops.rope import apply_rope
 from chainermn_tpu.ops.augment import (
@@ -37,6 +38,7 @@ __all__ = [
     "FLASH_MIN_SEQ_NONCAUSAL",
     "max_pool_fused",
     "fused_decode_attention",
+    "paged_decode_attention",
     "MAX_FUSED_LEN",
     "chunked_softmax_cross_entropy",
     "apply_rope",

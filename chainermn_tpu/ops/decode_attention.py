@@ -13,18 +13,35 @@ program.
 
 Layout: the fused path expects the cache **(B, KH, L, Dh)** (kv-head
 major) so each grid program ``(b, kh)`` reads a contiguous ``(L, Dh)``
-panel.  NOT YET WIRED into :class:`TransformerLM` — its decode branch
-still runs the einsum path over the (B, L, KH, Dh) cache; adopting this
-kernel means a model knob that selects the kv-head-major layout in
-``init_cache`` and the block's write path (future work).  Until then the
-public entry point is :func:`fused_decode_attention` itself (exported
-from ``chainermn_tpu.ops``).  Grid ``(B, KH)``; each
-program stages its panel in VMEM (L·Dh·itemsize — ~1 MB at L=4096,
-Dh=128 bf16), computes the G=H/KH query heads' scores against it, masks
-positions ``>= valid_len`` (causality at decode = a length bound), and
-writes the (G, Dh) output block.  One-shot softmax — no online
-recurrence needed since L fits VMEM for every decode-practical length;
-lengths beyond the VMEM budget fall back to the einsum path upstream.
+panel.  WIRED into :class:`TransformerLM` via the
+``decode_attention="fused"`` knob: ``init_cache`` then lays the cache
+out kv-head major and the decode branch dispatches every single-token
+step (``T == 1``, full attention, ``L <= MAX_FUSED_LEN``) to
+:func:`fused_decode_attention`, falling back to the layout-matched
+einsum path for prefill chunks, sliding-window models, and lengths past
+the VMEM budget (``models/transformer.py`` ``_DecoderBlock._attend_kv_major``).
+Grid ``(B, KH)``; each program stages its panel in VMEM (L·Dh·itemsize —
+~1 MB at L=4096, Dh=128 bf16), computes the G=H/KH query heads' scores
+against it, masks positions ``>= valid_len`` (causality at decode = a
+length bound), and writes the (G, Dh) output block.  One-shot softmax —
+no online recurrence needed since L fits VMEM for every decode-practical
+length; lengths beyond the VMEM budget fall back to the einsum path
+upstream.
+
+:func:`paged_decode_attention` is the continuous-batching twin
+(``chainermn_tpu/serving``): the cache lives in a fixed device-resident
+**block pool** ``(KH, num_blocks, block_len, Dh)`` and each slot owns a
+block table mapping logical cache blocks to physical pool blocks
+(vLLM/PagedAttention, Kwon et al. 2023).  Grid ``(S, KH, MB)`` with the
+block tables scalar-prefetched so each program's K/V DMA is indexed
+``pool[kh, table[s, m]]`` — the kernel walks the table directly, no
+gathered contiguous copy is ever materialized.  Blocks accumulate
+through the online-softmax recurrence (running max / normalizer /
+fp32 accumulator in VMEM scratch), so there is no ``MAX_FUSED_LEN``
+cap: VMEM holds one ``(block_len, Dh)`` panel at a time.  Blocks
+entirely past ``valid_len`` are skipped (``@pl.when``), so a
+short sequence in a long-capacity slot pays for the blocks it
+actually fills.
 
 No reference counterpart (the reference has no incremental-decode stack;
 SURVEY §2.9's examples are training-side) — this extends the repo's
@@ -36,12 +53,14 @@ an einsum oracle (MHA/GQA, ragged ``valid_len``, int8 cache + scales).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from chainermn_tpu.ops.flash_attention import NEG_INF, _use_interpret
 
@@ -143,3 +162,164 @@ def fused_decode_attention(
         interpret=_use_interpret(),
     )(*operands)
     return out.reshape(B, H, Dh)
+
+
+def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                  scale, block_len, quant):
+    """One (slot, kv head, logical block): online-softmax accumulation of
+    this block's contribution into the VMEM scratch; the last block
+    normalizes and writes the (G, Dh) output."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc = rest
+    else:
+        o_ref, m_scr, l_scr, acc = rest
+    s_idx = pl.program_id(0)
+    m_idx = pl.program_id(2)
+    n_blocks = pl.num_programs(2)
+
+    @pl.when(m_idx == 0)
+    def _():
+        # Scratch persists across grid steps (the block axis is innermost
+        # and sequential on TPU) — every slot/head pair must re-init it.
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    valid = len_ref[s_idx]
+    base = m_idx * block_len
+
+    @pl.when(base < valid)
+    def _():
+        # Blocks wholly past valid_len are skipped: a short sequence in a
+        # long-capacity slot reads only the blocks it actually fills.
+        G = q_ref.shape[2]
+        q = q_ref[0, 0].astype(jnp.float32) * scale   # (G, Dh)
+        k = k_ref[0, 0].astype(jnp.float32)           # (BL, Dh)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (G, BL)
+        if quant:
+            s = s * ks_ref[0, 0, :, 0][None, :]
+        pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (G, k.shape[0]), 1
+        )
+        mask = pos < valid
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        # Explicit p mask: with the finite NEG_INF stand-in, a fully-masked
+        # row would otherwise see exp(NEG_INF - NEG_INF) = 1 per position.
+        p = jnp.exp(s - m_new[:, None]) * mask.astype(jnp.float32)
+        l_scr[:, 0] = alpha * l_scr[:, 0] + jnp.sum(p, axis=1)
+        if quant:
+            p = p * vs_ref[0, 0, :, 0][None, :]
+        acc[:] = alpha[:, None] * acc[:] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0] = m_new
+
+    @pl.when(m_idx == n_blocks - 1)
+    def _():
+        o_ref[0, 0] = (
+            acc[:] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
+        ).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    valid_len: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Single-position attention against a block-pooled (paged) KV cache.
+
+    The serving engine's hot op (``chainermn_tpu/serving/engine.py``): S
+    decode slots each read their own logical sequence out of one shared
+    physical pool through a per-slot block table.  The kernel walks the
+    table via scalar prefetch — block ``m`` of slot ``s`` DMAs
+    ``pool[kh, block_tables[s, m]]`` straight into VMEM — and folds blocks
+    through the online-softmax recurrence, so no contiguous per-slot cache
+    copy is ever materialized and there is no ``MAX_FUSED_LEN`` cap.
+
+    Args:
+      q: ``(S, H, Dh)`` — each slot's current query position.
+      k_pool/v_pool: ``(KH, num_blocks, block_len, Dh)`` physical pools
+        (float, or int8 with scales).
+      block_tables: ``(S, max_blocks)`` int32 — logical→physical block map
+        per slot.  Entries past a slot's filled length may point anywhere
+        valid (they are masked, conventionally 0 — the serving pool
+        reserves physical block 0 as the parking block).
+      valid_len: ``(S,)`` int32 — positions ``< valid_len[s]`` attendable;
+        ``0`` marks an idle slot (output is well-defined zeros-over-guard,
+        discarded by the engine).
+      k_scale/v_scale: ``(KH, num_blocks, block_len)`` fp32 — required iff
+        the pool is int8 (same symmetric-absmax convention as
+        :func:`fused_decode_attention`).
+
+    Returns ``(S, H, Dh)`` in ``q``'s dtype.
+    """
+    S, H, Dh = q.shape
+    KH, NB, BL, _ = k_pool.shape
+    if H % KH:
+        raise ValueError(f"H ({H}) must be a multiple of KH ({KH})")
+    if block_tables.ndim != 2 or block_tables.shape[0] != S:
+        raise ValueError(
+            f"block_tables must be (S={S}, max_blocks), got "
+            f"{block_tables.shape}"
+        )
+    G = H // KH
+    MB = block_tables.shape[1]
+    quant = k_pool.dtype == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError("int8 pool needs k_scale and v_scale")
+    qg = q.reshape(S, KH, G, Dh)
+    tbl = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(valid_len, jnp.int32).reshape(S)
+
+    q_spec = pl.BlockSpec(
+        (1, 1, G, Dh), lambda s, h, m, tbl, ln: (s, h, 0, 0)
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, BL, Dh), lambda s, h, m, tbl, ln: (h, tbl[s, m], 0, 0)
+    )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        sc_spec = pl.BlockSpec(
+            (1, 1, BL, 1), lambda s, h, m, tbl, ln: (h, tbl[s, m], 0, 0)
+        )
+        in_specs += [sc_spec, sc_spec]
+        operands += [
+            k_scale.reshape(KH, NB, BL, 1),
+            v_scale.reshape(KH, NB, BL, 1),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, KH, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, G, Dh), lambda s, h, m, tbl, ln: (s, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),   # running max
+            pltpu.VMEM((G, 1), jnp.float32),   # normalizer
+            pltpu.VMEM((G, Dh), jnp.float32),  # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=1.0 / math.sqrt(Dh), block_len=BL,
+            quant=quant,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, KH, G, Dh), q.dtype),
+        interpret=_use_interpret(),
+    )(tbl, lens, *operands)
+    return out.reshape(S, H, Dh)

@@ -1,0 +1,45 @@
+"""Continuous-batching serving engine over a paged KV cache.
+
+The inference-side answer to the ROADMAP's "heavy traffic" north star:
+instead of one static ``lm_generate`` batch that pads every request to the
+longest member, a fixed-shape decode step runs ``capacity`` slots forever
+while the scheduler streams requests through them — admission the moment a
+slot and pool blocks free up, retirement the moment EOS lands (Orca-style
+iteration-level scheduling over a vLLM-style paged KV pool).
+
+Three layers:
+
+* :mod:`~chainermn_tpu.serving.kv_pool` — the fixed device-resident block
+  pool + host-side free-list allocator (zero device syncs).
+* :mod:`~chainermn_tpu.serving.engine` — the jitted fixed-capacity decode
+  step (compiles exactly once; slot churn never recompiles) + chunked
+  prefill.
+* :mod:`~chainermn_tpu.serving.scheduler` — admission queue, prefill/decode
+  interleaving, eviction-based backpressure, ``serve.*`` metrics.
+
+See ``docs/serving.md`` and ``benchmarks/serving.py``.
+"""
+
+from chainermn_tpu.serving.engine import DecodeEngine
+from chainermn_tpu.serving.kv_pool import (
+    BlockAllocator,
+    PagedKVPool,
+    PoolExhausted,
+    blocks_for,
+)
+from chainermn_tpu.serving.scheduler import (
+    Completion,
+    Request,
+    Scheduler,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "PagedKVPool",
+    "PoolExhausted",
+    "blocks_for",
+    "DecodeEngine",
+    "Completion",
+    "Request",
+    "Scheduler",
+]

@@ -1,0 +1,299 @@
+"""Fixed-shape continuous-batching decode engine over the paged KV pool.
+
+The TPU-idiomatic serving loop is ONE jitted decode step whose shapes never
+change: ``capacity`` slots × 1 token, every iteration, forever.  Slot churn
+(requests finishing, new prompts admitted) only changes the *contents* of
+the step's inputs — the block tables, position vector, live mask, RNG lanes
+and temperatures — never their shapes or dtypes, so the steady-state loop
+compiles **exactly once** (``tests/serving_tests/test_engine.py`` pins this
+with a compilation-count guard).  Idle slots ride along masked: their cache
+writes are parked on reserved block 0 and their sampled tokens discarded.
+
+One step = gather block tables → paged decode attention
+(:func:`~chainermn_tpu.ops.paged_decode_attention` under
+``decode_attention="fused"``, the gathered einsum fallback otherwise) →
+per-slot sampling (independent RNG lanes, per-slot temperature, engine-wide
+``top_k``).
+
+Prefill runs through a second single-row jitted program in chunks drawn
+from a small fixed **ladder** of geometries (``prefill_ladder``, by
+default ``prefill_chunk`` and its halves down to 8 — one slot per call;
+prefill compute scales with every padded row, so a capacity-wide
+variant would pay the full ``capacity x chunk`` forward even when a
+single slot is refilling): each chunk writes its K/V into the slot's
+blocks and the final chunk samples the first generated token from the
+last real prompt position's logits.  Chunking bounds prefill's latency
+footprint so the scheduler can interleave decode steps between chunks
+(iteration-level scheduling, Yu et al. 2022, *Orca*); the ladder bounds
+the final chunk's padding waste (a short tail pays the nearest ladder
+size, not the full ``prefill_chunk``) at a bounded, admission-path-only
+compile cost — at most ``len(prefill_ladder)`` prefill variants, ever,
+and still exactly ONE decode-step variant.
+
+Host↔device traffic per decode step: small int32 control vectors up
+(tokens/positions/tables/mask) and the ``(capacity,)`` sampled tokens down.
+Pool accounting stays host-side (:mod:`~chainermn_tpu.serving.kv_pool`) —
+no device sync beyond the token readback serving fundamentally needs for
+EOS detection.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from chainermn_tpu.serving.kv_pool import PagedKVPool
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a :class:`PagedKVPool`.
+
+    Args:
+      model: a :class:`~chainermn_tpu.models.TransformerLM`.  Works with
+        either ``decode_attention`` setting — "fused" runs the paged Pallas
+        kernel in the hot loop, "einsum" the gathered fallback.
+      params: the model's parameter pytree.
+      capacity: decode slots per step (the fixed batch dimension).
+      num_blocks: physical blocks in the pool (block 0 stays reserved).
+      block_len: positions per block.
+      max_blocks_per_slot: block-table width — caps a request at
+        ``max_blocks_per_slot * block_len`` total positions.  Defaults to
+        covering ``model.max_len``.
+      prefill_chunk: largest prompt-tokens-per-prefill-call geometry.
+      prefill_ladder: the full set of allowed prefill chunk sizes
+        (must contain its max == ``prefill_chunk``).  Defaults to
+        ``prefill_chunk`` and its successive halves down to 8.  Each
+        size is one compiled prefill variant (admission path only — the
+        decode step stays a single variant).
+      top_k: engine-wide sampling truncation (0 = off; static — part of
+        the compiled program).
+    """
+
+    def __init__(self, model, params, capacity: int, num_blocks: int,
+                 block_len: int = 16,
+                 max_blocks_per_slot: Optional[int] = None,
+                 prefill_chunk: int = 32, top_k: int = 0,
+                 prefill_ladder: Optional[List[int]] = None):
+        import jax
+        import jax.numpy as jnp
+
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        self.model = model
+        self.params = params
+        self.capacity = capacity
+        self.pool = PagedKVPool(model, num_blocks, block_len)
+        self.block_len = block_len
+        self.max_blocks = (
+            max_blocks_per_slot
+            if max_blocks_per_slot is not None
+            else max(1, math.ceil(model.max_len / block_len))
+        )
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self.prefill_chunk = prefill_chunk
+        if prefill_ladder is None:
+            ladder = {prefill_chunk}
+            c = prefill_chunk // 2
+            while c >= 8:
+                ladder.add(c)
+                c //= 2
+        else:
+            ladder = set(int(c) for c in prefill_ladder)
+            if not ladder or min(ladder) < 1:
+                raise ValueError(f"bad prefill_ladder {prefill_ladder}")
+            if max(ladder) != prefill_chunk:
+                raise ValueError(
+                    f"prefill_ladder max ({max(ladder)}) must equal "
+                    f"prefill_chunk ({prefill_chunk}) — the scheduler's "
+                    "padding bound at submit() assumes it"
+                )
+        #: allowed prefill chunk geometries, ascending; the scheduler
+        #: picks the smallest size covering a prompt's tail so short
+        #: remainders don't pay a full ``prefill_chunk`` of padded
+        #: compute.
+        self.prefill_ladder = tuple(sorted(ladder))
+        self.top_k = top_k
+        # The engine OWNS the live pool buffers: they are donated through
+        # the jitted step every iteration, so any alias held elsewhere
+        # (e.g. on the PagedKVPool) would dangle on deleted arrays after
+        # the first step.
+        self.pools = self.pool.pools
+        self.pool.pools = None
+        #: per-slot RNG BASE keys + temperatures, HOST numpy mirrors
+        #: written only at admission (never in the steady loop) and
+        #: uploaded lazily — an eager device scatter per admission would
+        #: cost more than the whole control-vector upload of a step.
+        #: Sampling derives each token's key STATELESSLY as
+        #: ``fold_in(base, position)``, so a request's sampled sequence
+        #: depends only on its seed and its own token positions —
+        #: invisible to co-scheduling, slot placement, and
+        #: eviction/recompute (the re-admission re-derives the exact
+        #: keys the uninterrupted run would have used).
+        self.rng = np.zeros((capacity, 2), np.uint32)
+        self.temp = np.zeros((capacity,), np.float32)
+        self._rng_temp_dev = None  # lazy device copy, dropped on seed_slot
+
+        def pick(logits, base, position, t):
+            """One slot's token: greedy at t <= 0, else temperature/top-k
+            sampling keyed by (base key, absolute position)."""
+            greedy = jnp.argmax(logits).astype(jnp.int32)
+            scaled = logits / jnp.maximum(t, 1e-6)
+            if self.top_k:
+                k = min(self.top_k, logits.shape[-1])
+                # lax.top_k, not a full-vocab sort — this runs per slot
+                # inside the hot decode step.
+                kth = jax.lax.top_k(scaled, k)[0][-1]
+                scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+            key = jax.random.fold_in(base, position)
+            samp = jax.random.categorical(key, scaled).astype(jnp.int32)
+            return jnp.where(t > 0, samp, greedy)
+
+        # Both programs CLOSE over `params` instead of taking them as an
+        # argument: jit dispatch flattens every call's argument pytree,
+        # and re-flattening hundreds of parameter leaves per generated
+        # token is pure host overhead in the hot loop.  Captured params
+        # are flattened once at trace time; per-step arguments are just
+        # the pools + a handful of small control vectors.
+        def step_impl(pools, tokens, pos, tables, active, rng, temp):
+            logits, new_pools = model.apply(
+                {"params": params}, tokens[:, None], cache=pools,
+                decode_pos=pos, block_tables=tables, slot_mask=active,
+            )
+            nxt = jax.vmap(pick)(logits[:, 0], rng, pos, temp)
+            return new_pools, nxt
+
+        # Prefill stays a SINGLE-ROW program (one slot's chunk per call):
+        # a fixed-capacity variant would pay the full ``capacity x chunk``
+        # forward even when one slot is refilling, and prefill compute —
+        # unlike the 1-token decode step — scales with every padded row.
+        # ``last_idx >= 0`` marks the final chunk; the first generated
+        # token is sampled from that in-chunk position's logits.
+        def prefill_impl(pools, tokens, p0, table, last_idx, rng, temp):
+            h, new_pools = model.apply(
+                {"params": params}, tokens, cache=pools, decode_pos=p0,
+                block_tables=table, return_hidden=True,
+            )
+            li = jnp.maximum(last_idx, 0)
+            # LM head at the sampled position ONLY: the other chunk
+            # rows' logits are never read, and a full (chunk, vocab)
+            # head matmul is a third of prefill compute.  Same manual
+            # fp32 head application as models.lm_loss_chunked.
+            hx = jax.lax.dynamic_slice_in_dim(h, li, 1, axis=1)
+            head = params["lm_head"]
+            logits = (
+                hx[0].astype(jnp.float32)
+                @ head["kernel"].astype(jnp.float32)
+                + head["bias"].astype(jnp.float32)
+            )
+            nxt = pick(logits[0], rng, p0 + li, temp)
+            return new_pools, nxt
+
+        self._step = jax.jit(step_impl, donate_argnums=(0,))
+        self._prefill = jax.jit(prefill_impl, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- slots
+    def seed_slot(self, slot: int, seed: int, temperature: float) -> None:
+        """Arm a slot's RNG base key + temperature (admission-time only)."""
+        # The key derivation itself (threefry seed hash) stays jax's so
+        # fold_in(base, position) matches any other PRNGKey(seed) user.
+        import jax
+
+        self.rng[slot] = np.asarray(jax.random.PRNGKey(seed), np.uint32)
+        self.temp[slot] = float(temperature)
+        self._rng_temp_dev = None
+
+    def _rng_temp(self):
+        import jax.numpy as jnp
+
+        if self._rng_temp_dev is None:
+            self._rng_temp_dev = (
+                jnp.asarray(self.rng), jnp.asarray(self.temp)
+            )
+        return self._rng_temp_dev
+
+    # ----------------------------------------------------------- prefill
+    def prefill(self, slot: int, chunk: np.ndarray, p0: int,
+                table: np.ndarray, last_idx: int = -1) -> Optional[int]:
+        """Run one prefill chunk for ``slot``.
+
+        ``chunk`` is one of the ``prefill_ladder`` geometries
+        (right-padded past the prompt — pad positions inside the slot's
+        allocated blocks are masked by ``valid_len`` until real tokens
+        overwrite them; pads past the allocation fall through the
+        zero-initialized tail of ``table`` into reserved parking block
+        0, which is never read).  ``last_idx >= 0`` marks the final
+        chunk: the first generated token is sampled from the logits at
+        that in-chunk index and returned.
+        """
+        import jax.numpy as jnp
+
+        if chunk.ndim != 1 or chunk.shape[0] not in self.prefill_ladder:
+            raise ValueError(
+                f"chunk must be 1-D with a ladder size "
+                f"{self.prefill_ladder}, got {chunk.shape}"
+            )
+        self.pools, tok = self._prefill(
+            self.pools,
+            jnp.asarray(chunk, jnp.int32)[None],
+            np.int32(p0),
+            jnp.asarray(table, jnp.int32)[None],
+            np.int32(last_idx),
+            self.rng[slot],
+            np.float32(self.temp[slot]),
+        )
+        return int(tok) if last_idx >= 0 else None
+
+    # ------------------------------------------------------------ decode
+    def step(self, tokens: np.ndarray, pos: np.ndarray,
+             tables: np.ndarray, active: np.ndarray) -> np.ndarray:
+        """One fixed-capacity decode iteration.
+
+        Args (all host arrays, shapes fixed by construction):
+          tokens: ``(capacity,)`` int32 — each slot's last token.
+          pos: ``(capacity,)`` int32 — each slot's current length (the
+            position this step writes).
+          tables: ``(capacity, max_blocks)`` int32 block tables.
+          active: ``(capacity,)`` bool — live slots.
+
+        Returns ``(capacity,)`` int32 sampled tokens (garbage at inactive
+        slots — callers must mask by ``active``).
+        """
+        import jax.numpy as jnp
+
+        rng, temp = self._rng_temp()
+        self.pools, nxt = self._step(
+            self.pools,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(active, bool),
+            rng, temp,
+        )
+        return np.asarray(nxt)
+
+    # ------------------------------------------------------- introspection
+    @property
+    def decode_compiles(self) -> int:
+        """Compiled-variant count of the decode step — the recompile
+        guard's subject: must stay 1 under arbitrary slot churn."""
+        return int(self._step._cache_size())
+
+    @property
+    def prefill_compiles(self) -> int:
+        return int(self._prefill._cache_size())
+
+    def free_blocks(self) -> int:
+        return self.pool.allocator.free_blocks
+
+    def alloc_blocks(self, n: int) -> Optional[List[int]]:
+        return self.pool.allocator.alloc(n)
+
+    def release_blocks(self, blocks) -> None:
+        self.pool.allocator.free(blocks)

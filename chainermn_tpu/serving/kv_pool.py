@@ -1,0 +1,144 @@
+"""Block-granular KV allocator over a fixed device-resident pool.
+
+The static-batch decode path (:func:`~chainermn_tpu.models.lm_generate`)
+sizes one contiguous ``(B, L, ...)`` cache to the LONGEST request and holds
+it for the whole batch — memory proportional to ``B · max_len`` even when
+most rows finished long ago.  The serving engine instead draws from one
+physical **block pool** per layer, laid out kv-head major exactly as the
+fused/paged decode kernels want it:
+
+    ``{"k", "v"}``:  ``(KH, num_blocks, block_len, Dh)``
+    ``{"k_scale", "v_scale"}`` (int8 pools): ``(KH, num_blocks, block_len)``
+
+A decode slot owns an ordered list of physical blocks (its *block table*);
+logical position ``p`` lives at ``(table[p // block_len], p % block_len)``.
+Blocks are recycled through a host-side free list the moment a request
+retires or is evicted — the next admission reuses them without touching the
+device (vLLM's PagedAttention memory model, Kwon et al. 2023).
+
+Accounting is **pure host state**: :class:`BlockAllocator` is a Python free
+list + owner set, so allocation/free decisions in the steady decode loop
+never read device memory and never force a sync.  The only device work is
+the engine's jitted step itself.
+
+Physical block 0 is reserved as the **parking block**: the paged decode
+branch redirects idle slots' scatter writes there (with their own current
+value, so duplicate indices carry duplicate values and the scatter stays
+deterministic — ``models/transformer.py``).  The allocator never hands it
+out.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+
+class PoolExhausted(RuntimeError):
+    """A request needs more blocks than the pool can ever provide."""
+
+
+class BlockAllocator:
+    """Host-side free-list accounting for the physical block pool.
+
+    No device syncs, ever: this is plain Python state.  Double-free and
+    foreign-block frees raise — silent accounting drift would surface
+    later as two slots scribbling over the same physical block.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (block 0 is reserved), got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        # LIFO free list: recently-freed blocks are re-issued first (their
+        # pool pages are the most likely to still be warm).
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._owned: set = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._owned)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` physical block ids, or ``None`` when the pool is exhausted
+        (the scheduler's backpressure/eviction signal — never raises)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._owned.update(out)
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b not in self._owned:
+                raise ValueError(
+                    f"freeing block {b} that was never allocated (double "
+                    "free or foreign id) — allocator state is corrupt"
+                )
+            self._owned.discard(b)
+            self._free.append(b)
+
+
+def blocks_for(tokens: int, block_len: int) -> int:
+    """Blocks needed to hold ``tokens`` positions."""
+    return max(1, math.ceil(tokens / block_len))
+
+
+class PagedKVPool:
+    """The device-resident pools (one ``{"k","v"[,scales]}`` dict per
+    layer) plus their :class:`BlockAllocator`.
+
+    Built from the model's own geometry so the pool entries are exactly
+    what :meth:`TransformerLM.__call__`'s paged decode branch expects.
+    ``kv_dtype=jnp.int8`` models get int8 pools with fp32 scale planes —
+    the same symmetric-absmax convention as the contiguous cache, at half
+    the bf16 pool bytes.
+    """
+
+    def __init__(self, model, num_blocks: int, block_len: int):
+        import jax.numpy as jnp
+
+        if block_len < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
+        kvh = model.n_kv_heads or model.n_heads
+        dh = model.d_model // model.n_heads
+        kvd = model.kv_dtype if model.kv_dtype is not None else model.dtype
+        shape = (kvh, num_blocks, block_len, dh)
+        self.block_len = block_len
+        self.num_blocks = num_blocks
+        self.allocator = BlockAllocator(num_blocks)
+        if jnp.dtype(kvd) == jnp.int8:
+            self.pools: List[Dict] = [
+                {"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "k_scale": jnp.zeros(shape[:3], jnp.float32),
+                 "v_scale": jnp.zeros(shape[:3], jnp.float32)}
+                for _ in range(model.n_layers)
+            ]
+            per_layer = 2 * kvh * block_len * (dh + 4)  # k+v int8 + scales
+        else:
+            if not jnp.issubdtype(jnp.dtype(kvd), jnp.floating):
+                raise ValueError(
+                    f"kv_dtype must be a float dtype or jnp.int8, got {kvd}"
+                )
+            self.pools = [
+                {"k": jnp.zeros(shape, kvd), "v": jnp.zeros(shape, kvd)}
+                for _ in range(model.n_layers)
+            ]
+            per_layer = 2 * kvh * block_len * dh * jnp.dtype(kvd).itemsize
+        #: HBM bytes one physical block costs across all layers.  Computed
+        #: from geometry, NOT the arrays: the engine donates the pool
+        #: buffers to its jitted step, so these initial arrays are deleted
+        #: after the first iteration.
+        self.bytes_per_block = int(per_layer * model.n_layers)

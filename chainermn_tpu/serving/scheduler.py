@@ -1,0 +1,486 @@
+"""Iteration-level scheduler: admission, interleaved prefill, eviction.
+
+Continuous batching (Yu et al. 2022, *Orca*): scheduling decisions happen
+every *iteration* (one engine decode step), not once per batch.  A request
+joins the running step the moment a slot and enough pool blocks free up,
+and leaves the instant it emits EOS or its token budget — the fixed-shape
+step never waits for stragglers the way a static ``lm_generate`` batch
+pads to its longest member.
+
+Loop shape (one :meth:`Scheduler.run` iteration):
+
+1. **Admit** — FIFO over arrived requests while a slot is free and the
+   allocator covers the first prefill chunk.
+2. **Prefill one chunk per prefilling slot** (oldest first; chunked so a
+   long prompt cannot stall running decodes for its whole length —
+   iteration-level interleave — while refilled slots rejoin the decode
+   step as fast as the chunking allows).
+3. **Decode step** for every live slot, then retire finished ones and
+   recycle their blocks.
+
+Backpressure: blocks are allocated lazily (per prefill chunk; one block
+per ``block_len`` decoded tokens).  When the pool is exhausted the
+scheduler **evicts the youngest-admitted slot** — its blocks return to the
+free list and the request re-queues at the FRONT carrying the tokens it
+already generated (recompute-style preemption: the re-admission prefills
+prompt + carried tokens and continues).  Evicting the youngest keeps the
+oldest requests' work; a request that cannot fit the pool even alone
+raises :class:`~chainermn_tpu.serving.kv_pool.PoolExhausted` at submit.
+
+Everything observable publishes into the PR-3 metrics registry
+(``serve.queue_depth``, ``serve.slot_occupancy``, ``serve.tokens``,
+``serve.prefill_ms``/``serve.decode_ms`` on the registry's FIXED default
+edges — the cross-rank merge contract holds).  Attribution caveat under
+async dispatch: only ops with a device readback are timed end-to-end —
+the decode step (token readback every iteration) and FINAL prefill
+chunks (first-token readback).  A non-final chunk's timing brackets
+just its dispatch; its compute drains into the next synced op, so after
+an admission wave ``serve.decode_ms`` absorbs the queued prefill work.
+Deliberate: forcing a readback per chunk to sharpen a histogram would
+add real latency to the admission path.
+
+The clock is injectable; the default counts real seconds from scheduler
+construction and can *skip* idle gaps (no busy-waiting between Poisson
+arrivals — benchmarks get open-loop arrival semantics with real measured
+service times).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from chainermn_tpu.serving.kv_pool import PoolExhausted, blocks_for
+
+
+@dataclass
+class Request:
+    """One generation request."""
+
+    id: int
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_token: Optional[int] = None
+    #: arrival time on the scheduler clock (0 = available immediately).
+    arrival: float = 0.0
+    #: per-request RNG lane seed (sampling only).
+    seed: int = 0
+
+
+@dataclass
+class Completion:
+    """A finished request: generated tokens + latency accounting.
+
+    ``first_admitted_at`` is when the request FIRST started service;
+    ``admitted_at`` is the final admission (they differ only when the
+    request was evicted and re-admitted — queueing delay is
+    ``first_admitted_at - arrival``, never ``admitted_at - arrival``,
+    which would book time already spent in service to the queue).
+    """
+
+    id: int
+    tokens: List[int]
+    reason: str  # "eos" | "length"
+    prompt_len: int
+    arrival: float
+    admitted_at: float
+    finished_at: float
+    evictions: int = 0
+    first_admitted_at: float = 0.0
+
+
+@dataclass
+class _QueueEntry:
+    req: Request
+    #: tokens generated before an eviction — re-prefilled and kept.
+    carried: List[int] = field(default_factory=list)
+    evictions: int = 0
+    #: when the request FIRST entered a slot (survives evictions).
+    first_admit: Optional[float] = None
+
+
+class _Slot:
+    def __init__(self, idx: int, entry: _QueueEntry, max_blocks: int,
+                 admit_time: float, admit_seq: int):
+        self.idx = idx
+        self.entry = entry
+        self.text = list(entry.req.prompt) + list(entry.carried)
+        self.table = np.zeros((max_blocks,), np.int32)
+        self.blocks: List[int] = []
+        self.pos = 0                    # positions prefilled so far
+        self.generated: List[int] = []  # this admission's new tokens
+        self.last_token: int = 0
+        self.prefilling = True
+        self.admit_time = admit_time
+        self.admit_seq = admit_seq
+
+    @property
+    def total_generated(self) -> int:
+        return len(self.entry.carried) + len(self.generated)
+
+
+class _NoopInstrument:
+    """Stand-in for registry instruments when observability is off."""
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+
+class _Clock:
+    """Real seconds since construction, with idle gaps skippable."""
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._skew = 0.0
+
+    def now(self) -> float:
+        return time.perf_counter() - self._t0 + self._skew
+
+    def skip_to(self, t: float) -> None:
+        delta = t - self.now()
+        if delta > 0:
+            self._skew += delta
+
+
+class Scheduler:
+    """Admission queue + iteration-level scheduling over a
+    :class:`~chainermn_tpu.serving.engine.DecodeEngine`."""
+
+    def __init__(self, engine, registry=None, clock: Optional[_Clock] = None):
+        import chainermn_tpu.observability as _obs
+        from chainermn_tpu.observability.metrics import (
+            DEFAULT_MS_EDGES,
+            registry as global_registry,
+        )
+
+        self.engine = engine
+        self.clock = clock or _Clock()
+        self._queue: List[_QueueEntry] = []
+        self._slots: List[Optional[_Slot]] = [None] * engine.capacity
+        self._admit_seq = 0
+        self.completions: List[Completion] = []
+        # An explicitly passed registry always publishes; the ambient
+        # global registry rides the CMN_OBS master switch like every
+        # other publisher (latched here, same as resilience/guard.py).
+        if registry is None and not _obs.enabled():
+            noop = _NoopInstrument()
+            self._m_queue = self._m_occ = self._m_tokens = noop
+            self._m_prefill = self._m_decode = noop
+            return
+        reg = registry if registry is not None else global_registry()
+        self._m_queue = reg.gauge("serve.queue_depth")
+        self._m_occ = reg.gauge("serve.slot_occupancy")
+        self._m_tokens = reg.counter("serve.tokens")
+        self._m_prefill = reg.histogram(
+            "serve.prefill_ms", edges=DEFAULT_MS_EDGES
+        )
+        self._m_decode = reg.histogram(
+            "serve.decode_ms", edges=DEFAULT_MS_EDGES
+        )
+
+    # ---------------------------------------------------------- admission
+    def submit(self, req: Request) -> None:
+        """Enqueue; raises :class:`PoolExhausted` if the request could
+        never fit the pool/slot geometry even running alone."""
+        plen = len(req.prompt)
+        if plen < 1:
+            raise ValueError(f"request {req.id}: empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(f"request {req.id}: max_new_tokens < 1")
+        eng = self.engine
+        cap = eng.max_blocks * eng.block_len
+        total = plen + req.max_new_tokens
+        # Worst-case prefill END over every possible (re-)admission: a
+        # slot prefills prompt + carried tokens (carried grows to
+        # max_new - 1 under eviction/recompute), full-size chunks while
+        # more than prefill_chunk remains, then the smallest ladder size
+        # covering the tail.  The padded tail must stay inside the block
+        # table (pad writes past it would clamp onto real blocks) and,
+        # for learned-pos models, inside the position table (the
+        # dynamic_slice would clamp and embed real tokens at wrong
+        # positions).  Rounding total up to a full prefill_chunk
+        # overstates this (the ladder tail is tighter) and would reject
+        # servable requests whenever the cap is not a chunk multiple.
+        worst_end = self._worst_prefill_end(plen, total - 1)
+        if total > cap or worst_end > cap:
+            raise PoolExhausted(
+                f"request {req.id}: {plen}+{req.max_new_tokens} tokens "
+                f"(worst padded prefill end {worst_end}) exceeds the "
+                f"per-slot cap {cap} (max_blocks={eng.max_blocks} x "
+                f"block_len={eng.block_len})"
+            )
+        if blocks_for(total, eng.block_len) > eng.pool.num_blocks - 1:
+            raise PoolExhausted(
+                f"request {req.id}: needs "
+                f"{blocks_for(total, eng.block_len)} blocks, pool has "
+                f"{eng.pool.num_blocks - 1} allocatable"
+            )
+        if eng.model.pos_enc == "learned" and worst_end > eng.model.max_len:
+            raise ValueError(
+                f"request {req.id}: worst padded prefill end {worst_end} "
+                f"exceeds the learned position table "
+                f"({eng.model.max_len}); use a rope model or shorter "
+                "requests"
+            )
+        self._queue.append(_QueueEntry(req))
+
+    def _worst_prefill_end(self, lo: int, hi: int) -> int:
+        """Max padded prefill end over admission text lengths in
+        ``[lo, hi]`` (prompt alone up to prompt + max_new - 1 carried).
+
+        For text length ``t``: full chunks cover ``t - t % C`` positions
+        (``C = prefill_chunk``), the tail pays the smallest ladder size
+        covering ``t % C``.  The end is residue-monotone in ``t``, so
+        scanning the top ``C`` lengths covers every residue's maximum —
+        O(prefill_chunk) per submit, host-side only.
+        """
+        ladder = self.engine.prefill_ladder
+        C = ladder[-1]
+        worst = 0
+        for t in range(max(lo, hi - C + 1), hi + 1):
+            r = t % C
+            end = t if r == 0 else t - r + next(
+                c for c in ladder if c >= r
+            )
+            worst = max(worst, end)
+        return worst
+
+    def _try_admit(self) -> bool:
+        if not self._queue:
+            return False
+        now = self.clock.now()
+        entry = self._queue[0]
+        if entry.req.arrival > now:
+            return False
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return False
+        text_len = len(entry.req.prompt) + len(entry.carried)
+        first = blocks_for(
+            min(self.engine.prefill_chunk, text_len),
+            self.engine.block_len,
+        )
+        if not self.engine.pool.allocator.can_alloc(first):
+            return False
+        self._queue.pop(0)
+        if entry.first_admit is None:
+            entry.first_admit = now
+        slot = _Slot(free[0], entry, self.engine.max_blocks, now,
+                     self._admit_seq)
+        self._admit_seq += 1
+        self._slots[free[0]] = slot
+        self.engine.seed_slot(free[0], entry.req.seed,
+                              entry.req.temperature)
+        return True
+
+    # ----------------------------------------------------------- eviction
+    def _evict_youngest(self) -> bool:
+        live = [s for s in self._slots if s is not None]
+        if not live:
+            return False
+        victim = max(live, key=lambda s: s.admit_seq)
+        self.engine.release_blocks(victim.blocks)
+        victim.entry.carried = (
+            list(victim.entry.carried) + list(victim.generated)
+        )
+        victim.entry.evictions += 1
+        self._queue.insert(0, victim.entry)
+        self._slots[victim.idx] = None
+        return True
+
+    def _alloc_for(self, slot: _Slot, n_needed: int) -> None:
+        """Grow ``slot`` to ``n_needed`` blocks, evicting under pressure."""
+        while len(slot.blocks) < n_needed:
+            if self._slots[slot.idx] is not slot:
+                # Already evicted — e.g. a co-slot's allocation earlier in
+                # the same step chose it as the youngest victim.  Growing
+                # it now would orphan the new blocks (the re-admission
+                # builds a fresh slot), i.e. leak pool memory.
+                return
+            got = self.engine.alloc_blocks(n_needed - len(slot.blocks))
+            if got is not None:
+                for b in got:
+                    slot.table[len(slot.blocks)] = b
+                    slot.blocks.append(b)
+                return
+            # Pool exhausted: evict the youngest slot (possibly `slot`
+            # itself — then this allocation is moot) and retry.
+            victim_was_self = (
+                self._slots[slot.idx] is slot
+                and max(
+                    (s.admit_seq for s in self._slots if s is not None),
+                ) == slot.admit_seq
+            )
+            if victim_was_self and sum(
+                s is not None for s in self._slots
+            ) == 1:
+                raise PoolExhausted(
+                    f"request {slot.entry.req.id} cannot fit the pool "
+                    "even running alone — grow num_blocks"
+                )
+            self._evict_youngest()
+            if self._slots[slot.idx] is not slot:
+                return  # the needy slot evicted itself; re-queued
+
+    # ------------------------------------------------------------ prefill
+    def _prefill_round(self) -> bool:
+        """One chunk for EVERY currently-prefilling slot (oldest first).
+
+        One chunk per slot per iteration keeps the interleave bound — a
+        long prompt still cannot stall running decodes for its whole
+        length — while refilled slots rejoin the decode step as fast as
+        the chunking allows.  Prefilling only one slot per iteration
+        would serialize re-admissions: after a near-simultaneous batch of
+        retirements (common when similar-length requests were admitted
+        together), the decode step would run under-occupied for several
+        extra iterations.
+        """
+        progressed = False
+        for slot in sorted(
+            (s for s in self._slots if s is not None and s.prefilling),
+            key=lambda s: s.admit_seq,
+        ):
+            if self._slots[slot.idx] is not slot:
+                continue  # evicted by an earlier candidate's allocation
+            progressed = self._prefill_chunk(slot) or progressed
+        return progressed
+
+    def _prefill_chunk(self, slot: _Slot) -> bool:
+        eng = self.engine
+        p0 = slot.pos
+        # Ladder policy: full-size chunks while more than prefill_chunk
+        # tokens remain, then the smallest ladder geometry covering the
+        # tail — one final call with minimal padded compute instead of a
+        # full prefill_chunk of mostly-pad forward.
+        remaining = len(slot.text) - p0
+        ladder = eng.prefill_ladder
+        if remaining >= ladder[-1]:
+            size = ladder[-1]
+        else:
+            size = next(c for c in ladder if c >= remaining)
+        end = min(p0 + size, len(slot.text))
+        self._alloc_for(slot, blocks_for(end, eng.block_len))
+        if self._slots[slot.idx] is not slot:
+            return True  # evicted itself under pressure; progress made
+        chunk = np.zeros((size,), np.int32)
+        chunk[: end - p0] = slot.text[p0:end]
+        last = end == len(slot.text)
+        t0 = time.perf_counter()
+        tok = eng.prefill(
+            slot.idx, chunk, p0, slot.table,
+            last_idx=(end - p0 - 1) if last else -1,
+        )
+        self._m_prefill.observe((time.perf_counter() - t0) * 1e3)
+        slot.pos = end
+        if last:
+            slot.prefilling = False
+            self._emit(slot, int(tok))
+        return True
+
+    # ------------------------------------------------------------- decode
+    def _decode_step(self) -> bool:
+        live = [
+            s for s in self._slots if s is not None and not s.prefilling
+        ]
+        if not live:
+            return False
+        S = self.engine.capacity
+        tokens = np.zeros((S,), np.int32)
+        pos = np.zeros((S,), np.int32)
+        tables = np.zeros((S, self.engine.max_blocks), np.int32)
+        active = np.zeros((S,), bool)
+        for s in live:
+            # The step writes position `pos` — make sure its block exists.
+            self._alloc_for(
+                s, blocks_for(s.pos + 1, self.engine.block_len)
+            )
+        live = [
+            s for s in self._slots if s is not None and not s.prefilling
+        ]
+        if not live:
+            return True  # everything evicted itself; still progress
+        for s in live:
+            tokens[s.idx] = s.last_token
+            pos[s.idx] = s.pos
+            tables[s.idx] = s.table
+            active[s.idx] = True
+        t0 = time.perf_counter()
+        out = self.engine.step(tokens, pos, tables, active)
+        self._m_decode.observe((time.perf_counter() - t0) * 1e3)
+        for s in live:
+            s.pos += 1
+            self._emit(s, int(out[s.idx]))
+        return True
+
+    def _emit(self, slot: _Slot, tok: int) -> None:
+        """Account one generated token; retire the slot when done."""
+        self._m_tokens.inc()
+        slot.generated.append(tok)
+        slot.last_token = tok
+        req = slot.entry.req
+        reason = None
+        if req.eos_token is not None and tok == req.eos_token:
+            reason = "eos"
+        elif slot.total_generated >= req.max_new_tokens:
+            reason = "length"
+        if reason is None:
+            return
+        self.engine.release_blocks(slot.blocks)
+        self._slots[slot.idx] = None
+        self.completions.append(Completion(
+            id=req.id,
+            tokens=list(slot.entry.carried) + list(slot.generated),
+            reason=reason,
+            prompt_len=len(req.prompt),
+            arrival=req.arrival,
+            admitted_at=slot.admit_time,
+            finished_at=self.clock.now(),
+            evictions=slot.entry.evictions,
+            first_admitted_at=slot.entry.first_admit,
+        ))
+
+    # --------------------------------------------------------------- run
+    def run(self, requests: Optional[Sequence[Request]] = None
+            ) -> List[Completion]:
+        """Submit ``requests`` (optional) and drain queue + slots."""
+        for r in requests or ():
+            self.submit(r)
+        while self._queue or any(s is not None for s in self._slots):
+            progressed = False
+            while self._try_admit():
+                progressed = True
+            if self._prefill_round():
+                progressed = True
+            if self._decode_step():
+                progressed = True
+            self._m_queue.set(len(self._queue))
+            self._m_occ.set(
+                sum(s is not None for s in self._slots)
+                / self.engine.capacity
+            )
+            if not progressed:
+                if not any(s is not None for s in self._slots):
+                    # Idle: jump the clock to the HEAD entry's arrival —
+                    # admission is strictly FIFO, so the head is the only
+                    # entry whose arrival can unblock anything; skipping
+                    # to a later entry's earlier arrival would leave the
+                    # loop spinning until the head's time on the real
+                    # clock.
+                    self.clock.skip_to(self._queue[0].req.arrival)
+                else:  # pragma: no cover - defensive
+                    raise RuntimeError(
+                        "scheduler made no progress with live slots"
+                    )
+        self._m_queue.set(0)
+        self._m_occ.set(0.0)
+        return list(self.completions)

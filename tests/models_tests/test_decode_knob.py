@@ -1,0 +1,94 @@
+"""``TransformerLM(decode_attention="fused")`` parity with the einsum path.
+
+The knob swaps the decode cache to the kv-head-major layout and routes
+single-token steps through the Pallas kernel
+(:func:`~chainermn_tpu.ops.fused_decode_attention`) — greedy generation
+must be TOKEN-identical to the default einsum cache path on every decode
+configuration the model supports: MHA and GQA, ragged right-padded
+prompts, the int8 quantized cache, and the sliding-window einsum
+fallback.  Any drift means the kernel wiring changed semantics, not just
+layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu.models import TransformerLM, lm_generate
+
+pytestmark = pytest.mark.tier1
+
+KW = dict(
+    vocab=128, n_layers=2, d_model=64, n_heads=4, d_ff=128, max_len=96,
+    dtype=jnp.float32, pos_enc="rope",
+)
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randint(1, 128, size=(3, 12)).astype(np.int32))
+
+
+def _pair(**over):
+    """(einsum model, fused model, shared params) for one config.
+
+    Params must come from the config's own einsum model — GQA/int8
+    variants change the parameter tree, and the knob itself must not
+    (same weights drive both paths)."""
+    merged = {**KW, **over}
+    m_e = TransformerLM(**merged)
+    m_f = TransformerLM(decode_attention="fused", **merged)
+    params = m_e.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 12), jnp.int32)
+    )["params"]
+    return m_e, m_f, params
+
+
+@pytest.mark.parametrize(
+    "over",
+    [
+        {},                      # MHA, full attention -> fused kernel
+        {"n_kv_heads": 2},       # GQA grouped panel reads
+        {"kv_dtype": jnp.int8},  # quantized cache + scale planes
+        {"window": 8},           # sliding window -> einsum fallback branch
+    ],
+    ids=["mha", "gqa", "int8", "window"],
+)
+def test_fused_knob_greedy_token_identical(prompt, over):
+    m_e, m_f, params = _pair(**over)
+    t_e = np.asarray(lm_generate(m_e, params, prompt, 16))
+    t_f = np.asarray(lm_generate(m_f, params, prompt, 16))
+    np.testing.assert_array_equal(t_e, t_f)
+
+
+def test_fused_knob_ragged_prompts(prompt):
+    m_e, m_f, params = _pair(n_kv_heads=2)
+    lens = jnp.asarray([5, 12, 9], jnp.int32)
+    t_e = np.asarray(
+        lm_generate(m_e, params, prompt, 12, prompt_lengths=lens)
+    )
+    t_f = np.asarray(
+        lm_generate(m_f, params, prompt, 12, prompt_lengths=lens)
+    )
+    np.testing.assert_array_equal(t_e, t_f)
+
+
+def test_fused_cache_layout_is_kv_head_major():
+    m_e, m_f, _ = _pair(n_kv_heads=2)
+    ce = m_e.init_cache(batch=3, max_len=32)[0]
+    cf = m_f.init_cache(batch=3, max_len=32)[0]
+    assert ce["k"].shape == (3, 32, 2, 16)   # (B, L, KH, Dh)
+    assert cf["k"].shape == (3, 2, 32, 16)   # (B, KH, L, Dh)
+
+
+def test_rolling_requires_einsum(prompt):
+    _, m_f, params = _pair(window=8)
+    with pytest.raises(ValueError, match="rolling"):
+        lm_generate(m_f, params, prompt, 8, rolling=True)
+
+
+def test_bad_knob_rejected():
+    with pytest.raises(ValueError, match="decode_attention"):
+        TransformerLM(decode_attention="pallas", **KW).init_cache(1)

@@ -1,0 +1,57 @@
+"""Shared tiny-model fixtures for the serving tier.
+
+One model/params pair per session: every test in this directory runs the
+same 2-layer GQA RoPE geometry so jit compiles amortize across files (the
+tier-1 budget is the binding constraint, not coverage).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+_MODEL_KW = dict(
+    vocab=128, n_layers=2, d_model=64, n_heads=4, d_ff=128, max_len=96,
+    dtype=jnp.float32, n_kv_heads=2, pos_enc="rope",
+)
+
+
+@pytest.fixture(scope="session")
+def model_kw():
+    return dict(_MODEL_KW)
+
+
+@pytest.fixture(scope="session")
+def make_model(model_kw):
+    """Factory: a TransformerLM on the shared geometry, with overrides."""
+    from chainermn_tpu.models import TransformerLM
+
+    def build(**over):
+        return TransformerLM(**{**model_kw, **over})
+
+    return build
+
+
+@pytest.fixture(scope="session")
+def tiny_params(make_model):
+    return make_model().init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 12), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="session")
+def prompts():
+    rng = np.random.RandomState(0)
+    return [rng.randint(1, 128, size=n).tolist() for n in (5, 12, 9, 3, 17)]
+
+
+@pytest.fixture(scope="session")
+def oracle():
+    """Per-request sequential greedy reference."""
+    from chainermn_tpu.models import lm_generate
+
+    def run(model, params, prompt, n_new):
+        pr = jnp.asarray(np.asarray(prompt, np.int32))[None]
+        return np.asarray(lm_generate(model, params, pr, n_new))[0].tolist()
+
+    return run

@@ -1,0 +1,177 @@
+"""DecodeEngine: the recompile guard and the continuous-batching oracle.
+
+The two acceptance-critical properties of the serving engine:
+
+1. **Zero steady-state recompiles** — the jitted decode step's compiled-
+   variant count stays at exactly 1 under arbitrary slot churn (requests
+   finishing and being admitted at different lengths).  A second variant
+   means some input's shape/dtype varied with occupancy, i.e. the fixed-
+   shape contract broke and every admission would pay a compile.
+2. **Greedy token identity** — continuous-batched output for every request
+   equals a per-request sequential :func:`lm_generate` run.  Interleaving,
+   chunked prefill, block-table indirection and the parked writes of idle
+   slots must be invisible in the tokens.
+"""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.serving import DecodeEngine, Request, Scheduler
+
+pytestmark = [pytest.mark.tier1, pytest.mark.serving]
+
+
+@pytest.fixture(scope="module")
+def fused_engine_run(make_model, tiny_params, prompts):
+    """One churny continuous-batching run on the fused engine, shared by
+    the recompile guard and the oracle test (compiles amortize)."""
+    model = make_model(decode_attention="fused")
+    eng = DecodeEngine(
+        model, tiny_params, capacity=3, num_blocks=24, block_len=8,
+        prefill_chunk=8,
+    )
+    sched = Scheduler(eng)
+    # 5 requests through 3 slots with mixed prompt lengths (5..17): slots
+    # retire and re-admit at different positions — the churn the guard is
+    # about.
+    comps = sched.run([
+        Request(id=i, prompt=p, max_new_tokens=10)
+        for i, p in enumerate(prompts)
+    ])
+    return model, eng, comps
+
+
+def test_steady_state_compiles_exactly_once(fused_engine_run):
+    _, eng, comps = fused_engine_run
+    assert len(comps) == 5
+    assert eng.decode_compiles == 1, (
+        f"decode step compiled {eng.decode_compiles} variants — slot "
+        "churn changed a traced shape/dtype"
+    )
+    assert eng.prefill_ladder == (8,)
+    assert eng.prefill_compiles == 1, (
+        f"prefill compiled {eng.prefill_compiles} variants — chunk "
+        "geometries must come from the fixed ladder"
+    )
+
+
+def test_continuous_batching_matches_sequential_greedy(
+    fused_engine_run, tiny_params, prompts, oracle
+):
+    model, _, comps = fused_engine_run
+    assert sorted(c.id for c in comps) == list(range(5))
+    for c in comps:
+        want = oracle(model, tiny_params, prompts[c.id], 10)
+        assert c.tokens == want, (c.id, c.tokens, want)
+        assert c.reason == "length"
+
+
+def test_all_blocks_recycled_after_drain(fused_engine_run):
+    _, eng, _ = fused_engine_run
+    assert eng.free_blocks() == eng.pool.num_blocks - 1
+
+
+def test_einsum_engine_same_tokens(make_model, tiny_params, prompts, oracle):
+    """decode_attention='einsum' engines run the gathered fallback in the
+    hot loop — same tokens, same zero-recompile contract."""
+    model = make_model()  # einsum default
+    eng = DecodeEngine(
+        model, tiny_params, capacity=2, num_blocks=24, block_len=8,
+        prefill_chunk=8,
+    )
+    comps = Scheduler(eng).run([
+        Request(id=i, prompt=prompts[i], max_new_tokens=6)
+        for i in range(3)
+    ])
+    for c in comps:
+        assert c.tokens == oracle(model, tiny_params, prompts[c.id], 6)
+    assert eng.decode_compiles == 1
+
+
+def test_int8_paged_engine_matches_sequential_greedy(
+    make_model, prompts, oracle
+):
+    """int8 KV pools: the quant branches of the paged scatter and of both
+    decode paths (the Pallas kernel's in-register dequant and the gathered
+    einsum fallback) are greedy-identical to the same int8 model's
+    contiguous-cache lm_generate.  The fp32-pool tests never touch these
+    branches — without this oracle a quant-scatter regression would pass
+    tier-1 silently."""
+    import jax
+    import jax.numpy as jnp
+
+    for attn in ("fused", "einsum"):
+        model = make_model(kv_dtype=jnp.int8, decode_attention=attn)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 12), jnp.int32)
+        )["params"]
+        eng = DecodeEngine(
+            model, params, capacity=2, num_blocks=24, block_len=8,
+            prefill_chunk=8,
+        )
+        comps = Scheduler(eng).run([
+            Request(id=i, prompt=prompts[i], max_new_tokens=6)
+            for i in range(3)
+        ])
+        for c in comps:
+            want = oracle(model, params, prompts[c.id], 6)
+            assert c.tokens == want, (attn, c.id, c.tokens, want)
+        assert eng.decode_compiles == 1, attn
+
+
+def test_sampling_deterministic_per_seed(make_model, tiny_params, prompts):
+    """Per-slot RNG lanes: same seeds -> same tokens across runs, and the
+    lanes are independent of admission order/slot placement."""
+    model = make_model(decode_attention="fused")
+
+    def run():
+        eng = DecodeEngine(
+            model, tiny_params, capacity=2, num_blocks=24, block_len=8,
+            prefill_chunk=8,
+        )
+        comps = Scheduler(eng).run([
+            Request(id=i, prompt=prompts[i], max_new_tokens=6,
+                    temperature=0.8, seed=42 + i)
+            for i in range(3)
+        ])
+        return {c.id: c.tokens for c in comps}
+
+    assert run() == run()
+
+
+def test_top_1_sampling_equals_greedy(make_model, tiny_params, prompts,
+                                      oracle):
+    """top_k=1 with temperature > 0 collapses to argmax: only the top
+    logit survives the truncation threshold, so categorical sampling has
+    one choice.  Pins the k-th-largest threshold math in the jitted
+    sampling branch."""
+    model = make_model()
+    eng = DecodeEngine(
+        model, tiny_params, capacity=2, num_blocks=24, block_len=8,
+        prefill_chunk=8, top_k=1,
+    )
+    comps = Scheduler(eng).run([
+        Request(id=i, prompt=prompts[i], max_new_tokens=6,
+                temperature=0.9, seed=7 + i)
+        for i in range(2)
+    ])
+    for c in comps:
+        assert c.tokens == oracle(model, tiny_params, prompts[c.id], 6)
+
+
+def test_prefill_rejects_wrong_chunk_shape(make_model, tiny_params):
+    eng = DecodeEngine(
+        make_model(), tiny_params, capacity=1, num_blocks=8, block_len=8,
+        prefill_chunk=8,
+    )
+    with pytest.raises(ValueError, match="chunk"):
+        eng.prefill(0, np.zeros((4,), np.int32), 0,
+                    np.zeros((12,), np.int32))
+
+
+def test_engine_validates_construction(make_model, tiny_params):
+    with pytest.raises(ValueError, match="capacity"):
+        DecodeEngine(make_model(), tiny_params, capacity=0, num_blocks=8)
+    with pytest.raises(ValueError, match="top_k"):
+        DecodeEngine(make_model(), tiny_params, capacity=1, num_blocks=8,
+                     top_k=-1)

@@ -1,0 +1,274 @@
+"""Scheduler: eviction/recompute correctness, retirement, backpressure,
+and the ``serve.*`` metrics contract.
+
+The hard case is eviction: a pool too small for the working set forces
+the youngest slot out mid-generation, its blocks recycle, and the request
+re-admits carrying its generated-so-far tokens.  Greedy decode is
+deterministic, so the recompute must land on the exact same continuation
+— the completion tokens stay identical to an uncontended sequential run.
+"""
+
+import pytest
+
+from chainermn_tpu.observability import MetricsRegistry
+from chainermn_tpu.observability.metrics import DEFAULT_MS_EDGES
+from chainermn_tpu.serving import (
+    DecodeEngine,
+    PoolExhausted,
+    Request,
+    Scheduler,
+)
+
+pytestmark = [pytest.mark.tier1, pytest.mark.serving]
+
+
+@pytest.fixture(scope="module")
+def contended_run(make_model, tiny_params, prompts):
+    """4 requests through 3 slots over a 7-allocatable-block pool: the
+    working set cannot fit, so evictions are guaranteed."""
+    model = make_model()
+    eng = DecodeEngine(
+        model, tiny_params, capacity=3, num_blocks=8, block_len=8,
+        prefill_chunk=8,
+    )
+    reg = MetricsRegistry()
+    sched = Scheduler(eng, registry=reg)
+    comps = sched.run([
+        Request(id=i, prompt=prompts[i], max_new_tokens=14)
+        for i in range(4)
+    ])
+    return model, eng, reg, comps
+
+
+def test_eviction_recompute_token_identical(
+    contended_run, tiny_params, prompts, oracle
+):
+    model, eng, _, comps = contended_run
+    assert sum(c.evictions for c in comps) > 0, (
+        "pool sized to force evictions saw none — the backpressure path "
+        "went untested"
+    )
+    for c in comps:
+        assert c.tokens == oracle(model, tiny_params, prompts[c.id], 14)
+    assert eng.free_blocks() == eng.pool.num_blocks - 1
+
+
+def test_serve_metrics_published_with_fixed_edges(contended_run):
+    """The PR-3 cross-rank merge contract: serving histograms use the
+    registry's DEFAULT edges, and the full serve.* catalog is present."""
+    _, _, reg, comps = contended_run
+    snap = reg.snapshot()
+    assert snap["serve.tokens"]["type"] == "counter"
+    # One count per generated token, prefill-sampled ones included; an
+    # eviction's carried tokens were counted when first emitted and are
+    # not re-counted on recompute, so equality is exact.
+    assert snap["serve.tokens"]["value"] == sum(
+        len(c.tokens) for c in comps
+    )
+    assert snap["serve.queue_depth"]["type"] == "gauge"
+    assert snap["serve.queue_depth"]["value"] == 0  # drained
+    assert snap["serve.slot_occupancy"]["value"] == 0.0
+    for h in ("serve.prefill_ms", "serve.decode_ms"):
+        assert snap[h]["type"] == "histogram"
+        assert tuple(snap[h]["edges"]) == tuple(DEFAULT_MS_EDGES)
+        assert snap[h]["count"] > 0
+
+
+def test_cmn_obs_off_skips_global_registry(
+    make_model, tiny_params, prompts
+):
+    """With the master switch off, a Scheduler built WITHOUT an explicit
+    registry must not touch the global registry (the CMN_OBS contract
+    every other publisher latches); an explicit registry still publishes
+    (caller intent beats the ambient switch)."""
+    import chainermn_tpu.observability as obs
+    from chainermn_tpu.observability.metrics import registry as global_reg
+
+    eng = DecodeEngine(
+        make_model(), tiny_params, capacity=2, num_blocks=24, block_len=8,
+        prefill_chunk=8,
+    )
+    before = global_reg().snapshot().get("serve.tokens", {}).get("value", 0)
+    obs.set_enabled(False)
+    try:
+        Scheduler(eng).run(
+            [Request(id=0, prompt=prompts[0], max_new_tokens=4)]
+        )
+        after = global_reg().snapshot().get("serve.tokens", {}).get(
+            "value", 0
+        )
+        assert after == before, "CMN_OBS=0 scheduler leaked serve.* samples"
+        explicit = MetricsRegistry()
+        Scheduler(eng, registry=explicit).run(
+            [Request(id=1, prompt=prompts[1], max_new_tokens=4)]
+        )
+        assert explicit.snapshot()["serve.tokens"]["value"] == 4
+    finally:
+        obs.set_enabled(None)
+
+
+def test_eos_retires_early(make_model, tiny_params, prompts, oracle):
+    model = make_model()
+    eng = DecodeEngine(
+        model, tiny_params, capacity=2, num_blocks=24, block_len=8,
+        prefill_chunk=8,
+    )
+    g = oracle(model, tiny_params, prompts[0], 14)
+    eos = g[-1]
+    stop = g.index(eos) + 1
+    comps = Scheduler(eng).run([
+        Request(id=0, prompt=prompts[0], max_new_tokens=14, eos_token=eos)
+    ])
+    assert comps[0].reason == "eos"
+    assert comps[0].tokens == g[:stop]
+    assert eng.free_blocks() == eng.pool.num_blocks - 1
+
+
+def test_submit_rejects_never_fitting_requests(make_model, tiny_params):
+    eng = DecodeEngine(
+        make_model(), tiny_params, capacity=2, num_blocks=8, block_len=8,
+        prefill_chunk=8,
+    )
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    # Exceeds the per-slot block-table cap.
+    with pytest.raises(PoolExhausted, match="per-slot cap"):
+        sched.submit(Request(id=0, prompt=list(range(1, 60)),
+                             max_new_tokens=200))
+    # Fits a slot's table but not the 7-block pool.
+    eng2 = DecodeEngine(
+        make_model(), tiny_params, capacity=2, num_blocks=4, block_len=8,
+        max_blocks_per_slot=12, prefill_chunk=8,
+    )
+    with pytest.raises(PoolExhausted, match="pool has"):
+        Scheduler(eng2, registry=MetricsRegistry()).submit(
+            Request(id=1, prompt=list(range(1, 30)), max_new_tokens=10)
+        )
+    with pytest.raises(ValueError, match="empty prompt"):
+        sched.submit(Request(id=2, prompt=[], max_new_tokens=4))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        sched.submit(Request(id=3, prompt=[1, 2], max_new_tokens=0))
+
+
+def test_learned_pos_enc_length_guard(make_model, tiny_params, model_kw):
+    """A learned-position model must reject requests past its table; rope
+    models take them (the serving cap is the block table, not max_len)."""
+    model = make_model(pos_enc="learned")
+    eng = DecodeEngine(
+        model, tiny_params, capacity=1, num_blocks=32, block_len=8,
+        max_blocks_per_slot=16, prefill_chunk=8,
+    )
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    too_long = model_kw["max_len"] + 1
+    with pytest.raises(ValueError, match="position table"):
+        sched.submit(Request(id=0, prompt=[1] * (too_long - 4),
+                             max_new_tokens=8))
+
+
+def test_learned_pos_rejects_padded_prefill_overhang(
+    make_model, tiny_params
+):
+    """The learned-pos bound is the worst PADDED prefill end: a request
+    whose total fits the position table but whose final padded chunk
+    overhangs it must be rejected at submit — dynamic_slice would clamp
+    the position slice and embed the chunk's real tokens at wrong
+    positions (silently wrong K/V, diverging tokens)."""
+    kw = dict(
+        capacity=1, num_blocks=32, block_len=8, max_blocks_per_slot=16,
+        prefill_chunk=32,
+    )
+    # total 86 <= max_len 90, but the tail chunk at p0=64 (remaining
+    # 17..21 over admission lengths 81..85) pays ladder size 32 -> the
+    # prefill runs positions 64..95, past the 90-entry table.
+    req = dict(id=0, prompt=[1] * 81, max_new_tokens=5)
+    learned = Scheduler(
+        DecodeEngine(
+            make_model(pos_enc="learned", max_len=90), tiny_params, **kw
+        ),
+        registry=MetricsRegistry(),
+    )
+    with pytest.raises(ValueError, match="position table"):
+        learned.submit(Request(**req))
+    # The same geometry on a rope model is fine (no position table).
+    Scheduler(
+        DecodeEngine(make_model(max_len=90), tiny_params, **kw),
+        registry=MetricsRegistry(),
+    ).submit(Request(**req))
+
+
+def test_submit_bound_is_exact_not_chunk_rounded(
+    make_model, tiny_params, oracle
+):
+    """The cap check uses the worst LADDER-tail end, not total rounded up
+    to a full prefill_chunk: with cap 72 and prefill_chunk 32, a
+    33+37-token request (worst tail end 64+8 = 72, exactly inside the
+    table; naive round-up 96 > 72) must be accepted AND run to its full
+    budget."""
+    model = make_model()
+    eng = DecodeEngine(
+        model, tiny_params, capacity=1, num_blocks=24, block_len=8,
+        max_blocks_per_slot=9, prefill_chunk=32,
+    )
+    prompt = list(range(1, 34))
+    comps = Scheduler(eng, registry=MetricsRegistry()).run([
+        Request(id=0, prompt=prompt, max_new_tokens=37),
+    ])
+    assert comps[0].reason == "length"
+    assert comps[0].tokens == oracle(model, tiny_params, prompt, 37)
+
+
+def test_arrivals_respected(make_model, tiny_params, prompts, oracle):
+    """A request with a future arrival is not admitted before its time;
+    the idle scheduler jumps its clock rather than busy-spinning."""
+    model = make_model()
+    eng = DecodeEngine(
+        model, tiny_params, capacity=2, num_blocks=24, block_len=8,
+        prefill_chunk=8,
+    )
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    comps = sched.run([
+        Request(id=0, prompt=prompts[0], max_new_tokens=4, arrival=1e4),
+    ])
+    assert comps[0].admitted_at >= 1e4
+    assert comps[0].tokens == oracle(model, tiny_params, prompts[0], 4)
+
+
+def test_out_of_order_arrivals_skip_to_head(make_model, tiny_params,
+                                            prompts, oracle):
+    """Admission is strictly FIFO, so the idle skip must target the HEAD
+    entry's arrival: with a later-arriving head in front of an
+    earlier-arriving entry, skipping to min(arrival) would be a no-op
+    once the clock passed it and the loop would busy-spin until the
+    head's time on the real clock (livelock under a clock that only
+    advances via skip_to — exactly this fake)."""
+
+    class _SkipOnlyClock:
+        def __init__(self):
+            self.t = 0.0
+            self.calls = 0
+
+        def now(self):
+            self.calls += 1
+            assert self.calls < 100_000, (
+                "scheduler busy-spinning: idle skip never reached the "
+                "head entry's arrival"
+            )
+            return self.t
+
+        def skip_to(self, t):
+            self.t = max(self.t, t)
+
+    model = make_model()
+    eng = DecodeEngine(
+        model, tiny_params, capacity=1, num_blocks=24, block_len=8,
+        prefill_chunk=8,
+    )
+    clock = _SkipOnlyClock()
+    sched = Scheduler(eng, registry=MetricsRegistry(), clock=clock)
+    comps = sched.run([
+        Request(id=0, prompt=prompts[0], max_new_tokens=4, arrival=10.0),
+        Request(id=1, prompt=prompts[1], max_new_tokens=4, arrival=1.0),
+    ])
+    by_id = {c.id: c for c in comps}
+    assert by_id[0].admitted_at >= 10.0
+    assert by_id[0].tokens == oracle(model, tiny_params, prompts[0], 4)
+    assert by_id[1].tokens == oracle(model, tiny_params, prompts[1], 4)
