@@ -214,6 +214,13 @@ def _serving_headline() -> dict | None:
             "decode_compiles": cont.get("decode_compiles"),
             "capacity": rec.get("capacity"),
             "config": rec.get("config"),
+            # Serving-plane observability A/B (ISSUE 6): the default-on
+            # serve.*/SLO/timeline stack's tokens/s cost and the SLO
+            # monitor's p95 snapshot, when the artifact carries them.
+            "serving_obs_overhead_pct": rec.get(
+                "observability", {}
+            ).get("overhead_pct"),
+            "slo_p95_ms": rec.get("observability", {}).get("slo_p95_ms"),
         }
 
     return _best_result("serving*.json", cands)

@@ -23,6 +23,12 @@ has arrived and batch i-1 is done) — the same idle-skipping semantics the
 scheduler's clock gives the continuous arm, so neither arm pays
 real-world sleeps.
 
+An observability A/B (short drain-mode passes, alternating the
+serve.*/SLO/timeline stack off and on, median of per-pair ratios)
+proves the ISSUE 6 overhead contract (< 1% tokens/s), and
+``--trace-out`` exports the obs-on traffic pass's request timeline as
+Perfetto-loadable Chrome trace JSON.
+
     python benchmarks/serving.py --out result/serving_tpu.json  # real chip
     JAX_PLATFORMS=cpu python benchmarks/serving.py --smoke      # plumbing
 """
@@ -93,8 +99,18 @@ def main():
                          "least-contended (fastest) pass — both arms' "
                          "phases are seconds-long, so a background blip "
                          "on the host otherwise decides the comparison")
+    ap.add_argument("--obs-pairs", type=int, default=0,
+                    help="alternating obs-on/obs-off pass pairs for the "
+                         "observability-overhead estimate (0 = same as "
+                         "--repeats).  The stack's cost (~0.4% profiled) "
+                         "sits below per-pass host noise (±2% even on "
+                         "an idle shared host), so the median needs "
+                         "several pairs to resolve the <1% contract")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--trace-out", default=None,
+                    help="also export the obs-on arm's request timeline "
+                         "as Chrome trace-event JSON (Perfetto-loadable)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -133,7 +149,7 @@ def main():
             requests=48, batch=8, prompt_min=8, prompt_max=48,
             new_min=4, new_max=64, layers=4, d_model=512, heads=8,
             d_ff=1024, vocab=4096, block_len=8, prefill_chunk=16,
-            repeats=4,
+            repeats=4, obs_pairs=12,
         )
         for k, v in smoke_over.items():
             if getattr(args, k) == ap.get_default(k):
@@ -303,17 +319,77 @@ def main():
         Request(id=-(i + 1), prompt=[1] * c, max_new_tokens=2)
         for i, c in enumerate(eng.prefill_ladder)
     ])
-    comps, cont_makespan = None, float("inf")
-    for _ in range(repeats):
-        sched = Scheduler(eng)
-        cs = sched.run(reqs)
-        span = (
-            max(c.finished_at for c in cs)
-            - min(c.arrival for c in cs)
-        )
-        if span < cont_makespan:
-            comps, cont_makespan = cs, span
+
+    # Headline continuous arm — observability ON, the shipped default
+    # (serve.* metrics, SLO monitor, request timeline, flight provider).
+    from chainermn_tpu import observability as obs
+
+    obs.set_enabled(True)
+    try:
+        comps, sched_on, cont_makespan = None, None, float("inf")
+        for _ in range(repeats):
+            sched = Scheduler(eng)
+            cs = sched.run(reqs)
+            span = (
+                max(c.finished_at for c in cs)
+                - min(c.arrival for c in cs)
+            )
+            if span < cont_makespan:
+                comps, sched_on, cont_makespan = cs, sched, span
+    finally:
+        obs.set_enabled(None)
     cont_tps = useful_tokens / cont_makespan
+    if args.trace_out:
+        sched_on.export_trace(args.trace_out)
+        print(f"# chrome trace -> {args.trace_out} "
+              f"(load at ui.perfetto.dev)", flush=True)
+
+    # Observability-overhead A/B (ISSUE 6 <1% contract).  Deliberately
+    # NOT measured on the traffic simulation above: its seconds-long
+    # passes are long enough that one background-contention burst on a
+    # shared host lands a whole pass ±10% — far above the stack's
+    # profiled self-time (<0.5%).  Instead: short DRAIN-mode passes
+    # (every request available at t=0, so the arrival process adds no
+    # variance), alternating obs-off/obs-on within each pair (the
+    # scheduler latches the switch at construction), overhead = median
+    # of per-pair makespan ratios — a spike contaminates one short pair,
+    # and the median stays in the clean bulk.  The compiled programs are
+    # shared and identical across arms; only host-side instrumentation
+    # differs.
+    ab_n = min(16, args.requests)
+    ab_reqs = [
+        Request(id=10_000 + i, prompt=prompts[i].tolist(),
+                max_new_tokens=min(int(new_counts[i]), 24))
+        for i in range(ab_n)
+    ]
+    ab_useful = sum(r.max_new_tokens for r in ab_reqs)
+    pair_ratios = []
+    # decode_compiles is cumulative across arms; attribute any recompile
+    # to the arm whose pass raised it (delta per pass), so a regression
+    # indicts the right arm.  1 = the shared warm-time compile.
+    recompiles = {False: 0, True: 0}
+    ab_best = {False: float("inf"), True: float("inf")}
+    for rep in range(args.obs_pairs or repeats):
+        spans = {}
+        # Swap pair order every repeat so neither arm systematically
+        # runs into a fresher (or staler) cache/contention state.
+        for on in ((False, True) if rep % 2 == 0 else (True, False)):
+            obs.set_enabled(on)
+            before = eng.decode_compiles
+            try:
+                cs = Scheduler(eng).run(ab_reqs)
+            finally:
+                obs.set_enabled(None)
+            recompiles[on] += eng.decode_compiles - before
+            spans[on] = max(c.finished_at for c in cs)
+            ab_best[on] = min(ab_best[on], spans[on])
+        pair_ratios.append(spans[True] / spans[False] - 1.0)
+    compiles = {arm: 1 + recompiles[arm] for arm in (False, True)}
+    rs = sorted(pair_ratios)
+    mid = len(rs) // 2
+    obs_overhead_pct = 100.0 * (
+        rs[mid] if len(rs) % 2 else (rs[mid - 1] + rs[mid]) / 2.0
+    )
     cont_lat = [
         (c.finished_at - c.arrival) / len(c.tokens) for c in comps
     ]
@@ -374,6 +450,38 @@ def main():
             "token_latency_ms_p95": round(_pct(cont_lat, 0.95) * 1e3, 3),
             "decode_compiles": eng.decode_compiles,
             "prefill_compiles": eng.prefill_compiles,
+        },
+        # Serving-plane observability overhead (ISSUE 6 contract: the
+        # default-on stack costs < 1% tokens/s).  Drain-mode A/B (see
+        # the comment above); ``overhead_pct`` is the median of paired
+        # alternating-pass ratios — host jitter can land it slightly
+        # negative, the contract reads the magnitude.  The obs-on/off
+        # tokens/s are each arm's best drain pass over the A/B workload
+        # (not the traffic headline above).
+        "observability": {
+            "tokens_per_sec_obs_on": round(ab_useful / ab_best[True], 1),
+            "tokens_per_sec_obs_off": round(
+                ab_useful / ab_best[False], 1
+            ),
+            "overhead_pct": round(obs_overhead_pct, 3),
+            "overhead_pct_min_ratio": round(
+                100 * (ab_best[True] / ab_best[False] - 1.0), 3
+            ),
+            "overhead_pair_ratios_pct": [
+                round(100 * r, 3) for r in pair_ratios
+            ],
+            "contract": "obs-on within 1% of obs-off tokens/s",
+            "decode_compiles_obs_off": compiles[False],
+            "decode_compiles_obs_on": compiles[True],
+            "slo_p95_ms": {
+                s: round(rep["p95_ms"], 3)
+                for s, rep in (sched_on.slo.last_report or {}).items()
+                if rep.get("p95_ms") is not None
+            } if sched_on.slo is not None else None,
+            "timeline_events": (
+                len(sched_on.timeline)
+                if sched_on.timeline is not None else 0
+            ),
         },
         "static": {
             "tokens_per_sec": round(static_tps, 1),

@@ -26,6 +26,14 @@ Four cooperating pieces, all default-on and all bounded:
 * :mod:`~chainermn_tpu.observability.aggregate` — rank-0 aggregation over
   the *existing* host object plane (no new meshes): a merged per-step
   JSONL feed plus an optional Prometheus-style textfile.
+* :mod:`~chainermn_tpu.observability.slo` — streaming SLO monitor for
+  the serving plane: TTFT / queue-wait / per-token latency in fixed-edge
+  histograms plus rolling-window p50/p95 and a p95 drift detector
+  (``serve.slo.*``); the serving scheduler also records per-request
+  lifecycle events (:class:`~chainermn_tpu.observability.tracing.
+  RequestTimeline`) exportable as Chrome trace-event JSON
+  (:func:`~chainermn_tpu.observability.tracing.write_chrome_trace`,
+  Perfetto-loadable).
 
 Env knobs (see ``docs/observability.md`` for the full table):
 
@@ -33,8 +41,10 @@ Env knobs (see ``docs/observability.md`` for the full table):
   hooks vanish, per-step trace annotations are not emitted.
 * ``CMN_OBS_SPAN_RING`` — span-ring capacity (default 512).
 * ``CMN_OBS_SAMPLES`` — metric-sample ring capacity (default 64).
+* ``CMN_OBS_TIMELINE`` — request-lifecycle timeline capacity (32768).
 * ``CMN_OBS_FLIGHT_DIR`` — where flight records land (the launcher sets a
   per-attempt path); ``CMN_OBS_FLIGHT=0`` disables the recorder.
+* ``CMN_SLO_*`` — SLO monitor window / baseline / envelope knobs.
 """
 
 from __future__ import annotations
@@ -72,15 +82,24 @@ from chainermn_tpu.observability.metrics import (  # noqa: E402
     Gauge,
     Histogram,
     MetricsRegistry,
+    histogram_quantile,
     merge_snapshots,
     registry,
 )
 from chainermn_tpu.observability.tracing import (  # noqa: E402
+    LifecycleEvent,
+    RequestTimeline,
     Span,
     SpanRing,
     Tracer,
+    chrome_trace_events,
     step_annotation,
     tracer,
+    write_chrome_trace,
+)
+from chainermn_tpu.observability.slo import (  # noqa: E402
+    SLOMonitor,
+    rolling_quantile,
 )
 from chainermn_tpu.observability.flight import (  # noqa: E402
     FLIGHT_SCHEMA,
@@ -101,13 +120,20 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "histogram_quantile",
     "merge_snapshots",
     "registry",
+    "LifecycleEvent",
+    "RequestTimeline",
     "Span",
     "SpanRing",
     "Tracer",
     "tracer",
+    "chrome_trace_events",
     "step_annotation",
+    "write_chrome_trace",
+    "SLOMonitor",
+    "rolling_quantile",
     "FLIGHT_SCHEMA",
     "FlightRecorder",
     "recorder",

@@ -54,13 +54,21 @@ class MetricsAggregator:
         1-process run and an N-process run produce the same artifacts).
       out_dir: where rank 0 writes the merged feed / textfile.
       prometheus: also maintain the Prometheus-style textfile.
+      quantiles: quantiles (in ``(0, 1]``) to estimate for every *merged*
+        histogram via :func:`~chainermn_tpu.observability.metrics.
+        histogram_quantile`; each feed line then carries a
+        ``"quantiles": {name: {"p95": ...}}`` section — fleet p95 from
+        exactly-merged buckets, the SLO/autoscaling consumer's number.
+        Default off (the feed schema is a cross-checked contract).
     """
 
     def __init__(self, comm=None, out_dir: str = "obs",
-                 prometheus: bool = False):
+                 prometheus: bool = False,
+                 quantiles: tuple = ()):
         self.comm = comm
         self.out_dir = out_dir
         self.prometheus = bool(prometheus)
+        self.quantiles = tuple(float(q) for q in quantiles)
         self.rank = getattr(comm, "rank", 0) if comm is not None else 0
         self.size = getattr(comm, "size", 1) if comm is not None else 1
 
@@ -101,6 +109,21 @@ class MetricsAggregator:
             "per_rank": per_rank,
             "merged": _metrics.merge_snapshots(snaps) if snaps else {},
         }
+        if self.quantiles and line["merged"]:
+            qs = {}
+            for name, rec in line["merged"].items():
+                if rec.get("type") != "histogram":
+                    continue
+                # :g keeps sub-percent labels distinct (0.995 -> p99.5;
+                # rounding would collide it with 0.999 as p100).
+                ests = {
+                    f"p{q * 100:g}":
+                        _metrics.histogram_quantile(rec, q)
+                    for q in self.quantiles
+                }
+                if any(v is not None for v in ests.values()):
+                    qs[name] = ests
+            line["quantiles"] = qs
         os.makedirs(self.out_dir, exist_ok=True)
         with open(self.merged_path, "a") as f:
             f.write(json.dumps(sanitize_json(line)) + "\n")

@@ -54,7 +54,10 @@ _providers_lock = threading.Lock()
 
 def register_provider(name: str, fn: Callable[[], dict]) -> None:
     """Contribute a section to every future record's ``resilience`` map.
-    The guard registers ``guard_report``; the detector its liveness view."""
+    The guard registers ``guard_report``; the detector its liveness view;
+    the serving scheduler registers ``serving`` (live slot map, allocator
+    occupancy, queue depth, in-flight request ids — see
+    ``docs/serving.md``)."""
     with _providers_lock:
         _providers[name] = fn
 

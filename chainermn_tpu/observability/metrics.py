@@ -148,6 +148,23 @@ class Histogram:
             }
 
 
+class NoopInstrument:
+    """No-op stand-in for any registry instrument.  Publishers that latch
+    the ``CMN_OBS`` master switch at construction (the serving scheduler,
+    the SLO monitor) hold one of these instead of a real instrument when
+    the switch is off — one shared stub, so the instrument interface has
+    a single off-path mirror."""
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
 class MetricsRegistry:
     """Named-instrument registry with a bounded ring of per-step samples.
 
@@ -275,6 +292,49 @@ def merge_snapshots(snaps: Sequence[Dict[str, dict]]) -> Dict[str, dict]:
             rec["max"] = max(vals) if vals else None
             rec["mean"] = sum(vals) / len(vals) if vals else None
     return out
+
+
+def histogram_quantile(rec: dict, q: float) -> Optional[float]:
+    """Estimate quantile ``q`` from a histogram *snapshot* dict (per-rank
+    or merged — both carry the same ``edges``/``counts`` layout).
+
+    Prometheus-style linear interpolation inside the covering bucket,
+    with two exactness improvements the snapshot affords: the estimate
+    is clamped to the recorded ``[min, max]``, and the first/overflow
+    buckets use ``min``/``max`` as their open bounds instead of 0/+Inf.
+    Returns ``None`` for an empty histogram.
+
+    This is the fleet-quantile path: per-rank histograms merge exactly
+    (bucketwise sums), so a rank-0 p95 estimated from the merged counts
+    is the same estimate a single observer's histogram would give —
+    unlike merged quantile *sketches*, which approximate twice.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    total = rec.get("count", 0)
+    if not total:
+        return None
+    edges = rec["edges"]
+    counts = rec["counts"]
+    lo_bound = rec.get("min")
+    hi_bound = rec.get("max")
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if cum + c >= target and c > 0:
+            lo = (lo_bound if lo_bound is not None else 0.0) \
+                if i == 0 else edges[i - 1]
+            hi = edges[i] if i < len(edges) else (
+                hi_bound if hi_bound is not None else edges[-1]
+            )
+            est = lo + (hi - lo) * max(target - cum, 0.0) / c
+            if lo_bound is not None:
+                est = max(est, lo_bound)
+            if hi_bound is not None:
+                est = min(est, hi_bound)
+            return est
+        cum += c
+    return hi_bound  # pragma: no cover - defensive (count drift)
 
 
 #: Process-wide registry (lazy; one per process like the fault injector).

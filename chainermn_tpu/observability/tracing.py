@@ -31,6 +31,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -216,6 +217,208 @@ class _SpanCtx:
     def __exit__(self, exc_type, exc, tb) -> bool:
         self._tracer._pop(self._open, exc)
         return False  # never swallow
+
+
+# ------------------------------------------------ request-lifecycle timeline
+@dataclass
+class LifecycleEvent:
+    """One serving-plane lifecycle event on the *scheduler clock*.
+
+    ``kind`` ∈ {submit, admit, prefill, decode, evict, retire}; ``t`` is
+    the event's start in scheduler-clock seconds (the clock the arrival
+    schedule lives on, so queue waits render true even when the scheduler
+    skips idle gaps); duration events carry ``dur_ms``.
+    """
+
+    kind: str
+    t: float
+    req: Optional[int] = None
+    slot: Optional[int] = None
+    dur_ms: float = 0.0
+    info: Optional[dict] = None
+
+
+class RequestTimeline:
+    """Bounded recorder of request-lifecycle events for one serving run.
+
+    Two sinks per event:
+
+    * the timeline's own ring (capacity ``CMN_OBS_TIMELINE``, default
+      32768 — sized for whole-run Chrome/Perfetto export; oldest events
+      drop first and ``dropped`` counts them, so a truncated export is
+      visible, never silent), and
+    * optionally the process span ring (``ring=``): each event is
+      mirrored as a ``serve.<kind>`` :class:`Span`, so a flight record
+      of a dying serving rank shows its recent scheduling activity next
+      to the host-plane ops.  Mirrored spans bypass the metric publisher
+      — the scheduler's ``serve.*`` histograms already carry the rates.
+    """
+
+    def __init__(self, capacity: Optional[int] = None,
+                 ring: Optional[SpanRing] = None):
+        cap = int(
+            capacity if capacity is not None
+            else os.environ.get("CMN_OBS_TIMELINE", "32768")
+        )
+        if cap < 1:
+            raise ValueError(f"timeline capacity must be >= 1: {cap}")
+        self.capacity = cap
+        self._lock = threading.Lock()
+        # deque(maxlen): O(1) eviction — a full timeline sits on the
+        # scheduler's per-iteration path, where a list-trim memmove of
+        # `capacity` pointers per event would not.
+        self._events: deque = deque(maxlen=cap)
+        self.ring = ring
+        #: total ever recorded (dropped = total - len).
+        self.total = 0
+
+    def record(self, kind: str, t: float, req: Optional[int] = None,
+               slot: Optional[int] = None, dur_ms: float = 0.0,
+               info: Optional[dict] = None) -> None:
+        ev = LifecycleEvent(kind=kind, t=t, req=req, slot=slot,
+                            dur_ms=dur_ms, info=info)
+        with self._lock:
+            self._events.append(ev)
+            self.total += 1
+        if self.ring is not None:
+            detail = f"req={req}" if req is not None else (
+                f"slots={len(info['reqs'])}" if info and "reqs" in info
+                else None
+            )
+            self.ring.append(Span(
+                op=f"serve.{kind}", peer=slot, wall_start=time.time(),
+                ms=dur_ms, detail=detail,
+            ))
+
+    def events(self) -> List[LifecycleEvent]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self.total - len(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+#: Chrome trace-event track ids: the admission queue gets its own track
+#: above the slot tracks.
+_QUEUE_TID = 0
+
+
+def chrome_trace_events(events, rank: int = 0) -> List[dict]:
+    """Convert :class:`LifecycleEvent` s into Chrome trace-event JSON
+    objects (the ``traceEvents`` array — Perfetto/``chrome://tracing``
+    loadable).
+
+    Track layout: one *process* per rank; thread 0 is the admission
+    queue, thread ``1 + slot`` is that decode slot.  A request renders
+    as:
+
+    * a ``queue req N`` slice on the queue track (submit→admit, and
+      again evict→readmission),
+    * a ``req N`` slice on its slot track for each residency
+      (admit→retire/evict), with nested ``prefill`` / ``decode`` slices,
+    * an ``evict`` *instant* event at each eviction.
+
+    Events still open when the recording ends (an aborted run) are
+    closed at the last observed timestamp, so the export always loads.
+    """
+    out: List[dict] = []
+    pid = int(rank)
+    used_tids = {_QUEUE_TID}
+    t_max = max((e.t + e.dur_ms / 1e3 for e in events), default=0.0)
+
+    def us(t: float) -> float:
+        return round(t * 1e6, 3)
+
+    def slice_(name, cat, tid, t0, t1, args=None):
+        ev = {"name": name, "cat": cat, "ph": "X", "pid": pid,
+              "tid": tid, "ts": us(t0), "dur": max(us(t1) - us(t0), 0.0)}
+        if args:
+            ev["args"] = args
+        out.append(ev)
+
+    queue_since: Dict[int, float] = {}
+    residency: Dict[int, tuple] = {}  # req -> (t_admit, slot)
+    for e in events:
+        if e.kind == "submit":
+            queue_since[e.req] = e.t
+        elif e.kind == "admit":
+            t0 = queue_since.pop(e.req, None)
+            if t0 is not None:
+                slice_(f"queue req {e.req}", "queue", _QUEUE_TID,
+                       t0, e.t, {"req": e.req})
+            residency[e.req] = (e.t, e.slot)
+            used_tids.add(1 + e.slot)
+        elif e.kind == "prefill":
+            used_tids.add(1 + e.slot)
+            slice_("prefill", "prefill", 1 + e.slot, e.t,
+                   e.t + e.dur_ms / 1e3,
+                   {"req": e.req, **(e.info or {})})
+        elif e.kind == "decode":
+            info = e.info or {}
+            for slot, req in info.get("reqs", ()):
+                used_tids.add(1 + slot)
+                slice_("decode", "decode", 1 + slot, e.t,
+                       e.t + e.dur_ms / 1e3,
+                       {"req": req, "mixed": info.get("mixed", False)})
+        elif e.kind in ("evict", "retire"):
+            start = residency.pop(e.req, None)
+            if start is not None:
+                t0, slot = start
+                args = {"req": e.req}
+                if e.kind == "evict":
+                    args["evicted"] = True
+                elif e.info:
+                    args.update(e.info)
+                slice_(f"req {e.req}", "request", 1 + slot, t0, e.t, args)
+            if e.kind == "evict":
+                out.append({"name": "evict", "cat": "evict", "ph": "i",
+                            "s": "t", "pid": pid, "tid": 1 + e.slot,
+                            "ts": us(e.t), "args": {"req": e.req}})
+                queue_since[e.req] = e.t
+    # Close anything the recording ended inside of.
+    for req, (t0, slot) in residency.items():
+        slice_(f"req {req}", "request", 1 + slot, t0, t_max,
+               {"req": req, "open": True})
+    for req, t0 in queue_since.items():
+        if t0 < t_max:
+            slice_(f"queue req {req}", "queue", _QUEUE_TID, t0, t_max,
+                   {"req": req, "open": True})
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": f"cmn-serve rank {pid}"}}]
+    for tid in sorted(used_tids):
+        name = "queue" if tid == _QUEUE_TID else f"slot {tid - 1}"
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": name}})
+        meta.append({"name": "thread_sort_index", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"sort_index": tid}})
+    return meta + out
+
+
+def write_chrome_trace(path: str, events, rank: int = 0) -> str:
+    """Write a Perfetto-loadable Chrome trace JSON file
+    (``{"traceEvents": [...], "displayTimeUnit": "ms"}``) and return
+    ``path``.  Strict JSON via the same sanitizer as the metric feeds."""
+    import json
+
+    from chainermn_tpu.observability import aggregate as _oagg
+
+    payload = {
+        "traceEvents": _oagg.sanitize_json(
+            chrome_trace_events(events, rank=rank)
+        ),
+        "displayTimeUnit": "ms",
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
 
 
 # ------------------------------------------------------- device annotations

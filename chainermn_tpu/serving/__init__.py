@@ -15,7 +15,14 @@ Three layers:
   step (compiles exactly once; slot churn never recompiles) + chunked
   prefill.
 * :mod:`~chainermn_tpu.serving.scheduler` — admission queue, prefill/decode
-  interleaving, eviction-based backpressure, ``serve.*`` metrics.
+  interleaving, eviction-based backpressure, ``serve.*`` metrics, plus the
+  request-lifecycle observability layer: per-request timeline events
+  (exportable as Perfetto-loadable Chrome trace JSON via
+  :meth:`~chainermn_tpu.serving.scheduler.Scheduler.export_trace`), the
+  streaming SLO monitor (``serve.slo.*`` — see
+  :mod:`chainermn_tpu.observability.slo`), and a ``"serving"``
+  flight-record provider (live slot map + allocator occupancy in every
+  crash/preemption/SIGUSR1 snapshot).
 
 See ``docs/serving.md`` and ``benchmarks/serving.py``.
 """

@@ -292,6 +292,24 @@ class DecodeEngine:
     def free_blocks(self) -> int:
         return self.pool.allocator.free_blocks
 
+    def stats(self) -> dict:
+        """Host-side engine state for flight records / dashboards —
+        never touches a device buffer."""
+        free = self.pool.allocator.free_blocks
+        allocatable = self.pool.num_blocks - 1  # block 0 reserved
+        return {
+            "capacity": self.capacity,
+            "num_blocks": self.pool.num_blocks,
+            "block_len": self.block_len,
+            "free_blocks": free,
+            "blocks_in_use": allocatable - free,
+            "block_occupancy": (
+                (allocatable - free) / allocatable if allocatable else 0.0
+            ),
+            "decode_compiles": self.decode_compiles,
+            "prefill_compiles": self.prefill_compiles,
+        }
+
     def alloc_blocks(self, n: int) -> Optional[List[int]]:
         return self.pool.allocator.alloc(n)
 

@@ -29,15 +29,43 @@ raises :class:`~chainermn_tpu.serving.kv_pool.PoolExhausted` at submit.
 
 Everything observable publishes into the PR-3 metrics registry
 (``serve.queue_depth``, ``serve.slot_occupancy``, ``serve.tokens``,
-``serve.prefill_ms``/``serve.decode_ms`` on the registry's FIXED default
-edges — the cross-rank merge contract holds).  Attribution caveat under
-async dispatch: only ops with a device readback are timed end-to-end —
-the decode step (token readback every iteration) and FINAL prefill
-chunks (first-token readback).  A non-final chunk's timing brackets
-just its dispatch; its compute drains into the next synced op, so after
-an admission wave ``serve.decode_ms`` absorbs the queued prefill work.
-Deliberate: forcing a readback per chunk to sharpen a histogram would
-add real latency to the admission path.
+``serve.prefill_ms``/``serve.decode_ms``/``serve.mixed_ms`` on the
+registry's FIXED default edges — the cross-rank merge contract holds).
+Attribution caveat under async dispatch: only ops with a device readback
+are timed end-to-end — the decode step (token readback every iteration)
+and FINAL prefill chunks (first-token readback).  A non-final chunk's
+timing brackets just its dispatch; its compute drains into the next
+synced op, so a decode step that follows un-synced prefill dispatches
+would absorb the queued prefill work.  Those iterations are *tagged*:
+their step time books to ``serve.mixed_ms``, so ``serve.decode_ms``
+holds only clean decode iterations and its p95 is trustworthy (the SLO
+monitor's ``token`` stream reads exactly the clean iterations).
+Forcing a readback per chunk instead would add real latency to the
+admission path, so the scheduler tags rather than syncs.
+
+Request-lifecycle observability (all riding the ``CMN_OBS`` master
+switch; ISSUE 6):
+
+* every lifecycle transition (submitted → admitted → each prefill chunk
+  → eviction/readmission → per-iteration decode → retired) lands in a
+  :class:`~chainermn_tpu.observability.tracing.RequestTimeline` (and is
+  mirrored as ``serve.*`` spans into the process span ring, so flight
+  records show recent scheduling activity);
+  :meth:`Scheduler.export_trace` writes the whole run as Chrome
+  trace-event JSON — load it at ui.perfetto.dev (slots as tracks,
+  requests as nested slices, evictions as instant events);
+* a :class:`~chainermn_tpu.observability.slo.SLOMonitor` tracks TTFT,
+  queue-wait, and per-token latency (``serve.slo.*``) with rolling
+  p50/p95 and p95-drift detection, checked every
+  ``slo.check_every`` decode iterations;
+* the scheduler registers a ``"serving"`` flight-record provider: any
+  crash / exit-75 preemption / SIGUSR1 snapshot captures the live slot
+  map, allocator occupancy, queue depth, and in-flight request ids.
+
+The decode step is also a ``CMN_FAULT`` hook point (site
+``serve_step``, counted by decode iteration): ``skew@serve_step:N:ms``
+stretches every step from iteration N on — the deterministic way to
+test that the SLO drift detector fires.
 
 The clock is injectable; the default counts real seconds from scheduler
 construction and can *skip* idle gaps (no busy-waiting between Poisson
@@ -53,6 +81,9 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from chainermn_tpu.observability.metrics import (
+    NoopInstrument as _NoopInstrument,
+)
 from chainermn_tpu.serving.kv_pool import PoolExhausted, blocks_for
 
 
@@ -123,19 +154,6 @@ class _Slot:
         return len(self.entry.carried) + len(self.generated)
 
 
-class _NoopInstrument:
-    """Stand-in for registry instruments when observability is off."""
-
-    def inc(self, n: int = 1) -> None:
-        pass
-
-    def set(self, value: float) -> None:
-        pass
-
-    def observe(self, value: float) -> None:
-        pass
-
-
 class _Clock:
     """Real seconds since construction, with idle gaps skippable."""
 
@@ -156,12 +174,17 @@ class Scheduler:
     """Admission queue + iteration-level scheduling over a
     :class:`~chainermn_tpu.serving.engine.DecodeEngine`."""
 
-    def __init__(self, engine, registry=None, clock: Optional[_Clock] = None):
+    def __init__(self, engine, registry=None, clock: Optional[_Clock] = None,
+                 slo=None, timeline=None):
         import chainermn_tpu.observability as _obs
+        from chainermn_tpu.observability import flight as _flight
+        from chainermn_tpu.observability import tracing as _tracing
         from chainermn_tpu.observability.metrics import (
             DEFAULT_MS_EDGES,
             registry as global_registry,
         )
+        from chainermn_tpu.observability.slo import SLOMonitor
+        from chainermn_tpu.resilience import faults as _faults
 
         self.engine = engine
         self.clock = clock or _Clock()
@@ -169,24 +192,73 @@ class Scheduler:
         self._slots: List[Optional[_Slot]] = [None] * engine.capacity
         self._admit_seq = 0
         self.completions: List[Completion] = []
+        self._iterations = 0
+        #: True while non-final prefill chunks dispatched since the last
+        #: device readback may still be draining — the next decode step's
+        #: wall time would absorb them (the ``serve.mixed_ms`` tag).
+        self._unsynced_prefill = False
+        self._fault = _faults.process_injector()
+        enabled = _obs.enabled()
         # An explicitly passed registry always publishes; the ambient
         # global registry rides the CMN_OBS master switch like every
         # other publisher (latched here, same as resilience/guard.py).
-        if registry is None and not _obs.enabled():
+        if registry is None and not enabled:
             noop = _NoopInstrument()
             self._m_queue = self._m_occ = self._m_tokens = noop
-            self._m_prefill = self._m_decode = noop
-            return
-        reg = registry if registry is not None else global_registry()
-        self._m_queue = reg.gauge("serve.queue_depth")
-        self._m_occ = reg.gauge("serve.slot_occupancy")
-        self._m_tokens = reg.counter("serve.tokens")
-        self._m_prefill = reg.histogram(
-            "serve.prefill_ms", edges=DEFAULT_MS_EDGES
+            self._m_prefill = self._m_decode = self._m_mixed = noop
+            reg = None
+        else:
+            reg = registry if registry is not None else global_registry()
+            self._m_queue = reg.gauge("serve.queue_depth")
+            self._m_occ = reg.gauge("serve.slot_occupancy")
+            self._m_tokens = reg.counter("serve.tokens")
+            self._m_prefill = reg.histogram(
+                "serve.prefill_ms", edges=DEFAULT_MS_EDGES
+            )
+            self._m_decode = reg.histogram(
+                "serve.decode_ms", edges=DEFAULT_MS_EDGES
+            )
+            self._m_mixed = reg.histogram(
+                "serve.mixed_ms", edges=DEFAULT_MS_EDGES
+            )
+        #: SLO monitor: an explicit one always wins; otherwise it shares
+        #: the scheduler's publishing decision (same registry, no-op
+        #: when the master switch turned metrics off).
+        self.slo = slo if slo is not None else (
+            SLOMonitor(registry=reg) if reg is not None else None
         )
-        self._m_decode = reg.histogram(
-            "serve.decode_ms", edges=DEFAULT_MS_EDGES
+        #: Request-lifecycle timeline: explicit wins; else ride the
+        #: master switch, mirroring events into the process span ring
+        #: (flight records then show recent serving activity).
+        if timeline is not None:
+            self.timeline = timeline
+        elif enabled:
+            self.timeline = _tracing.RequestTimeline(
+                ring=_tracing.tracer().ring
+            )
+        else:
+            self.timeline = None
+        # Flight-record provider — ungated by CMN_OBS, like the recorder
+        # itself (it answers only to CMN_OBS_FLIGHT*).  Keyed, so the
+        # newest scheduler replaces a finished one's state; held via
+        # weakref so the provider registry never pins a dropped
+        # scheduler (and through it the engine's device KV pools).
+        import weakref
+
+        ref = weakref.ref(self)
+        _flight.register_provider(
+            "serving",
+            lambda: (
+                s._flight_state() if (s := ref()) is not None
+                else {"released": True}
+            ),
         )
+        # Arm the env-configured recorder (same as Trainer.__init__): a
+        # pure serving process would otherwise never install the SIGUSR1
+        # live-snapshot handler — the signal's default action KILLS the
+        # engine instead of snapshotting it.  No-op when
+        # CMN_OBS_FLIGHT_DIR is unset.
+        _flight.recorder()
 
     # ---------------------------------------------------------- admission
     def submit(self, req: Request) -> None:
@@ -233,6 +305,14 @@ class Scheduler:
                 "requests"
             )
         self._queue.append(_QueueEntry(req))
+        if self.timeline is not None:
+            # Stamped at the request's logical availability (its arrival
+            # on the scheduler clock) — the same origin the queue-wait
+            # metric uses, so the queue slice and the histogram agree.
+            self.timeline.record(
+                "submit", t=float(req.arrival), req=req.id,
+                info={"prompt_len": plen, "max_new": req.max_new_tokens},
+            )
 
     def _worst_prefill_end(self, lo: int, hi: int) -> int:
         """Max padded prefill end over admission text lengths in
@@ -275,12 +355,22 @@ class Scheduler:
         self._queue.pop(0)
         if entry.first_admit is None:
             entry.first_admit = now
+            if self.slo is not None:
+                self.slo.observe(
+                    "queue_wait", (now - entry.req.arrival) * 1e3
+                )
         slot = _Slot(free[0], entry, self.engine.max_blocks, now,
                      self._admit_seq)
         self._admit_seq += 1
         self._slots[free[0]] = slot
         self.engine.seed_slot(free[0], entry.req.seed,
                               entry.req.temperature)
+        if self.timeline is not None:
+            self.timeline.record(
+                "admit", t=now, req=entry.req.id, slot=free[0],
+                info={"readmit": entry.evictions > 0} if entry.evictions
+                else None,
+            )
         return True
 
     # ----------------------------------------------------------- eviction
@@ -296,6 +386,12 @@ class Scheduler:
         victim.entry.evictions += 1
         self._queue.insert(0, victim.entry)
         self._slots[victim.idx] = None
+        if self.timeline is not None:
+            self.timeline.record(
+                "evict", t=self.clock.now(), req=victim.entry.req.id,
+                slot=victim.idx,
+                info={"carried": len(victim.entry.carried)},
+            )
         return True
 
     def _alloc_for(self, slot: _Slot, n_needed: int) -> None:
@@ -375,16 +471,35 @@ class Scheduler:
         chunk = np.zeros((size,), np.int32)
         chunk[: end - p0] = slot.text[p0:end]
         last = end == len(slot.text)
+        tc = self.clock.now()
         t0 = time.perf_counter()
         tok = eng.prefill(
             slot.idx, chunk, p0, slot.table,
             last_idx=(end - p0 - 1) if last else -1,
         )
-        self._m_prefill.observe((time.perf_counter() - t0) * 1e3)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        self._m_prefill.observe(dur_ms)
+        # A final chunk's first-token readback drains every dispatch
+        # queued before it; a non-final chunk is dispatch-only and its
+        # compute drains into the NEXT synced op (the mixed-iteration
+        # tag the decode step reads).
+        self._unsynced_prefill = not last
+        if self.timeline is not None:
+            self.timeline.record(
+                "prefill", t=tc, req=slot.entry.req.id, slot=slot.idx,
+                dur_ms=dur_ms,
+                info={"p0": p0, "end": end, "final": last},
+            )
         slot.pos = end
         if last:
             slot.prefilling = False
+            first_token_ever = not slot.entry.carried
             self._emit(slot, int(tok))
+            if first_token_ever and self.slo is not None:
+                self.slo.observe(
+                    "ttft",
+                    (self.clock.now() - slot.entry.req.arrival) * 1e3,
+                )
         return True
 
     # ------------------------------------------------------------- decode
@@ -414,9 +529,37 @@ class Scheduler:
             pos[s.idx] = s.pos
             tables[s.idx] = s.table
             active[s.idx] = True
+        mixed = self._unsynced_prefill
+        self._iterations += 1
+        tc = self.clock.now()
         t0 = time.perf_counter()
+        if self._fault is not None:
+            # ``skew@serve_step:N:ms`` — inside the timed window, so an
+            # injected stretch lands in this iteration's histogram
+            # exactly like a real slowdown would.
+            self._fault.hook("serve_step", count=self._iterations)
         out = self.engine.step(tokens, pos, tables, active)
-        self._m_decode.observe((time.perf_counter() - t0) * 1e3)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        # The token readback above drained the dispatch queue: any
+        # prefill work queued before this step has now been absorbed
+        # into dur_ms — book the contaminated iteration separately so
+        # serve.decode_ms (and the SLO token stream) stay clean.
+        self._unsynced_prefill = False
+        if mixed:
+            self._m_mixed.observe(dur_ms)
+        else:
+            self._m_decode.observe(dur_ms)
+            if self.slo is not None:
+                self.slo.observe("token", dur_ms)
+        if self.timeline is not None:
+            self.timeline.record(
+                "decode", t=tc, dur_ms=dur_ms,
+                info={"reqs": [(s.idx, s.entry.req.id) for s in live],
+                      "mixed": mixed},
+            )
+        if self.slo is not None and \
+                self._iterations % self.slo.check_every == 0:
+            self.slo.check()
         for s in live:
             s.pos += 1
             self._emit(s, int(out[s.idx]))
@@ -437,6 +580,7 @@ class Scheduler:
             return
         self.engine.release_blocks(slot.blocks)
         self._slots[slot.idx] = None
+        now = self.clock.now()
         self.completions.append(Completion(
             id=req.id,
             tokens=list(slot.entry.carried) + list(slot.generated),
@@ -444,10 +588,16 @@ class Scheduler:
             prompt_len=len(req.prompt),
             arrival=req.arrival,
             admitted_at=slot.admit_time,
-            finished_at=self.clock.now(),
+            finished_at=now,
             evictions=slot.entry.evictions,
             first_admitted_at=slot.entry.first_admit,
         ))
+        if self.timeline is not None:
+            self.timeline.record(
+                "retire", t=now, req=req.id, slot=slot.idx,
+                info={"reason": reason,
+                      "tokens": slot.total_generated},
+            )
 
     # --------------------------------------------------------------- run
     def run(self, requests: Optional[Sequence[Request]] = None
@@ -483,4 +633,54 @@ class Scheduler:
                     )
         self._m_queue.set(0)
         self._m_occ.set(0.0)
+        if self.slo is not None:
+            self.slo.check()
         return list(self.completions)
+
+    # ------------------------------------------------------- observability
+    def _flight_state(self) -> dict:
+        """The ``"serving"`` flight-record section: what this engine is
+        serving *right now* — readable even while :meth:`run` is live
+        (every field is a host-side scalar or small list; worst case a
+        torn read shows one admission ago)."""
+        slots = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                slots.append(None)
+                continue
+            slots.append({
+                "req": s.entry.req.id,
+                "pos": int(s.pos),
+                "prefilling": bool(s.prefilling),
+                "generated": len(s.generated),
+                "carried": len(s.entry.carried),
+                "blocks": len(s.blocks),
+            })
+        state = {
+            "iterations": self._iterations,
+            "queue_depth": len(self._queue),
+            "queued_requests": [e.req.id for e in self._queue[:64]],
+            "in_flight_requests": [
+                s["req"] for s in slots if s is not None
+            ],
+            "slots": slots,
+            "completions": len(self.completions),
+            "clock": round(self.clock.now(), 6),
+            "engine": self.engine.stats(),
+        }
+        if self.slo is not None and self.slo.last_report:
+            state["slo"] = self.slo.last_report
+        if self.timeline is not None:
+            state["timeline_events"] = len(self.timeline)
+            state["timeline_dropped"] = self.timeline.dropped
+        return state
+
+    def export_trace(self, path: str, rank: int = 0) -> Optional[str]:
+        """Write this run's request timeline as Chrome trace-event JSON
+        (Perfetto-loadable); returns the path, or None when lifecycle
+        tracing is off (``CMN_OBS=0`` and no explicit timeline)."""
+        if self.timeline is None:
+            return None
+        from chainermn_tpu.observability.tracing import write_chrome_trace
+
+        return write_chrome_trace(path, self.timeline.events(), rank=rank)

@@ -1,0 +1,211 @@
+"""SLO monitor: exact rolling quantiles, drift detection, merge contract.
+
+The estimator's quantiles must equal an exact oracle recompute over the
+same window (they are not approximations — the window holds raw values),
+the drift detector must fire only when p95 actually leaves the envelope,
+and the ``serve.slo.*`` histograms must keep the PR-3 exact cross-rank
+merge property (fixed default edges).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import chainermn_tpu.observability as obs
+from chainermn_tpu.observability import MetricsRegistry, merge_snapshots
+from chainermn_tpu.observability.aggregate import MetricsAggregator
+from chainermn_tpu.observability.metrics import (
+    DEFAULT_MS_EDGES,
+    histogram_quantile,
+)
+from chainermn_tpu.observability.slo import (
+    STREAMS,
+    SLOMonitor,
+    rolling_quantile,
+)
+
+pytestmark = pytest.mark.tier1
+
+
+def _oracle_quantile(values, q):
+    """Independent nearest-rank recompute (the bench's _pct definition)."""
+    xs = sorted(values)
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+def test_rolling_quantiles_match_exact_oracle():
+    rng = np.random.RandomState(7)
+    window = 64
+    mon = SLOMonitor(registry=MetricsRegistry(), window=window,
+                     min_samples=8)
+    stream = []
+    for v in rng.lognormal(1.0, 0.8, size=200):
+        mon.observe("token", float(v))
+        stream.append(float(v))
+        tail = stream[-window:]
+        for q in (0.0, 0.5, 0.95, 1.0):
+            assert mon.quantile("token", q) == _oracle_quantile(tail, q)
+    # check() reports the same numbers it publishes as gauges.
+    rep = mon.check()["token"]
+    assert rep["p50_ms"] == _oracle_quantile(stream[-window:], 0.5)
+    assert rep["p95_ms"] == _oracle_quantile(stream[-window:], 0.95)
+    assert rep["n"] == window
+
+
+def test_rolling_quantile_empty_and_helper():
+    mon = SLOMonitor(registry=MetricsRegistry())
+    assert mon.quantile("ttft", 0.95) is None
+    assert mon.check() == {}
+    assert rolling_quantile([], 0.5) is None
+    assert rolling_quantile([3.0], 0.95) == 3.0
+
+
+def test_histograms_use_fixed_default_edges():
+    reg = MetricsRegistry()
+    mon = SLOMonitor(registry=reg, window=16, min_samples=4)
+    for s in STREAMS:
+        mon.observe(s, 1.0)
+    snap = reg.snapshot()
+    for s in STREAMS:
+        rec = snap[f"serve.slo.{s}_ms"]
+        assert rec["type"] == "histogram"
+        assert tuple(rec["edges"]) == tuple(DEFAULT_MS_EDGES)
+        assert rec["count"] == 1
+    with pytest.raises(ValueError, match="unknown SLO stream"):
+        mon.observe("nope", 1.0)
+    with pytest.raises(ValueError, match=">= 1"):
+        SLOMonitor(registry=reg, window=0)
+
+
+def test_drift_detector_fires_on_shift_quiet_otherwise():
+    reg = MetricsRegistry()
+    mon = SLOMonitor(registry=reg, window=64, min_samples=16,
+                     tolerance=0.5)
+    rng = np.random.RandomState(0)
+    # Calibration + steady state: ~10ms with mild jitter — no breach.
+    for _ in range(48):
+        mon.observe("token", float(rng.normal(10.0, 0.5)))
+    rep = mon.check()["token"]
+    assert rep["calibrated"] and rep["ref_p95_ms"] is not None
+    assert rep["breached"] is False
+    assert abs(rep["drift"]) < 0.5
+    assert reg.snapshot()["serve.slo.token.breaches"]["value"] == 0
+    # Regime shift: 4x the baseline — p95 leaves the envelope.
+    for _ in range(64):
+        mon.observe("token", float(rng.normal(40.0, 0.5)))
+    rep = mon.check()["token"]
+    assert rep["breached"] is True
+    assert rep["drift"] > 0.5
+    snap = reg.snapshot()
+    assert snap["serve.slo.token.breaches"]["value"] >= 1
+    assert snap["serve.slo.p95_drift"]["value"] > 0.5
+    # The reference stays latched — a drifting run must not re-baseline.
+    assert rep["ref_p95_ms"] == pytest.approx(
+        mon.check()["token"]["ref_p95_ms"]
+    )
+
+
+def test_absolute_target_via_env(monkeypatch):
+    monkeypatch.setenv("CMN_SLO_TOKEN_P95_MS", "20")
+    reg = MetricsRegistry()
+    mon = SLOMonitor(registry=reg, window=32, min_samples=4,
+                     tolerance=0.25)
+    for _ in range(8):
+        mon.observe("token", 50.0)
+    rep = mon.check()["token"]
+    assert rep["ref_p95_ms"] == 20.0 and not rep["calibrated"]
+    assert rep["breached"] is True  # 50 > 20 * 1.25
+    # Inside the envelope: quiet.
+    mon2 = SLOMonitor(registry=MetricsRegistry(), window=32,
+                      min_samples=4, tolerance=0.25)
+    for _ in range(8):
+        mon2.observe("token", 22.0)
+    assert mon2.check()["token"]["breached"] is False
+
+
+def test_cross_rank_histogram_merge_is_exact():
+    """Two ranks' serve.slo histograms merge to exactly the histogram a
+    single observer of all values would have built."""
+    rng = np.random.RandomState(3)
+    a_vals = rng.lognormal(0.5, 1.0, size=120).tolist()
+    b_vals = rng.lognormal(2.0, 0.7, size=80).tolist()
+    reg_a, reg_b, reg_one = (MetricsRegistry() for _ in range(3))
+    mon_a = SLOMonitor(registry=reg_a, window=32, min_samples=4)
+    mon_b = SLOMonitor(registry=reg_b, window=32, min_samples=4)
+    mon_one = SLOMonitor(registry=reg_one, window=32, min_samples=4)
+    for v in a_vals:
+        mon_a.observe("ttft", v)
+        mon_one.observe("ttft", v)
+    for v in b_vals:
+        mon_b.observe("ttft", v)
+        mon_one.observe("ttft", v)
+    merged = merge_snapshots([reg_a.snapshot(), reg_b.snapshot()])
+    one = reg_one.snapshot()["serve.slo.ttft_ms"]
+    got = merged["serve.slo.ttft_ms"]
+    assert got["counts"] == one["counts"]
+    assert got["count"] == one["count"]
+    assert got["sum"] == pytest.approx(one["sum"])
+    assert got["min"] == one["min"] and got["max"] == one["max"]
+    # Fleet quantile off the merged buckets == the single observer's
+    # estimate (merging never degrades it).
+    for q in (0.5, 0.95):
+        assert histogram_quantile(got, q) == pytest.approx(
+            histogram_quantile(one, q)
+        )
+
+
+def test_histogram_quantile_bounds():
+    reg = MetricsRegistry()
+    h = reg.histogram("x.ms")
+    assert histogram_quantile(h.to_dict(), 0.95) is None
+    for v in (1.0, 2.0, 3.0, 4.0, 120.0):
+        h.observe(v)
+    rec = h.to_dict()
+    p50 = histogram_quantile(rec, 0.5)
+    p95 = histogram_quantile(rec, 0.95)
+    assert rec["min"] <= p50 <= p95 <= rec["max"]
+    with pytest.raises(ValueError, match="quantile"):
+        histogram_quantile(rec, 1.5)
+
+
+def test_aggregator_quantiles_section(tmp_path):
+    reg = MetricsRegistry()
+    mon = SLOMonitor(registry=reg, window=16, min_samples=4)
+    for v in (1.0, 2.0, 5.0, 9.0):
+        mon.observe("token", v)
+    agg = MetricsAggregator(out_dir=str(tmp_path), quantiles=(0.5, 0.95))
+    line = agg.collect(0, {"rank": 0, "registry": reg.snapshot()})
+    qs = line["quantiles"]["serve.slo.token_ms"]
+    assert qs["p50"] is not None and qs["p95"] is not None
+    assert qs["p50"] <= qs["p95"]
+    # The feed line on disk carries the same section, strict JSON.
+    on_disk = [json.loads(ln) for ln in
+               open(agg.merged_path).read().splitlines()]
+    assert on_disk[-1]["quantiles"]["serve.slo.token_ms"]["p95"] == \
+        pytest.approx(qs["p95"])
+
+
+def test_cmn_obs_off_skips_global_registry():
+    """With the master switch off, a registry-less monitor publishes
+    nothing into the global registry (estimator still works)."""
+    from chainermn_tpu.observability.metrics import registry as global_reg
+
+    def counts():
+        return {
+            k: v.get("count", v.get("value"))
+            for k, v in global_reg().snapshot().items()
+            if k.startswith("serve.slo.")
+        }
+
+    before = counts()
+    obs.set_enabled(False)
+    try:
+        mon = SLOMonitor(window=8, min_samples=2)
+        for _ in range(4):
+            mon.observe("token", 5.0)
+        rep = mon.check()["token"]
+        assert rep["p95_ms"] == 5.0  # the window still answers
+        assert counts() == before
+    finally:
+        obs.set_enabled(None)

@@ -1,0 +1,286 @@
+"""Serving-plane observability: request-lifecycle timeline + Perfetto
+export, ``serve.mixed_ms`` attribution, SLO monitor wiring, the
+``"serving"`` flight-record provider, and the exact 2-rank merge of every
+``serve.*`` histogram through the existing aggregation path.
+
+One contended module-scoped run (evictions guaranteed, multi-chunk
+prefill guaranteed) feeds most assertions; later tests reuse its engine
+(fresh schedulers share the compiled programs — the recompile guard must
+hold under the full observability layer too).
+"""
+
+import json
+from collections import defaultdict
+
+import pytest
+
+from chainermn_tpu.observability import MetricsRegistry, RequestTimeline
+from chainermn_tpu.observability.aggregate import MetricsAggregator
+from chainermn_tpu.observability.metrics import DEFAULT_MS_EDGES
+from chainermn_tpu.observability.slo import SLOMonitor
+from chainermn_tpu.serving import DecodeEngine, Request, Scheduler
+
+pytestmark = [pytest.mark.tier1, pytest.mark.serving]
+
+
+@pytest.fixture(scope="module")
+def obs_run(make_model, tiny_params, prompts):
+    """4 requests through 3 slots over a 7-allocatable-block pool (the
+    eviction geometry), prompts up to 17 tokens over an 8-token prefill
+    chunk (multi-chunk prefill => mixed iterations guaranteed), full
+    observability on explicit objects."""
+    model = make_model()
+    eng = DecodeEngine(
+        model, tiny_params, capacity=3, num_blocks=8, block_len=8,
+        prefill_chunk=8,
+    )
+    reg = MetricsRegistry()
+    timeline = RequestTimeline(capacity=4096)
+    slo = SLOMonitor(registry=reg, window=64, min_samples=8,
+                     tolerance=0.5, check_every=4)
+    sched = Scheduler(eng, registry=reg, slo=slo, timeline=timeline)
+    comps = sched.run([
+        Request(id=i, prompt=prompts[i], max_new_tokens=14)
+        for i in range(4)
+    ])
+    return eng, reg, timeline, slo, sched, comps
+
+
+def test_lifecycle_events_complete_and_monotonic(obs_run):
+    _, _, timeline, _, _, comps = obs_run
+    evs = timeline.events()
+    assert timeline.dropped == 0
+    by_req = defaultdict(list)
+    for e in evs:
+        if e.req is not None:
+            by_req[e.req].append(e)
+    for rid in range(4):
+        kinds = [e.kind for e in by_req[rid]]
+        assert kinds[0] == "submit", kinds
+        assert "admit" in kinds
+        assert kinds[-1] == "retire", kinds
+        ts = [e.t for e in by_req[rid]]
+        assert ts == sorted(ts), f"req {rid} timestamps not monotonic"
+        finals = [e for e in by_req[rid]
+                  if e.kind == "prefill" and e.info["final"]]
+        assert finals, f"req {rid} never finished a prefill"
+    # Per-iteration decode events exist and carry the active slot->req
+    # map (the exporter fans them out to slot tracks).
+    dec = [e for e in evs if e.kind == "decode"]
+    assert dec
+    assert all(e.info["reqs"] for e in dec)
+    assert all(e.dur_ms > 0 for e in dec)
+
+
+def test_eviction_readmission_ordering(obs_run):
+    _, _, timeline, _, _, comps = obs_run
+    evicted = [c.id for c in comps if c.evictions > 0]
+    assert evicted, "eviction geometry saw no evictions"
+    for rid in evicted:
+        evs = [e for e in timeline.events() if e.req == rid]
+        kinds = [e.kind for e in evs]
+        i_evict = kinds.index("evict")
+        assert "admit" in kinds[:i_evict], "evicted before any admission"
+        readmits = [e for e in evs[i_evict + 1:] if e.kind == "admit"]
+        assert readmits, "eviction without a later readmission"
+        assert readmits[0].t >= evs[i_evict].t
+        assert readmits[0].info and readmits[0].info["readmit"] is True
+        assert kinds[-1] == "retire"
+
+
+def test_chrome_export_valid_and_structured(obs_run, tmp_path):
+    _, _, _, _, sched, comps = obs_run
+    path = sched.export_trace(str(tmp_path / "trace.json"))
+    data = json.load(open(path))  # strict JSON or this raises
+    evs = data["traceEvents"]
+    assert isinstance(evs, list) and evs
+    assert data["displayTimeUnit"] == "ms"
+    for e in evs:
+        assert "ph" in e and "pid" in e
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    # Evictions render as instant events; an evicted request has one
+    # residency slice per admission.
+    assert [e for e in evs if e["ph"] == "i" and e["name"] == "evict"]
+    rid = [c.id for c in comps if c.evictions > 0][0]
+    residencies = [e for e in evs
+                   if e["ph"] == "X" and e["name"] == f"req {rid}"]
+    assert len(residencies) >= 2
+    # Queue + slot tracks are named.
+    tracks = {e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "queue" in tracks
+    assert any(t.startswith("slot ") for t in tracks)
+    # Queue-wait slices precede the matching residency.
+    q = [e for e in evs if e["ph"] == "X"
+         and e["name"] == f"queue req {rid}"]
+    assert q and min(e["ts"] for e in q) <= min(
+        e["ts"] for e in residencies
+    )
+
+
+def test_mixed_vs_decode_attribution(obs_run):
+    """The serve.decode_ms quirk fix: iterations that absorb un-synced
+    prefill dispatches book to serve.mixed_ms, so decode p95 (and the
+    SLO token stream) read only clean iterations."""
+    _, reg, _, _, sched, _ = obs_run
+    snap = reg.snapshot()
+    mixed, dec = snap["serve.mixed_ms"], snap["serve.decode_ms"]
+    assert tuple(mixed["edges"]) == tuple(DEFAULT_MS_EDGES)
+    assert mixed["count"] > 0, (
+        "multi-chunk prefill geometry produced no mixed iterations — "
+        "the tag went dead"
+    )
+    assert dec["count"] > 0
+    assert mixed["count"] + dec["count"] == sched._iterations
+    assert snap["serve.slo.token_ms"]["count"] == dec["count"]
+
+
+def test_slo_streams_wired(obs_run):
+    _, reg, _, slo, _, comps = obs_run
+    snap = reg.snapshot()
+    # Exactly one TTFT and one queue-wait sample per request — evictions
+    # and readmissions never double-book either.
+    assert snap["serve.slo.ttft_ms"]["count"] == len(comps)
+    assert snap["serve.slo.queue_wait_ms"]["count"] == len(comps)
+    rep = slo.last_report
+    assert set(rep) == {"ttft", "queue_wait", "token"}
+    assert snap["serve.slo.token.p95_ms"]["value"] is not None
+    # No faults injected => the drift detector stays quiet.
+    assert snap["serve.slo.token.breaches"]["value"] == 0
+
+
+def test_flight_provider_names_live_state(obs_run, prompts, tmp_path):
+    from chainermn_tpu.observability import tracer
+    from chainermn_tpu.observability.flight import FlightRecorder
+
+    eng = obs_run[0]
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    sched.submit(Request(id=7, prompt=prompts[0], max_new_tokens=4))
+    sched.submit(Request(id=8, prompt=prompts[3], max_new_tokens=4))
+    while sched._try_admit():
+        pass
+    sched._prefill_round()
+    path = FlightRecorder(str(tmp_path), rank=0).record("sigusr1")
+    entry = json.loads(open(path).read().splitlines()[-1])
+    srv = entry["resilience"]["serving"]
+    assert set(srv["in_flight_requests"]) == {7, 8}
+    assert srv["queue_depth"] == 0
+    live = [s for s in srv["slots"] if s is not None]
+    assert {s["req"] for s in live} == {7, 8}
+    assert all(s["blocks"] >= 1 for s in live)
+    assert srv["engine"]["blocks_in_use"] >= 2
+    assert 0.0 < srv["engine"]["block_occupancy"] <= 1.0
+    assert srv["engine"]["decode_compiles"] == 1
+    # The default timeline mirrors lifecycle spans into the process span
+    # ring, so the flight record's span dump shows serving activity too.
+    ops = [s["op"] for s in tracer().ring.snapshot()]
+    assert "serve.admit" in ops
+    # Drain so the shared engine's pool is clean for the next test.
+    sched.run([])
+    assert eng.free_blocks() == eng.pool.num_blocks - 1
+
+
+def test_flight_provider_releases_dropped_scheduler(obs_run, tmp_path):
+    """The provider holds the scheduler via weakref: dropping the last
+    strong reference must free it (and through it the engine's device
+    pools), not pin it in the provider registry forever."""
+    import gc
+
+    from chainermn_tpu.observability.flight import FlightRecorder
+
+    eng = obs_run[0]
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    del sched
+    gc.collect()
+    path = FlightRecorder(str(tmp_path), rank=1).record("test")
+    entry = json.loads(open(path).read().splitlines()[-1])
+    assert entry["resilience"]["serving"] == {"released": True}
+
+
+def test_request_timeline_bounded_o1():
+    tl = RequestTimeline(capacity=4)
+    for i in range(10):
+        tl.record("decode", t=float(i))
+    assert len(tl) == 4 and tl.dropped == 6
+    assert [e.t for e in tl.events()] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_two_rank_serve_merge_exact(obs_run, prompts, tmp_path):
+    """serve.* histograms merge exactly through the existing rank-0
+    aggregation path (bucketwise sums, same fixed edges)."""
+    eng, reg_a = obs_run[0], obs_run[1]
+    reg_b = MetricsRegistry()
+    sched_b = Scheduler(eng, registry=reg_b)
+    sched_b.run([
+        Request(id=100 + i, prompt=prompts[i], max_new_tokens=5)
+        for i in range(2)
+    ])
+    snap_a, snap_b = reg_a.snapshot(), reg_b.snapshot()
+
+    class _Comm:
+        rank, size = 0, 2
+
+        def gather_obj(self, entry, root=0):
+            return [{"rank": 0, "registry": snap_a},
+                    {"rank": 1, "registry": snap_b}]
+
+    agg = MetricsAggregator(comm=_Comm(), out_dir=str(tmp_path),
+                            quantiles=(0.95,))
+    line = agg.collect(1, {"rank": 0, "registry": snap_a})
+    merged = line["merged"]
+    assert merged["serve.tokens"]["value"] == (
+        snap_a["serve.tokens"]["value"] + snap_b["serve.tokens"]["value"]
+    )
+    for h in ("serve.prefill_ms", "serve.decode_ms", "serve.mixed_ms",
+              "serve.slo.token_ms", "serve.slo.ttft_ms"):
+        assert merged[h]["counts"] == [
+            x + y for x, y in zip(snap_a[h]["counts"],
+                                  snap_b[h]["counts"])
+        ], h
+        assert merged[h]["count"] == (
+            snap_a[h]["count"] + snap_b[h]["count"]
+        )
+        assert merged[h]["edges"] == list(DEFAULT_MS_EDGES)
+    # The fleet p95 section rides the same line.
+    assert line["quantiles"]["serve.decode_ms"]["p95"] is not None
+
+
+def test_skew_fault_fires_drift_detector(obs_run, prompts, monkeypatch):
+    """CMN_FAULT skew@serve_step stretches decode iterations from hit 17
+    on; the SLO monitor calibrates on the clean prefix and must flag the
+    drift (the quiet control is test_slo_streams_wired's zero-breach
+    assertion on the unfaulted run)."""
+    from chainermn_tpu.resilience import faults as faults_mod
+
+    inj = faults_mod.FaultInjector(
+        faults_mod.parse_fault_spec("skew@serve_step:17:25ms")
+    )
+    monkeypatch.setitem(faults_mod._process_injector, "built", True)
+    monkeypatch.setitem(faults_mod._process_injector, "inj", inj)
+    eng = obs_run[0]
+    reg = MetricsRegistry()
+    slo = SLOMonitor(registry=reg, window=32, min_samples=8,
+                     tolerance=0.5, check_every=4)
+    sched = Scheduler(eng, registry=reg, slo=slo)
+    sched.run([Request(id=0, prompt=prompts[0], max_new_tokens=32)])
+    snap = reg.snapshot()
+    assert snap["serve.slo.token.breaches"]["value"] >= 1
+    assert snap["serve.slo.p95_drift"]["value"] > 0.5
+    rep = slo.last_report["token"]
+    assert rep["breached"] is True and rep["calibrated"] is True
+    # Host-side instrumentation + injection never recompiled the step.
+    assert eng.decode_compiles == 1
+
+
+def test_observability_off_disables_lifecycle_layer(obs_run):
+    import chainermn_tpu.observability as obs
+
+    eng = obs_run[0]
+    obs.set_enabled(False)
+    try:
+        sched = Scheduler(eng)
+        assert sched.timeline is None and sched.slo is None
+        assert sched.export_trace("/tmp/unused_trace.json") is None
+    finally:
+        obs.set_enabled(None)

@@ -80,6 +80,70 @@ def test_long_pole_dirs_declare_test_tiers():
     )
 
 
+#: ``reg.counter("...")`` / ``.gauge`` / ``.histogram`` literals (plain
+#: or f-string; the call may wrap lines, hence DOTALL).
+_METRIC_CALL_RE = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*(f?)[\"']([^\"']+)[\"']", re.S
+)
+
+
+def _normalize_metric(name):
+    """Dynamic segments — ``{expr}`` in code f-strings, ``<placeholder>``
+    in the doc catalog — both normalize to ``*`` so the two sides
+    compare: ``host_op.{span.op}.ms`` == ``host_op.<op>.ms``."""
+    return re.sub(r"(\{[^}]*\}|<[^>]*>)", "*", name)
+
+
+def test_metric_names_match_doc_catalog():
+    """Doc-drift lint: every metric published anywhere in
+    ``chainermn_tpu/`` appears in the ``docs/observability.md`` metric
+    catalog, and every catalog row names a metric the code actually
+    publishes.  A metric missing from the catalog is invisible to
+    operators; a stale catalog row documents a signal that no longer
+    exists — both are silent drift."""
+    code_names = {}
+    for dirpath, dirnames, filenames in _walk("chainermn_tpu"):
+        if os.path.basename(dirpath) == "__pycache__":
+            continue
+        for f in filenames:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            with open(path) as fh:
+                src = fh.read()
+            for m in _METRIC_CALL_RE.finditer(src):
+                code_names.setdefault(
+                    _normalize_metric(m.group(2)),
+                    os.path.relpath(path, REPO),
+                )
+    assert code_names, "metric-literal scan found nothing — regex rot?"
+    # Catalog side: table rows' FIRST cell, backticked dotted names
+    # (slashes/spaces exclude file paths and prose).
+    doc_path = os.path.join(REPO, "docs", "observability.md")
+    doc_names = set()
+    with open(doc_path) as fh:
+        for line in fh:
+            if not line.startswith("|"):
+                continue
+            first_cell = line.split("|")[1]
+            for tok in re.findall(r"`([^`]+)`", first_cell):
+                if "." in tok and "/" not in tok and " " not in tok:
+                    doc_names.add(_normalize_metric(tok))
+    undocumented = {
+        n: where for n, where in code_names.items() if n not in doc_names
+    }
+    stale = doc_names - set(code_names)
+    assert not undocumented, (
+        "metrics published in code but missing from the "
+        "docs/observability.md catalog (add a table row): "
+        f"{undocumented}"
+    )
+    assert not stale, (
+        "docs/observability.md catalog rows with no publishing code "
+        f"(delete or fix the row): {sorted(stale)}"
+    )
+
+
 def test_every_package_dir_has_init():
     missing = []
     for dirpath, dirnames, filenames in _walk("chainermn_tpu"):
