@@ -221,6 +221,22 @@ def _serving_headline() -> dict | None:
                 "observability", {}
             ).get("overhead_pct"),
             "slo_p95_ms": rec.get("observability", {}).get("slo_p95_ms"),
+            # Prefix-sharing + speculative-decoding arms (ISSUE 7), when
+            # the artifact carries them: steady-state prompt-token hit
+            # rate / sharing speedup on the Zipf arm, and the distilled-
+            # draft acceptance / speedup of the engine A/B.
+            "prefix_hit_rate": rec.get(
+                "prefix_reuse", {}
+            ).get("prefix_hit_rate"),
+            "prefix_speedup_vs_no_sharing": rec.get(
+                "prefix_reuse", {}
+            ).get("speedup_vs_no_sharing"),
+            "spec_accept_rate": rec.get(
+                "speculative", {}
+            ).get("accept_rate"),
+            "spec_speedup_vs_plain": rec.get(
+                "speculative", {}
+            ).get("speedup_vs_plain"),
         }
 
     return _best_result("serving*.json", cands)

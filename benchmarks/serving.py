@@ -29,6 +29,20 @@ proves the ISSUE 6 overhead contract (< 1% tokens/s), and
 ``--trace-out`` exports the obs-on traffic pass's request timeline as
 Perfetto-loadable Chrome trace JSON.
 
+Two further arms ride the same alternating-pair methodology (ISSUE 7):
+
+* ``--prefix-reuse N`` — Zipf-distributed shared-prefix traffic (N
+  prompt templates, popularity ~ 1/rank^a: the system-prompt /
+  few-shot-template regime) through a sharing engine vs an identical
+  engine with ``prefix_cache=False``; reports ``prefix_hit_rate`` and
+  the useful-tokens/s ratio (prefill for a hot prefix is mapped, not
+  recomputed).
+* ``--spec-k K`` — speculative decoding A/B: the zero-tail distilled
+  draft (same construction as ``benchmarks/decode.py --draft-mode
+  distilled`` — realistic draft cost, near-ideal acceptance) lifted
+  into the engine vs the plain engine on the same zero-tail target;
+  reports per-slot acceptance and the tokens/s ratio.
+
     python benchmarks/serving.py --out result/serving_tpu.json  # real chip
     JAX_PLATFORMS=cpu python benchmarks/serving.py --smoke      # plumbing
 """
@@ -106,6 +120,24 @@ def main():
                          "sits below per-pass host noise (±2% even on "
                          "an idle shared host), so the median needs "
                          "several pairs to resolve the <1% contract")
+    ap.add_argument("--prefix-reuse", type=int, default=0, metavar="N",
+                    help="also run the Zipf shared-prefix arm: N prompt "
+                         "templates drawn Zipf(--zipf-a), each request = "
+                         "template + a short unique suffix; sharing "
+                         "engine vs prefix_cache=False engine on "
+                         "identical traffic, alternating drain pairs "
+                         "(0 = skip)")
+    ap.add_argument("--zipf-a", type=float, default=1.2,
+                    help="Zipf exponent for template popularity")
+    ap.add_argument("--spec-k", type=int, default=0, metavar="K",
+                    help="also run the speculative A/B: K draft "
+                         "proposals per round from the zero-tail "
+                         "distilled draft (decode.py's construction) vs "
+                         "the plain engine on the same zero-tail "
+                         "target, alternating drain pairs (0 = skip)")
+    ap.add_argument("--draft-layers", type=int, default=0,
+                    help="distilled draft depth (default layers // 4, "
+                         "min 1)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--trace-out", default=None,
@@ -149,7 +181,8 @@ def main():
             requests=48, batch=8, prompt_min=8, prompt_max=48,
             new_min=4, new_max=64, layers=4, d_model=512, heads=8,
             d_ff=1024, vocab=4096, block_len=8, prefill_chunk=16,
-            repeats=4, obs_pairs=12,
+            repeats=4, obs_pairs=12, prefix_reuse=4, spec_k=3,
+            draft_layers=1,
         )
         for k, v in smoke_over.items():
             if getattr(args, k) == ap.get_default(k):
@@ -328,6 +361,12 @@ def main():
     try:
         comps, sched_on, cont_makespan = None, None, float("inf")
         for _ in range(repeats):
+            # Cold prefix cache every pass: this arm's headline is
+            # continuous-vs-static batching, and this traffic draws
+            # unique prompts anyway — a pass re-serving the previous
+            # pass's cached prefills would measure the cache, not the
+            # scheduler (the --prefix-reuse arm measures the cache).
+            eng.drop_prefix_cache()
             sched = Scheduler(eng)
             cs = sched.run(reqs)
             span = (
@@ -376,6 +415,10 @@ def main():
         for on in ((False, True) if rep % 2 == 0 else (True, False)):
             obs.set_enabled(on)
             before = eng.decode_compiles
+            # Cold cache per pass: within a pair, the second arm would
+            # otherwise re-serve the first's cached prefills — a
+            # systematic bias toward whichever runs second.
+            eng.drop_prefix_cache()
             try:
                 cs = Scheduler(eng).run(ab_reqs)
             finally:
@@ -417,6 +460,250 @@ def main():
         ),
         "diverged_request_ids": [i for i, _ in diverged][:8],
     }
+
+    def median(xs):
+        xs = sorted(xs)
+        mid = len(xs) // 2
+        return xs[mid] if len(xs) % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+    def warm_engine(e):
+        """Compile an engine's whole ladder + its decode/spec step off
+        the clock, then drop whatever the warm prompts cached."""
+        Scheduler(e).run([
+            Request(id=-(i + 1), prompt=[1] * c, max_new_tokens=2)
+            for i, c in enumerate(e.prefill_ladder)
+        ])
+        e.drop_prefix_cache()
+
+    # ---------------------------------------------- prefix-sharing arm
+    # Zipf-distributed shared-prefix traffic (ROADMAP item 2's ground
+    # truth): N templates, popularity ~ 1/rank^a — the system-prompt /
+    # few-shot regime real traffic is dominated by.  Sharing engine vs
+    # an identical prefix_cache=False engine on IDENTICAL traffic,
+    # alternating drain-mode pass pairs (the PR-6 methodology: short
+    # passes, a contention burst contaminates one pair, the median
+    # stays in the clean bulk).  The sharing engine keeps its trie warm
+    # across passes — a long-lived server's steady state IS the
+    # treatment being measured; only host noise is paired away.
+    prefix_payload = None
+    if args.prefix_reuse:
+        n_tpl = args.prefix_reuse
+        tpl_lens = rng.randint(
+            max(args.prompt_min, (3 * args.prompt_max) // 4),
+            args.prompt_max + 1, size=n_tpl,
+        )
+        templates = [
+            rng.randint(1, args.vocab, size=int(n)).astype(np.int32)
+            for n in tpl_lens
+        ]
+        ranks = np.arange(1, n_tpl + 1, dtype=np.float64)
+        pz = ranks ** -args.zipf_a
+        pz /= pz.sum()
+        n_px = max(24, min(args.requests, 48))
+        choice = rng.choice(n_tpl, size=n_px, p=pz)
+        suffix = max(2, args.prompt_min // 2)
+        px_new = max(4, args.new_min)
+        px_prompts = [
+            np.concatenate([
+                templates[c],
+                rng.randint(1, args.vocab, size=suffix).astype(np.int32),
+            ]).tolist()
+            for c in choice
+        ]
+        px_reqs = [
+            Request(id=20_000 + i, prompt=p, max_new_tokens=px_new)
+            for i, p in enumerate(px_prompts)
+        ]
+        px_useful = n_px * px_new
+        longest_px = max(len(p) for p in px_prompts) + px_new
+        px_mbs = blocks_for(
+            pad_to(longest_px + args.spec_k, args.prefill_chunk),
+            args.block_len,
+        )
+        # Pool: templates stay resident (the trie) + a full-capacity
+        # working set — contention is not this arm's subject.
+        px_blocks = 1 + int(sum(
+            blocks_for(int(n), args.block_len) for n in tpl_lens
+        )) + args.batch * (px_mbs + 1)
+        px_eng = {}
+        for share in (False, True):
+            px_eng[share] = DecodeEngine(
+                model, params, capacity=args.batch,
+                num_blocks=px_blocks, block_len=args.block_len,
+                prefill_chunk=args.prefill_chunk,
+                max_blocks_per_slot=px_mbs, prefix_cache=share,
+            )
+            warm_engine(px_eng[share])
+        px_ratios = []
+        px_best = {False: float("inf"), True: float("inf")}
+        px_sched = None
+        for rep in range(args.obs_pairs or repeats):
+            spans = {}
+            for share in (
+                (False, True) if rep % 2 == 0 else (True, False)
+            ):
+                sched = Scheduler(px_eng[share])  # fresh per pass
+                cs = sched.run(px_reqs)
+                spans[share] = max(c.finished_at for c in cs)
+                px_best[share] = min(px_best[share], spans[share])
+                if share:
+                    px_sched = sched
+            px_ratios.append(spans[False] / spans[True])
+        hit_rate = (
+            px_sched.prefix_hit_tokens
+            / max(px_sched.prefix_lookup_tokens, 1)
+        )
+        prefix_payload = {
+            "templates": n_tpl,
+            "zipf_a": args.zipf_a,
+            "requests": n_px,
+            "template_len": [int(tpl_lens.min()), int(tpl_lens.max())],
+            "suffix_len": suffix,
+            "max_new": px_new,
+            # Steady-state (warm-trie) hit rate of the last sharing
+            # pass: matched prompt tokens / looked-up prompt tokens.
+            "prefix_hit_rate": round(hit_rate, 4),
+            "tokens_per_sec_sharing": round(px_useful / px_best[True], 1),
+            "tokens_per_sec_no_sharing": round(
+                px_useful / px_best[False], 1
+            ),
+            # Median of paired no-sharing/sharing makespan ratios
+            # (> 1 = sharing wins).
+            "speedup_vs_no_sharing": round(median(px_ratios), 3),
+            "pair_ratios": [round(r, 3) for r in px_ratios],
+            "cached_blocks": px_eng[True].prefix.cached_blocks,
+            "cow_compiles": px_eng[True].cow_compiles,
+            "decode_compiles_sharing": px_eng[True].decode_compiles,
+        }
+        del px_eng  # drop both engines' device pools
+
+    # ------------------------------------------------ speculative arm
+    # Zero-tail distilled draft (benchmarks/decode.py --draft-mode
+    # distilled): the target's blocks past `dl` become exact identities
+    # (proj/ff2 zeroed), so its function collapses to its first dl
+    # blocks at full honest cost — and those blocks + head ARE the
+    # draft.  Realistic draft cost, near-ideal acceptance: the measured
+    # bound a perfectly distilled draft reaches.  Spec engine vs plain
+    # engine on the SAME zero-tail target, alternating drain pairs.
+    spec_payload = None
+    if args.spec_k:
+        from chainermn_tpu.models import TransformerLM as _LM
+
+        dl = args.draft_layers or max(1, args.layers // 4)
+        zparams = dict(params)
+        for i in range(dl, args.layers):
+            blk = dict(zparams[f"block_{i}"])
+            for nm in ("proj", "ff2"):
+                blk[nm] = jax.tree.map(jnp.zeros_like, blk[nm])
+            zparams[f"block_{i}"] = blk
+        draft = _LM(
+            vocab=args.vocab, n_layers=dl, d_model=args.d_model,
+            n_heads=args.heads, d_ff=args.d_ff, max_len=max_total,
+            pos_enc="rope", n_kv_heads=args.kv_heads,
+            kv_dtype=jnp.int8 if args.kv_int8 else None,
+            decode_attention=args.decode_attention,
+        )
+        dparams = {
+            f"block_{i}": zparams[f"block_{i}"] for i in range(dl)
+        }
+        for nm in ("embed", "ln_f", "lm_head"):
+            dparams[nm] = zparams[nm]
+        # Decode-dominated drain traffic: short prompts, generous
+        # budgets — speculation's win is sequential-step count.
+        n_sp = max(12, min(args.requests, 24))
+        sp_new = max(12, min(args.new_max, 24))
+        sp_prompts = [
+            rng.randint(
+                1, args.vocab,
+                size=int(rng.randint(args.prompt_min,
+                                     max(args.prompt_min + 1, 17))),
+            ).astype(np.int32).tolist()
+            for _ in range(n_sp)
+        ]
+        longest_sp = max(len(p) for p in sp_prompts) + sp_new
+        sp_mbs = blocks_for(
+            pad_to(longest_sp + args.spec_k, args.prefill_chunk),
+            args.block_len,
+        )
+        sp_blocks = 1 + args.batch * (sp_mbs + 1)
+        sp_eng = {}
+        for spec in (False, True):
+            kw = dict(
+                capacity=args.batch, num_blocks=sp_blocks,
+                block_len=args.block_len,
+                prefill_chunk=args.prefill_chunk,
+                max_blocks_per_slot=sp_mbs,
+            )
+            if spec:
+                kw.update(draft_model=draft, draft_params=dparams,
+                          spec_k=args.spec_k)
+            sp_eng[spec] = DecodeEngine(model, zparams, **kw)
+            warm_engine(sp_eng[spec])
+        sp_reqs = [
+            Request(id=30_000 + i, prompt=p, max_new_tokens=sp_new)
+            for i, p in enumerate(sp_prompts)
+        ]
+        sp_useful = n_sp * sp_new
+        sp_ratios = []
+        sp_best = {False: float("inf"), True: float("inf")}
+        sp_tokens = {}
+        accept, per_req_min = None, None
+        for rep in range(args.obs_pairs or repeats):
+            spans = {}
+            for spec in (
+                (False, True) if rep % 2 == 0 else (True, False)
+            ):
+                sp_eng[spec].drop_prefix_cache()
+                sched = Scheduler(sp_eng[spec])  # fresh per pass
+                cs = sched.run(sp_reqs)
+                spans[spec] = max(c.finished_at for c in cs)
+                sp_best[spec] = min(sp_best[spec], spans[spec])
+                sp_tokens[spec] = {c.id: c.tokens for c in cs}
+                if spec:
+                    accept = (
+                        sched.spec_accepted / max(sched.spec_proposed, 1)
+                    )
+                    per_req_min = min(
+                        c.spec_accepted / max(c.spec_proposed, 1)
+                        for c in cs
+                    )
+            sp_ratios.append(spans[False] / spans[True])
+        # Greedy identity across arms (same zero-tail target): exact in
+        # fp32; bf16 near-argmax ties can flip between the 1-token step
+        # and the (k+1)-position verify kernel — report structure.
+        mism = []
+        for rid in sp_tokens[True]:
+            a, b = sp_tokens[True][rid], sp_tokens[False][rid]
+            first = next(
+                (i for i, (x, y) in enumerate(zip(a, b)) if x != y), None
+            )
+            if first is not None:
+                mism.append(first)
+        spec_payload = {
+            "k": args.spec_k,
+            "draft_layers": dl,
+            "target_layers": args.layers,
+            "draft": "zero-tail distillation (realistic draft cost, "
+                     "near-ideal acceptance)",
+            "requests": n_sp,
+            "max_new": sp_new,
+            # Aggregate and worst per-request greedy acceptance from the
+            # last speculative pass.
+            "accept_rate": round(accept, 4),
+            "accept_rate_per_request_min": round(per_req_min, 4),
+            "tokens_per_sec_spec": round(sp_useful / sp_best[True], 1),
+            "tokens_per_sec_plain": round(sp_useful / sp_best[False], 1),
+            "speedup_vs_plain": round(median(sp_ratios), 3),
+            "pair_ratios": [round(r, 3) for r in sp_ratios],
+            "decode_compiles_spec": sp_eng[True].decode_compiles,
+            "verify_compiles": sp_eng[True].verify_compiles,
+            "greedy_agreement_vs_plain": {
+                "requests_exact": n_sp - len(mism),
+                "requests": n_sp,
+                "min_first_divergence": min(mism) if mism else None,
+            },
+        }
+        del sp_eng
 
     payload = {
         "metric": "serving_tokens_per_sec",
@@ -498,6 +785,10 @@ def main():
         "speedup_vs_static": round(cont_tps / static_tps, 3),
         "greedy_agreement_vs_static": agreement,
     }
+    if prefix_payload is not None:
+        payload["prefix_reuse"] = prefix_payload
+    if spec_payload is not None:
+        payload["speculative"] = spec_payload
     print(json.dumps(payload))
     if args.out:
         from chainermn_tpu.utils import atomic_json_dump

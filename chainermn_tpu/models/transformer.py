@@ -170,6 +170,7 @@ class _DecoderBlock(nn.Module):
         value, keeping duplicate-index writes deterministic)."""
         from chainermn_tpu.ops import (
             MAX_FUSED_LEN,
+            MAX_VERIFY_T,
             flash_attention,
             fused_decode_attention,
             paged_decode_attention,
@@ -342,16 +343,29 @@ class _DecoderBlock(nn.Module):
                         )
                     ks_c = cache["k_scale"].at[:, pb, off].set(ks_t)
                     vs_c = cache["v_scale"].at[:, pb, off].set(vs_t)
-                valid = q_pos[:, -1] + 1
+                # The kernel's causal bound is the FIRST query position's
+                # (offset t adds t in-kernel); T == 1 reduces to the
+                # classic decode bound.  Idle slots mask to 0.
+                valid = q_pos[:, 0] + 1
                 if slot_mask is not None:
                     valid = jnp.where(slot_mask.astype(bool), valid, 0)
-                if (T == 1 and self.decode_attention == "fused"
-                        and not self.window):
+                # Verify chunks (per-row decode_pos, small static T — the
+                # speculative path) keep the Pallas kernel; prefill
+                # chunks (scalar decode_pos, large T) stay on the
+                # gathered einsum.
+                verify = (
+                    jnp.ndim(decode_pos) == 1 and 1 < T <= MAX_VERIFY_T
+                )
+                if (self.decode_attention == "fused" and not self.window
+                        and (T == 1 or verify)):
                     a = paged_decode_attention(
-                        q[:, 0], kc, vc, block_tables, valid,
+                        q[:, 0] if T == 1 else q, kc, vc, block_tables,
+                        valid,
                         k_scale=ks_c if quant else None,
                         v_scale=vs_c if quant else None,
-                    )[:, None]
+                    )
+                    if T == 1:
+                        a = a[:, None]
                 else:
                     # Gathered fallback (prefill chunks; einsum engines):
                     # materialize each row's logical kv-head-major view of

@@ -10,6 +10,7 @@ code in interpret mode).
 from chainermn_tpu.ops.chunked_ce import chunked_softmax_cross_entropy
 from chainermn_tpu.ops.decode_attention import (
     MAX_FUSED_LEN,
+    MAX_VERIFY_T,
     fused_decode_attention,
     paged_decode_attention,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "fused_decode_attention",
     "paged_decode_attention",
     "MAX_FUSED_LEN",
+    "MAX_VERIFY_T",
     "chunked_softmax_cross_entropy",
     "apply_rope",
     "random_crop",

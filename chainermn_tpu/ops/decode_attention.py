@@ -41,7 +41,13 @@ fp32 accumulator in VMEM scratch), so there is no ``MAX_FUSED_LEN``
 cap: VMEM holds one ``(block_len, Dh)`` panel at a time.  Blocks
 entirely past ``valid_len`` are skipped (``@pl.when``), so a
 short sequence in a long-capacity slot pays for the blocks it
-actually fills.
+actually fills.  A 4-D query ``(S, T, H, Dh)`` is the **multi-query
+verify mode** (the serving engine's speculative decode): ``T`` chunk
+positions ride as extra rows of each ``(slot, kv-head)`` program, and
+query offset ``t`` attends positions ``< valid_len + t`` — per-position
+causality inside the verify chunk, one kernel launch for all ``k + 1``
+positions (``T <= MAX_VERIFY_T``; ``T == 1`` is bit-identical to the
+3-D call).
 
 No reference counterpart (the reference has no incremental-decode stack;
 SURVEY §2.9's examples are training-side) — this extends the repo's
@@ -67,6 +73,13 @@ from chainermn_tpu.ops.flash_attention import NEG_INF, _use_interpret
 #: stage-whole-panel VMEM budget: k + v panels at Dh=128 bf16 hit ~4 MB
 #: at this L; callers fall back to the einsum path past it.
 MAX_FUSED_LEN = 16384
+
+#: query-position cap for :func:`paged_decode_attention`'s multi-query
+#: (speculative-verify) mode: T query offsets multiply the per-program
+#: row count (T·G rows vs G), so unbounded T would blow the scratch
+#: budget — and verify chunks are k+1 ≤ a handful anyway.  The model's
+#: paged decode branch falls back to the gathered einsum past it.
+MAX_VERIFY_T = 16
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, len_ref, *rest, scale, quant):
@@ -165,10 +178,16 @@ def fused_decode_attention(
 
 
 def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest,
-                  scale, block_len, quant):
+                  scale, block_len, quant, n_q, group):
     """One (slot, kv head, logical block): online-softmax accumulation of
     this block's contribution into the VMEM scratch; the last block
-    normalizes and writes the (G, Dh) output."""
+    normalizes and writes the (n_q·G, Dh) output.
+
+    ``n_q`` query positions ride as extra rows (row ``r`` is query offset
+    ``r // group``): offset ``t`` attends positions ``< valid + t`` —
+    per-position causality inside a speculative verify chunk, reducing to
+    the classic decode bound at ``n_q == 1``.
+    """
     if quant:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc = rest
     else:
@@ -188,24 +207,28 @@ def _paged_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest,
     valid = len_ref[s_idx]
     base = m_idx * block_len
 
-    @pl.when(base < valid)
+    @pl.when(base < valid + (n_q - 1))
     def _():
-        # Blocks wholly past valid_len are skipped: a short sequence in a
-        # long-capacity slot reads only the blocks it actually fills.
-        G = q_ref.shape[2]
-        q = q_ref[0, 0].astype(jnp.float32) * scale   # (G, Dh)
+        # Blocks wholly past the LAST query's bound are skipped: a short
+        # sequence in a long-capacity slot reads only its filled blocks.
+        R = q_ref.shape[2]  # n_q * group rows
+        q = q_ref[0, 0].astype(jnp.float32) * scale   # (R, Dh)
         k = k_ref[0, 0].astype(jnp.float32)           # (BL, Dh)
         v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (G, BL)
+        )  # (R, BL)
         if quant:
             s = s * ks_ref[0, 0, :, 0][None, :]
         pos = base + jax.lax.broadcasted_iota(
-            jnp.int32, (G, k.shape[0]), 1
+            jnp.int32, (R, k.shape[0]), 1
         )
-        mask = pos < valid
+        # Row r is query offset r // group; it may attend one position
+        # more than the row before it (the verify chunk's causality).
+        toff = jax.lax.broadcasted_iota(jnp.int32, (R, k.shape[0]), 0) \
+            // group
+        mask = pos < valid + toff
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -249,23 +272,38 @@ def paged_decode_attention(
     copy is ever materialized and there is no ``MAX_FUSED_LEN`` cap.
 
     Args:
-      q: ``(S, H, Dh)`` — each slot's current query position.
+      q: ``(S, H, Dh)`` — each slot's current query position — or
+        ``(S, T, H, Dh)`` for a T-position **speculative verify chunk**:
+        query offset ``t`` of slot ``s`` attends positions
+        ``< valid_len[s] + t`` (per-position causality inside the chunk;
+        the chunk's K/V must already be written to the pool).  ``T`` is
+        static and small (``<= MAX_VERIFY_T`` by the model's dispatch).
       k_pool/v_pool: ``(KH, num_blocks, block_len, Dh)`` physical pools
         (float, or int8 with scales).
       block_tables: ``(S, max_blocks)`` int32 — logical→physical block map
         per slot.  Entries past a slot's filled length may point anywhere
         valid (they are masked, conventionally 0 — the serving pool
         reserves physical block 0 as the parking block).
-      valid_len: ``(S,)`` int32 — positions ``< valid_len[s]`` attendable;
-        ``0`` marks an idle slot (output is well-defined zeros-over-guard,
-        discarded by the engine).
+      valid_len: ``(S,)`` int32 — the FIRST query position's causal bound:
+        positions ``< valid_len[s] + t`` attendable for query offset
+        ``t`` (plain decode has ``T == 1``, ``t == 0`` — unchanged);
+        ``0`` marks an idle slot (every row of query offset 0 is fully
+        masked — zeros-over-guard, discarded by the engine; later
+        offsets attend only the chunk's own parked writes, equally
+        discarded).
       k_scale/v_scale: ``(KH, num_blocks, block_len)`` fp32 — required iff
         the pool is int8 (same symmetric-absmax convention as
         :func:`fused_decode_attention`).
 
-    Returns ``(S, H, Dh)`` in ``q``'s dtype.
+    Returns ``(S, H, Dh)`` or ``(S, T, H, Dh)`` (matching ``q``) in
+    ``q``'s dtype.
     """
-    S, H, Dh = q.shape
+    multi = q.ndim == 4
+    if multi:
+        S, T, H, Dh = q.shape
+    else:
+        S, H, Dh = q.shape
+        T = 1
     KH, NB, BL, _ = k_pool.shape
     if H % KH:
         raise ValueError(f"H ({H}) must be a multiple of KH ({KH})")
@@ -279,12 +317,21 @@ def paged_decode_attention(
     quant = k_pool.dtype == jnp.int8
     if quant and (k_scale is None or v_scale is None):
         raise ValueError("int8 pool needs k_scale and v_scale")
-    qg = q.reshape(S, KH, G, Dh)
+    if multi:
+        # Query offsets ride as extra ROWS of each (slot, kv-head)
+        # program: (S, T, KH, G, Dh) -> (S, KH, T*G, Dh), offset t of
+        # group row g at row t*G + g (the kernel recovers t as
+        # row // G for its per-offset causal bound).
+        qg = q.reshape(S, T, KH, G, Dh).transpose(0, 2, 1, 3, 4) \
+            .reshape(S, KH, T * G, Dh)
+    else:
+        qg = q.reshape(S, KH, G, Dh)
+    R = T * G
     tbl = jnp.asarray(block_tables, jnp.int32)
     lens = jnp.asarray(valid_len, jnp.int32).reshape(S)
 
     q_spec = pl.BlockSpec(
-        (1, 1, G, Dh), lambda s, h, m, tbl, ln: (s, h, 0, 0)
+        (1, 1, R, Dh), lambda s, h, m, tbl, ln: (s, h, 0, 0)
     )
     kv_spec = pl.BlockSpec(
         (1, 1, BL, Dh), lambda s, h, m, tbl, ln: (h, tbl[s, m], 0, 0)
@@ -305,21 +352,24 @@ def paged_decode_attention(
         grid=(S, KH, MB),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (1, 1, G, Dh), lambda s, h, m, tbl, ln: (s, h, 0, 0)
+            (1, 1, R, Dh), lambda s, h, m, tbl, ln: (s, h, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((G, 1), jnp.float32),   # running max
-            pltpu.VMEM((G, 1), jnp.float32),   # normalizer
-            pltpu.VMEM((G, Dh), jnp.float32),  # output accumulator
+            pltpu.VMEM((R, 1), jnp.float32),   # running max
+            pltpu.VMEM((R, 1), jnp.float32),   # normalizer
+            pltpu.VMEM((R, Dh), jnp.float32),  # output accumulator
         ],
     )
     out = pl.pallas_call(
         functools.partial(
             _paged_kernel, scale=1.0 / math.sqrt(Dh), block_len=BL,
-            quant=quant,
+            quant=quant, n_q=T, group=G,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, KH, G, Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S, KH, R, Dh), q.dtype),
         interpret=_use_interpret(),
     )(tbl, lens, *operands)
+    if multi:
+        return out.reshape(S, KH, T, G, Dh).transpose(0, 2, 1, 3, 4) \
+            .reshape(S, T, H, Dh)
     return out.reshape(S, H, Dh)

@@ -7,13 +7,21 @@ while the scheduler streams requests through them — admission the moment a
 slot and pool blocks free up, retirement the moment EOS lands (Orca-style
 iteration-level scheduling over a vLLM-style paged KV pool).
 
-Three layers:
+Four layers:
 
 * :mod:`~chainermn_tpu.serving.kv_pool` — the fixed device-resident block
-  pool + host-side free-list allocator (zero device syncs).
+  pool + host-side REFCOUNTED free-list allocator (zero device syncs;
+  one physical block can back many block tables).
+* :mod:`~chainermn_tpu.serving.prefix_cache` — the prefix trie: hot
+  prompt prefixes (system prompts, few-shot templates, multi-turn
+  history) are MAPPED into new requests' block tables instead of
+  recomputed, with copy-on-write at the first divergent write into a
+  shared partial block.
 * :mod:`~chainermn_tpu.serving.engine` — the jitted fixed-capacity decode
   step (compiles exactly once; slot churn never recompiles) + chunked
-  prefill.
+  prefill; optionally one jitted SPECULATIVE round instead (K draft
+  proposals verified by one multi-position target forward — up to K+1
+  tokens per sequential step, greedy-exact).
 * :mod:`~chainermn_tpu.serving.scheduler` — admission queue, prefill/decode
   interleaving, eviction-based backpressure, ``serve.*`` metrics, plus the
   request-lifecycle observability layer: per-request timeline events
@@ -34,6 +42,7 @@ from chainermn_tpu.serving.kv_pool import (
     PoolExhausted,
     blocks_for,
 )
+from chainermn_tpu.serving.prefix_cache import PrefixCache
 from chainermn_tpu.serving.scheduler import (
     Completion,
     Request,
@@ -44,6 +53,7 @@ __all__ = [
     "BlockAllocator",
     "PagedKVPool",
     "PoolExhausted",
+    "PrefixCache",
     "blocks_for",
     "DecodeEngine",
     "Completion",

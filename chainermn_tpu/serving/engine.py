@@ -15,6 +15,29 @@ One step = gather block tables → paged decode attention
 per-slot sampling (independent RNG lanes, per-slot temperature, engine-wide
 ``top_k``).
 
+**Speculative decoding** (``draft_model``/``spec_k``): the hot loop becomes
+one jitted *round* instead — ``k`` sequential draft proposals per slot
+(plus one backfill forward for the last proposal's K/V), then ONE target
+verify forward over all ``k + 1`` positions (the paged kernel's
+multi-query mode — per-position causality inside the chunk), greedy
+prefix acceptance per slot.  A round costs ``k + 1`` draft steps + one
+target forward and emits 1..``k + 1`` tokens per slot; greedy output is
+exactly the target's own generation (speculation changes the schedule,
+never the tokens — Leviathan et al. 2023), and sampling slots simply
+accept zero drafts and sample the verify step's position-0 logits, which
+ARE the plain step's logits under the same stateless RNG key.  The draft
+owns its own block pools but **shares the target's block tables and
+allocator**, so admission, prefix sharing, eviction and rollback stay one
+accounting decision: a rejected tail is rolled back by *not advancing*
+the slot's position — its stale K/V (both pools) is causally masked and
+overwritten by later writes, never copied.
+
+**Prefix sharing** (``prefix_cache=True``): the engine owns a
+:class:`~chainermn_tpu.serving.prefix_cache.PrefixCache` over its
+allocator; the scheduler maps cached prompt blocks at admission and COWs
+shared partial blocks through :meth:`DecodeEngine.cow_copy` (one jitted
+whole-block copy across every layer of every pool — target and draft).
+
 Prefill runs through a second single-row jitted program in chunks drawn
 from a small fixed **ladder** of geometries (``prefill_ladder``, by
 default ``prefill_chunk`` and its halves down to 8 — one slot per call;
@@ -28,10 +51,13 @@ footprint so the scheduler can interleave decode steps between chunks
 the final chunk's padding waste (a short tail pays the nearest ladder
 size, not the full ``prefill_chunk``) at a bounded, admission-path-only
 compile cost — at most ``len(prefill_ladder)`` prefill variants, ever,
-and still exactly ONE decode-step variant.
+and still exactly ONE decode-step variant.  A speculative engine's
+prefill also runs the draft model over the same chunk (headless —
+``return_hidden``), so the draft's cache tracks the target's.
 
 Host↔device traffic per decode step: small int32 control vectors up
-(tokens/positions/tables/mask) and the ``(capacity,)`` sampled tokens down.
+(tokens/positions/tables/mask) and the sampled tokens down (``(capacity,)``
+plain; ``(capacity, k+1)`` + per-slot acceptance for a speculative round).
 Pool accounting stays host-side (:mod:`~chainermn_tpu.serving.kv_pool`) —
 no device sync beyond the token readback serving fundamentally needs for
 EOS detection.
@@ -40,11 +66,12 @@ EOS detection.
 from __future__ import annotations
 
 import math
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 from chainermn_tpu.serving.kv_pool import PagedKVPool
+from chainermn_tpu.serving.prefix_cache import PrefixCache
 
 
 class DecodeEngine:
@@ -69,13 +96,22 @@ class DecodeEngine:
         decode step stays a single variant).
       top_k: engine-wide sampling truncation (0 = off; static — part of
         the compiled program).
+      draft_model: optional draft :class:`TransformerLM` for speculative
+        decoding (same vocab; depth/width free).  Requires ``spec_k``.
+      draft_params: the draft's parameter pytree.
+      spec_k: draft proposals per round (0 = speculation off).
+      prefix_cache: share identical prompt prefixes through a refcounted
+        block trie (on by default).  Cached blocks survive their writers
+        until pool pressure or :meth:`drop_prefix_cache` releases them.
     """
 
     def __init__(self, model, params, capacity: int, num_blocks: int,
                  block_len: int = 16,
                  max_blocks_per_slot: Optional[int] = None,
                  prefill_chunk: int = 32, top_k: int = 0,
-                 prefill_ladder: Optional[List[int]] = None):
+                 prefill_ladder: Optional[List[int]] = None,
+                 draft_model=None, draft_params=None, spec_k: int = 0,
+                 prefix_cache: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -83,15 +119,40 @@ class DecodeEngine:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if (draft_model is None) != (spec_k == 0):
+            raise ValueError(
+                "speculative decoding needs BOTH draft_model and "
+                f"spec_k >= 1 (got draft_model={draft_model is not None}, "
+                f"spec_k={spec_k})"
+            )
+        if draft_model is not None:
+            if draft_params is None:
+                raise ValueError("draft_model needs draft_params")
+            if draft_model.vocab != model.vocab:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab} != target vocab "
+                    f"{model.vocab} — proposals would be meaningless"
+                )
+            from chainermn_tpu.ops import MAX_VERIFY_T
+
+            if not 1 <= spec_k <= MAX_VERIFY_T - 1:
+                raise ValueError(
+                    f"spec_k must be in [1, {MAX_VERIFY_T - 1}] "
+                    f"(verify chunk is k + 1 positions), got {spec_k}"
+                )
         self.model = model
         self.params = params
         self.capacity = capacity
         self.pool = PagedKVPool(model, num_blocks, block_len)
         self.block_len = block_len
+        self.spec_k = spec_k
+        self.draft_model = draft_model
         self.max_blocks = (
             max_blocks_per_slot
             if max_blocks_per_slot is not None
-            else max(1, math.ceil(model.max_len / block_len))
+            else max(
+                1, math.ceil((model.max_len + spec_k) / block_len)
+            )
         )
         if prefill_chunk < 1:
             raise ValueError(
@@ -126,6 +187,22 @@ class DecodeEngine:
         # the first step.
         self.pools = self.pool.pools
         self.pool.pools = None
+        if draft_model is not None:
+            # The draft's pools mirror the target's block geometry and
+            # SHARE its allocator + block tables: one physical block id
+            # addresses both pools, so admission/sharing/eviction/COW
+            # remain a single accounting decision.
+            dpool = PagedKVPool(draft_model, num_blocks, block_len)
+            self.draft_pools = dpool.pools
+            #: HBM bytes per block across target + draft pools.
+            self.pool.bytes_per_block += dpool.bytes_per_block
+        else:
+            self.draft_pools = None
+        #: prefix trie over this engine's allocator (None = sharing off).
+        self.prefix = (
+            PrefixCache(block_len, self.pool.allocator)
+            if prefix_cache else None
+        )
         #: per-slot RNG BASE keys + temperatures, HOST numpy mirrors
         #: written only at admission (never in the steady loop) and
         #: uploaded lazily — an eager device scatter per admission would
@@ -174,12 +251,20 @@ class DecodeEngine:
         # forward even when one slot is refilling, and prefill compute —
         # unlike the 1-token decode step — scales with every padded row.
         # ``last_idx >= 0`` marks the final chunk; the first generated
-        # token is sampled from that in-chunk position's logits.
-        def prefill_impl(pools, tokens, p0, table, last_idx, rng, temp):
+        # token is sampled from that in-chunk position's logits.  A
+        # speculative engine's prefill ALSO runs the draft model over the
+        # chunk (headless) so the draft cache tracks the target's.
+        def prefill_impl(pools, dpools, tokens, p0, table, last_idx, rng,
+                         temp):
             h, new_pools = model.apply(
                 {"params": params}, tokens, cache=pools, decode_pos=p0,
                 block_tables=table, return_hidden=True,
             )
+            if draft_model is not None:
+                _, dpools = draft_model.apply(
+                    {"params": draft_params}, tokens, cache=dpools,
+                    decode_pos=p0, block_tables=table, return_hidden=True,
+                )
             li = jnp.maximum(last_idx, 0)
             # LM head at the sampled position ONLY: the other chunk
             # rows' logits are never read, and a full (chunk, vocab)
@@ -193,10 +278,74 @@ class DecodeEngine:
                 + head["bias"].astype(jnp.float32)
             )
             nxt = pick(logits[0], rng, p0 + li, temp)
-            return new_pools, nxt
+            return new_pools, dpools, nxt
+
+        # One speculative ROUND, one jitted program: k + 1 sequential
+        # draft steps (the last backfills the final proposal's K/V — a
+        # permanent zero-K/V row after an all-accept round would poison
+        # the draft's context forever, same hazard
+        # models.lm_speculative_generate documents), then ONE target
+        # verify forward over the (k + 1)-position chunk with per-row
+        # positions, greedy prefix acceptance per slot.  Sampling slots
+        # (t > 0) accept zero drafts and sample position-0's logits —
+        # which ARE the plain step's logits under the same fold_in key,
+        # so sampling semantics are unchanged by speculation.
+        def spec_impl(pools, dpools, tokens, pos, tables, active, rng,
+                      temp):
+            k = spec_k
+
+            def dstep(carry, i):
+                tok, dp = carry
+                dlogits, dp = draft_model.apply(
+                    {"params": draft_params}, tok[:, None], cache=dp,
+                    decode_pos=pos + i, block_tables=tables,
+                    slot_mask=active,
+                )
+                nxt = jnp.argmax(dlogits[:, 0], axis=-1).astype(jnp.int32)
+                return (nxt, dp), nxt
+
+            (_, dpools), drafts = jax.lax.scan(
+                dstep, (tokens, dpools), jnp.arange(k + 1)
+            )
+            drafts = drafts[:k]  # step k only backfilled K/V
+            chunk = jnp.concatenate(
+                [tokens[None], drafts], axis=0
+            ).T  # (S, k+1): [last, d1..dk]
+            logits, pools = model.apply(
+                {"params": params}, chunk, cache=pools, decode_pos=pos,
+                block_tables=tables, slot_mask=active,
+            )
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, k+1)
+            agree = (g[:, :k] == chunk[:, 1:]).astype(jnp.int32)
+            n_accept = jnp.cumprod(agree, axis=1).sum(axis=1)
+            tok0 = jax.vmap(pick)(logits[:, 0], rng, pos, temp)
+            g = g.at[:, 0].set(tok0)
+            n_accept = jnp.where(temp > 0.0, 0, n_accept)
+            return pools, dpools, g, n_accept
+
+        # Copy-on-write: duplicate ONE physical block across every layer
+        # of every pool (target + draft) so a borrower of a shared
+        # partial block can diverge without scribbling the cached
+        # original.  Traced src/dst — one compiled variant, ever.
+        def cow_impl(pools, dpools, src, dst):
+            def dup(layer):
+                return {
+                    n: layer[n].at[:, dst].set(layer[n][:, src])
+                    for n in layer
+                }
+
+            pools = [dup(p) for p in pools]
+            if draft_model is not None:
+                dpools = [dup(p) for p in dpools]
+            return pools, dpools
 
         self._step = jax.jit(step_impl, donate_argnums=(0,))
-        self._prefill = jax.jit(prefill_impl, donate_argnums=(0,))
+        self._prefill = jax.jit(prefill_impl, donate_argnums=(0, 1))
+        self._spec = (
+            jax.jit(spec_impl, donate_argnums=(0, 1))
+            if draft_model is not None else None
+        )
+        self._cow = jax.jit(cow_impl, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------- slots
     def seed_slot(self, slot: int, seed: int, temperature: float) -> None:
@@ -228,9 +377,11 @@ class DecodeEngine:
         allocated blocks are masked by ``valid_len`` until real tokens
         overwrite them; pads past the allocation fall through the
         zero-initialized tail of ``table`` into reserved parking block
-        0, which is never read).  ``last_idx >= 0`` marks the final
-        chunk: the first generated token is sampled from the logits at
-        that in-chunk index and returned.
+        0, which is never read).  ``p0`` may start mid-block (a
+        prefix-cache hit resumes at the first unmatched token).
+        ``last_idx >= 0`` marks the final chunk: the first generated
+        token is sampled from the logits at that in-chunk index and
+        returned.
         """
         import jax.numpy as jnp
 
@@ -239,8 +390,9 @@ class DecodeEngine:
                 f"chunk must be 1-D with a ladder size "
                 f"{self.prefill_ladder}, got {chunk.shape}"
             )
-        self.pools, tok = self._prefill(
+        self.pools, self.draft_pools, tok = self._prefill(
             self.pools,
+            self.draft_pools,
             jnp.asarray(chunk, jnp.int32)[None],
             np.int32(p0),
             jnp.asarray(table, jnp.int32)[None],
@@ -278,12 +430,76 @@ class DecodeEngine:
         )
         return np.asarray(nxt)
 
+    def spec_step(self, tokens: np.ndarray, pos: np.ndarray,
+                  tables: np.ndarray, active: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """One speculative round (requires a draft; same fixed shapes as
+        :meth:`step`).  The slot at ``pos`` must have block-table
+        coverage for positions up to ``pos + spec_k`` (the verify chunk's
+        writes) — the scheduler allocates ahead.
+
+        Returns ``(tokens, n_accept)``: ``(capacity, spec_k + 1)`` int32
+        round tokens and ``(capacity,)`` int32 per-slot accepted draft
+        counts — slot ``s`` emits ``tokens[s, :n_accept[s] + 1]``
+        (greedy: accepted drafts + the target's correction/bonus;
+        sampling slots always emit exactly ``tokens[s, :1]``).
+        """
+        import jax.numpy as jnp
+
+        if self._spec is None:
+            raise RuntimeError(
+                "spec_step on a non-speculative engine — construct with "
+                "draft_model/draft_params/spec_k"
+            )
+        rng, temp = self._rng_temp()
+        self.pools, self.draft_pools, toks, n_accept = self._spec(
+            self.pools,
+            self.draft_pools,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(pos, jnp.int32),
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(active, bool),
+            rng, temp,
+        )
+        return np.asarray(toks), np.asarray(n_accept)
+
+    # ----------------------------------------------------- prefix sharing
+    def cow_copy(self, src: int, dst: int) -> None:
+        """Copy physical block ``src`` onto ``dst`` across every layer of
+        every pool (target + draft) — the device half of copy-on-write.
+        Pure block-table/refcount surgery stays with the caller."""
+        self.pools, self.draft_pools = self._cow(
+            self.pools, self.draft_pools, np.int32(src), np.int32(dst)
+        )
+
+    def drop_prefix_cache(self) -> int:
+        """Release every trie-held block reference (gc/retire pass);
+        returns the number of blocks released.  With no live slots the
+        allocator is back at its construction baseline afterwards."""
+        return self.prefix.clear() if self.prefix is not None else 0
+
     # ------------------------------------------------------- introspection
     @property
     def decode_compiles(self) -> int:
-        """Compiled-variant count of the decode step — the recompile
-        guard's subject: must stay 1 under arbitrary slot churn."""
-        return int(self._step._cache_size())
+        """Compiled-variant count of the hot-loop decode program — the
+        recompile guard's subject: must stay 1 under arbitrary slot
+        churn.  For a speculative engine the hot loop is the fused
+        draft+verify round program (the plain step is never dispatched),
+        so that is what is counted."""
+        prog = self._spec if self._spec is not None else self._step
+        return int(prog._cache_size())
+
+    @property
+    def verify_compiles(self) -> int:
+        """Speculative round variants (0 on a plain engine) — the "at
+        most one additional cached executable" the speculation feature
+        is allowed."""
+        return int(self._spec._cache_size()) if self._spec else 0
+
+    @property
+    def cow_compiles(self) -> int:
+        """Copy-on-write block-copy variants (must stay <= 1)."""
+        return int(self._cow._cache_size())
 
     @property
     def prefill_compiles(self) -> int:
@@ -297,7 +513,7 @@ class DecodeEngine:
         never touches a device buffer."""
         free = self.pool.allocator.free_blocks
         allocatable = self.pool.num_blocks - 1  # block 0 reserved
-        return {
+        out = {
             "capacity": self.capacity,
             "num_blocks": self.pool.num_blocks,
             "block_len": self.block_len,
@@ -309,6 +525,12 @@ class DecodeEngine:
             "decode_compiles": self.decode_compiles,
             "prefill_compiles": self.prefill_compiles,
         }
+        if self.prefix is not None:
+            out["prefix_cached_blocks"] = self.prefix.cached_blocks
+        if self.spec_k:
+            out["spec_k"] = self.spec_k
+            out["verify_compiles"] = self.verify_compiles
+        return out
 
     def alloc_blocks(self, n: int) -> Optional[List[int]]:
         return self.pool.allocator.alloc(n)

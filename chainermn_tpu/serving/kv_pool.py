@@ -17,9 +17,13 @@ retires or is evicted — the next admission reuses them without touching the
 device (vLLM's PagedAttention memory model, Kwon et al. 2023).
 
 Accounting is **pure host state**: :class:`BlockAllocator` is a Python free
-list + owner set, so allocation/free decisions in the steady decode loop
-never read device memory and never force a sync.  The only device work is
-the engine's jitted step itself.
+list + per-block refcount map, so allocation/share/free decisions in the
+steady decode loop never read device memory and never force a sync.  The
+only device work is the engine's jitted step itself.  Refcounts are what
+make prefix sharing safe: one physical block can back the same prompt
+prefix in many block tables (and stay pinned by the prefix trie after its
+requests retire), and it returns to the free list only when the last
+holder lets go.
 
 Physical block 0 is reserved as the **parking block**: the paged decode
 branch redirects idle slots' scatter writes there (with their own current
@@ -39,11 +43,15 @@ class PoolExhausted(RuntimeError):
 
 
 class BlockAllocator:
-    """Host-side free-list accounting for the physical block pool.
+    """Host-side REFCOUNTED free-list accounting for the physical pool.
 
-    No device syncs, ever: this is plain Python state.  Double-free and
-    foreign-block frees raise — silent accounting drift would surface
-    later as two slots scribbling over the same physical block.
+    No device syncs, ever: this is plain Python state.  ``alloc`` hands a
+    block out at refcount 1; :meth:`share` lends it to another holder
+    (prefix sharing — the same physical KV block mapped into several block
+    tables, or pinned by the prefix trie); ``free`` drops one reference
+    and reclaims the block only when the count hits zero.  Freeing a block
+    nobody holds raises — silent accounting drift would surface later as
+    two slots scribbling over the same physical block.
     """
 
     def __init__(self, num_blocks: int):
@@ -55,7 +63,7 @@ class BlockAllocator:
         # LIFO free list: recently-freed blocks are re-issued first (their
         # pool pages are the most likely to still be warm).
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._owned: set = set()
+        self._ref: Dict[int, int] = {}
 
     @property
     def free_blocks(self) -> int:
@@ -63,31 +71,56 @@ class BlockAllocator:
 
     @property
     def used_blocks(self) -> int:
-        return len(self._owned)
+        return len(self._ref)
+
+    def refcount(self, block: int) -> int:
+        """Current holder count (0 = free or reserved)."""
+        return self._ref.get(block, 0)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` physical block ids, or ``None`` when the pool is exhausted
-        (the scheduler's backpressure/eviction signal — never raises)."""
+        """``n`` physical block ids at refcount 1 each, or ``None`` when
+        the pool is exhausted (the scheduler's backpressure/eviction
+        signal — never raises)."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
-        self._owned.update(out)
+        for b in out:
+            self._ref[b] = 1
         return out
 
-    def free(self, blocks: Sequence[int]) -> None:
+    def share(self, blocks: Sequence[int]) -> None:
+        """Add one reference per block (the block must be live — sharing
+        a free block would resurrect reclaimed memory)."""
         for b in blocks:
-            if b not in self._owned:
+            if b not in self._ref:
                 raise ValueError(
-                    f"freeing block {b} that was never allocated (double "
+                    f"sharing block {b} that is not allocated — a borrowed "
+                    "reference must come from a live holder"
+                )
+        for b in blocks:
+            self._ref[b] += 1
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; a block is reclaimed to the free
+        list when its count reaches zero.  Freeing an unallocated block
+        (over-free or foreign id) raises."""
+        for b in blocks:
+            n = self._ref.get(b, 0)
+            if n == 0:
+                raise ValueError(
+                    f"freeing block {b} that was never allocated (over-"
                     "free or foreign id) — allocator state is corrupt"
                 )
-            self._owned.discard(b)
-            self._free.append(b)
+            if n == 1:
+                del self._ref[b]
+                self._free.append(b)
+            else:
+                self._ref[b] = n - 1
 
 
 def blocks_for(tokens: int, block_len: int) -> int:

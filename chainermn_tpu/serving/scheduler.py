@@ -18,14 +18,42 @@ Loop shape (one :meth:`Scheduler.run` iteration):
 3. **Decode step** for every live slot, then retire finished ones and
    recycle their blocks.
 
+**Prefix sharing** (engines built with ``prefix_cache=True``, the
+default): admission asks the engine's
+:class:`~chainermn_tpu.serving.prefix_cache.PrefixCache` for the longest
+cached prefix of ``prompt + carried`` and MAPS those physical blocks into
+the new slot's table (one refcount each — never a copy, never a
+recompute); prefill resumes at the first unmatched token.  A *partial*
+match lends the leading tokens of a cached block — the slot carries a
+pending **copy-on-write** and resolves it at its first write into that
+block (fresh block allocated, one jitted whole-block copy across every
+pool, borrowed reference dropped), so the cached original is never
+mutated.  Completed prefixes are inserted back: full prompt blocks when
+prefill finishes, full ``prompt + generated`` blocks at retirement
+(multi-turn reuse — the next turn's prompt embeds this turn's history).
+
+**Speculative decoding** (engines built with ``draft_model``/``spec_k``):
+the decode step becomes one speculative *round* — ``k`` draft proposals
+per slot verified by ONE multi-position target forward — emitting
+1..``k + 1`` tokens per slot per iteration.  EOS/budget retirement is
+checked token-by-token inside the round (over-accepted tails are
+dropped; their K/V is causally masked and rewritten later — rollback is
+the block table simply not advancing, refcounts make that safe under
+sharing).  Per-slot acceptance feeds ``serve.spec.*``.
+
 Backpressure: blocks are allocated lazily (per prefill chunk; one block
-per ``block_len`` decoded tokens).  When the pool is exhausted the
-scheduler **evicts the youngest-admitted slot** — its blocks return to the
-free list and the request re-queues at the FRONT carrying the tokens it
-already generated (recompute-style preemption: the re-admission prefills
-prompt + carried tokens and continues).  Evicting the youngest keeps the
-oldest requests' work; a request that cannot fit the pool even alone
-raises :class:`~chainermn_tpu.serving.kv_pool.PoolExhausted` at submit.
+per ``block_len`` decoded tokens; a speculative engine allocates
+``spec_k`` positions ahead for the verify chunk's writes).  When the
+free list runs dry the scheduler first **drains the prefix cache**
+(least-recently-used trie leaves nobody else holds — cached blocks are
+reuse *potential*, a live request beats them), then **evicts the
+youngest-admitted slot** — its references return to the allocator and
+the request re-queues at the FRONT carrying the tokens it already
+generated (recompute-style preemption: the re-admission re-matches the
+trie — usually its own just-cached prefix — then prefills the remainder
+and continues).  Evicting the youngest keeps the oldest requests' work;
+a request that cannot fit the pool even alone raises
+:class:`~chainermn_tpu.serving.kv_pool.PoolExhausted` at submit.
 
 Everything observable publishes into the PR-3 metrics registry
 (``serve.queue_depth``, ``serve.slot_occupancy``, ``serve.tokens``,
@@ -111,6 +139,11 @@ class Completion:
     request was evicted and re-admitted — queueing delay is
     ``first_admitted_at - arrival``, never ``admitted_at - arrival``,
     which would book time already spent in service to the queue).
+
+    ``prefix_hit_tokens`` counts prompt+carried tokens served from the
+    prefix cache, summed over every admission of this request;
+    ``spec_proposed``/``spec_accepted`` are this request's own draft
+    bookkeeping (greedy slots only — sampling slots never accept).
     """
 
     id: int
@@ -122,6 +155,9 @@ class Completion:
     finished_at: float
     evictions: int = 0
     first_admitted_at: float = 0.0
+    prefix_hit_tokens: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 @dataclass
@@ -132,6 +168,10 @@ class _QueueEntry:
     evictions: int = 0
     #: when the request FIRST entered a slot (survives evictions).
     first_admit: Optional[float] = None
+    #: lifetime accounting carried across evictions.
+    prefix_hit_tokens: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 class _Slot:
@@ -148,6 +188,9 @@ class _Slot:
         self.prefilling = True
         self.admit_time = admit_time
         self.admit_seq = admit_seq
+        #: table index of a borrowed PARTIAL prefix block (copy-on-write
+        #: pending: resolved before this slot's first write into it).
+        self.cow_idx: Optional[int] = None
 
     @property
     def total_generated(self) -> int:
@@ -206,6 +249,11 @@ class Scheduler:
             noop = _NoopInstrument()
             self._m_queue = self._m_occ = self._m_tokens = noop
             self._m_prefill = self._m_decode = self._m_mixed = noop
+            self._m_px_lookups = self._m_px_hit = self._m_px_rate = noop
+            self._m_px_cached = self._m_px_cow = noop
+            self._m_px_evicted = noop
+            self._m_spec_prop = self._m_spec_acc = noop
+            self._m_spec_rate = noop
             reg = None
         else:
             reg = registry if registry is not None else global_registry()
@@ -221,6 +269,21 @@ class Scheduler:
             self._m_mixed = reg.histogram(
                 "serve.mixed_ms", edges=DEFAULT_MS_EDGES
             )
+            self._m_px_lookups = reg.counter("serve.prefix.lookups")
+            self._m_px_hit = reg.counter("serve.prefix.hit_tokens")
+            self._m_px_rate = reg.gauge("serve.prefix.hit_rate")
+            self._m_px_cached = reg.gauge("serve.prefix.cached_blocks")
+            self._m_px_cow = reg.counter("serve.prefix.cow_copies")
+            self._m_px_evicted = reg.counter("serve.prefix.evicted_blocks")
+            self._m_spec_prop = reg.counter("serve.spec.proposed")
+            self._m_spec_acc = reg.counter("serve.spec.accepted")
+            self._m_spec_rate = reg.gauge("serve.spec.accept_rate")
+        #: lifetime host-side accounting (benchmarks read these directly;
+        #: the gauges above mirror the derived rates).
+        self.prefix_lookup_tokens = 0
+        self.prefix_hit_tokens = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         #: SLO monitor: an explicit one always wins; otherwise it shares
         #: the scheduler's publishing decision (same registry, no-op
         #: when the master switch turned metrics off).
@@ -272,6 +335,10 @@ class Scheduler:
         eng = self.engine
         cap = eng.max_blocks * eng.block_len
         total = plen + req.max_new_tokens
+        # A speculative round can probe/write up to spec_k positions past
+        # the final generated token (the verify chunk), so the slot's
+        # geometry must cover that headroom too.
+        probe_end = total + eng.spec_k
         # Worst-case prefill END over every possible (re-)admission: a
         # slot prefills prompt + carried tokens (carried grows to
         # max_new - 1 under eviction/recompute), full-size chunks while
@@ -283,26 +350,32 @@ class Scheduler:
         # positions).  Rounding total up to a full prefill_chunk
         # overstates this (the ladder tail is tighter) and would reject
         # servable requests whenever the cap is not a chunk multiple.
+        # (Prefix-cache hits can move the prefill start mid-chunk and
+        # change the padded end; admission caps the MATCH to fit —
+        # ``_cap_match`` — so the m=0 bound checked here is the one that
+        # must hold.)
         worst_end = self._worst_prefill_end(plen, total - 1)
-        if total > cap or worst_end > cap:
+        if max(probe_end, worst_end) > cap:
             raise PoolExhausted(
                 f"request {req.id}: {plen}+{req.max_new_tokens} tokens "
-                f"(worst padded prefill end {worst_end}) exceeds the "
-                f"per-slot cap {cap} (max_blocks={eng.max_blocks} x "
+                f"(worst padded prefill end {worst_end}, speculative "
+                f"probe end {probe_end}) exceeds the per-slot cap {cap} "
+                f"(max_blocks={eng.max_blocks} x "
                 f"block_len={eng.block_len})"
             )
-        if blocks_for(total, eng.block_len) > eng.pool.num_blocks - 1:
+        if blocks_for(probe_end, eng.block_len) > eng.pool.num_blocks - 1:
             raise PoolExhausted(
                 f"request {req.id}: needs "
-                f"{blocks_for(total, eng.block_len)} blocks, pool has "
+                f"{blocks_for(probe_end, eng.block_len)} blocks, pool has "
                 f"{eng.pool.num_blocks - 1} allocatable"
             )
-        if eng.model.pos_enc == "learned" and worst_end > eng.model.max_len:
+        if eng.model.pos_enc == "learned" and \
+                max(probe_end, worst_end) > eng.model.max_len:
             raise ValueError(
                 f"request {req.id}: worst padded prefill end {worst_end} "
-                f"exceeds the learned position table "
-                f"({eng.model.max_len}); use a rope model or shorter "
-                "requests"
+                f"(speculative probe end {probe_end}) exceeds the learned "
+                f"position table ({eng.model.max_len}); use a rope model "
+                "or shorter requests"
             )
         self._queue.append(_QueueEntry(req))
         if self.timeline is not None:
@@ -324,16 +397,11 @@ class Scheduler:
         scanning the top ``C`` lengths covers every residue's maximum —
         O(prefill_chunk) per submit, host-side only.
         """
-        ladder = self.engine.prefill_ladder
-        C = ladder[-1]
-        worst = 0
-        for t in range(max(lo, hi - C + 1), hi + 1):
-            r = t % C
-            end = t if r == 0 else t - r + next(
-                c for c in ladder if c >= r
-            )
-            worst = max(worst, end)
-        return worst
+        C = self.engine.prefill_ladder[-1]
+        return max(
+            self._padded_end(0, t)
+            for t in range(max(lo, hi - C + 1), hi + 1)
+        )
 
     def _try_admit(self) -> bool:
         if not self._queue:
@@ -345,13 +413,29 @@ class Scheduler:
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
             return False
-        text_len = len(entry.req.prompt) + len(entry.carried)
-        first = blocks_for(
-            min(self.engine.prefill_chunk, text_len),
-            self.engine.block_len,
-        )
-        if not self.engine.pool.allocator.can_alloc(first):
-            return False
+        eng = self.engine
+        BL = eng.block_len
+        text = list(entry.req.prompt) + list(entry.carried)
+        # Match BEFORE the allocator gate: a hot fully-cached prompt
+        # borrows nearly all its blocks from the trie, so gating on the
+        # unmatched requirement would refuse exactly the admissions
+        # sharing makes nearly free.  (The match only touches LRU
+        # stamps; references are shared below, after admission commits.)
+        matched, blocks, first = self._admission_plan(text)
+        if not eng.pool.allocator.can_alloc(first):
+            # The free list may be empty only because the prefix trie is
+            # hoarding retired blocks — reuse potential never blocks a
+            # live admission.
+            if eng.prefix is None:
+                return False
+            need = first - eng.pool.allocator.free_blocks
+            self._m_px_evicted.inc(eng.prefix.evict(need))
+            # The eviction may have released blocks the match above
+            # returned (they were only trie-held) — re-plan against the
+            # surviving trie before trusting any block id.
+            matched, blocks, first = self._admission_plan(text)
+            if not eng.pool.allocator.can_alloc(first):
+                return False
         self._queue.pop(0)
         if entry.first_admit is None:
             entry.first_admit = now
@@ -359,19 +443,110 @@ class Scheduler:
                 self.slo.observe(
                     "queue_wait", (now - entry.req.arrival) * 1e3
                 )
-        slot = _Slot(free[0], entry, self.engine.max_blocks, now,
+        slot = _Slot(free[0], entry, eng.max_blocks, now,
                      self._admit_seq)
         self._admit_seq += 1
         self._slots[free[0]] = slot
+        # Prefix-cache hit: map the matched blocks (borrowed references,
+        # never copies) and resume prefill at the first unmatched token.
+        # The match was capped at len(text) - 1 — the final prefill chunk
+        # must keep at least one real token, whose logits sample the
+        # first output — and then shortened until the remainder's padded
+        # prefill end fits the slot/table geometry (the submit() bound
+        # only covered the unmatched start).
+        if eng.prefix is not None:
+            if matched:
+                eng.pool.allocator.share(blocks)
+                for i, b in enumerate(blocks):
+                    slot.table[i] = b
+                slot.blocks = list(blocks)
+                slot.pos = matched
+                if matched % BL:
+                    # The last mapped block is partially ours: this
+                    # slot's first write into it copy-on-writes first.
+                    slot.cow_idx = matched // BL
+                entry.prefix_hit_tokens += matched
+                self._m_px_hit.inc(matched)
+            self._m_px_lookups.inc()
+            self.prefix_lookup_tokens += len(text)
+            self.prefix_hit_tokens += matched
+            self._m_px_rate.set(
+                self.prefix_hit_tokens
+                / max(self.prefix_lookup_tokens, 1)
+            )
+            self._m_px_cached.set(eng.prefix.cached_blocks)
         self.engine.seed_slot(free[0], entry.req.seed,
                               entry.req.temperature)
         if self.timeline is not None:
+            info = {}
+            if entry.evictions:
+                info["readmit"] = True
+            if matched:
+                info["prefix_tokens"] = matched
             self.timeline.record(
                 "admit", t=now, req=entry.req.id, slot=free[0],
-                info={"readmit": entry.evictions > 0} if entry.evictions
-                else None,
+                info=info or None,
             )
         return True
+
+    def _ladder_size(self, remaining: int) -> int:
+        """The prefill chunk geometry for ``remaining`` tokens — THE one
+        definition of the ladder policy (full-size chunks while more
+        than ``prefill_chunk`` remains, then the smallest ladder size
+        covering the tail).  `_prefill_chunk` (runtime), `_padded_end`
+        (the admission/submit safety bound), and `_admission_plan` (the
+        gate's fresh-block estimate) must all read the policy from here
+        or the bound silently desynchronizes from the real chunks."""
+        ladder = self.engine.prefill_ladder
+        if remaining >= ladder[-1]:
+            return ladder[-1]
+        return next(c for c in ladder if c >= remaining)
+
+    def _padded_end(self, start: int, text_len: int) -> int:
+        """Padded prefill end for a prefill that starts at ``start``."""
+        remaining = text_len - start
+        if remaining <= 0:
+            return start
+        r = remaining % self.engine.prefill_ladder[-1]
+        if r == 0:
+            return text_len
+        return text_len - r + self._ladder_size(r)
+
+    def _admission_plan(self, text):
+        """Admission sizing for ``text`` against the current trie state:
+        ``(matched, blocks, first_fresh)`` — the capped prefix match,
+        its table blocks, and the FRESH blocks the first prefill chunk
+        needs net of the mapped prefix (+1 for the COW copy of a
+        partial block)."""
+        eng = self.engine
+        BL = eng.block_len
+        matched, blocks, n_tbl = 0, [], 0
+        if eng.prefix is not None:
+            blocks, matched = eng.prefix.match(text, limit=len(text) - 1)
+            matched = self._cap_match(matched, len(text))
+            n_tbl = (matched + BL - 1) // BL
+            blocks = blocks[:n_tbl]
+        end1 = min(
+            matched + self._ladder_size(len(text) - matched), len(text)
+        )
+        first = max(
+            blocks_for(end1, BL) - n_tbl + (1 if matched % BL else 0), 0
+        )
+        return matched, blocks, first
+
+    def _cap_match(self, matched: int, text_len: int) -> int:
+        """Largest usable prefix match <= ``matched``: the remainder's
+        padded prefill end must stay inside the block table (pad writes
+        past it would clamp onto real blocks) and, for learned-pos
+        models, the position table.  ``matched == 0`` always qualifies —
+        submit() validated the unmatched geometry."""
+        eng = self.engine
+        cap = eng.max_blocks * eng.block_len
+        if eng.model.pos_enc == "learned":
+            cap = min(cap, eng.model.max_len)
+        while matched > 0 and self._padded_end(matched, text_len) > cap:
+            matched -= 1
+        return matched
 
     # ----------------------------------------------------------- eviction
     def _evict_youngest(self) -> bool:
@@ -394,39 +569,67 @@ class Scheduler:
             )
         return True
 
-    def _alloc_for(self, slot: _Slot, n_needed: int) -> None:
-        """Grow ``slot`` to ``n_needed`` blocks, evicting under pressure."""
-        while len(slot.blocks) < n_needed:
+    def _alloc_blocks(self, slot: _Slot, n: int) -> Optional[List[int]]:
+        """``n`` fresh blocks for ``slot`` under pool pressure: drain the
+        prefix cache first (LRU leaves nobody else holds), then evict the
+        youngest slot — possibly ``slot`` itself, in which case the
+        allocation is moot and ``None`` is returned."""
+        eng = self.engine
+        while True:
             if self._slots[slot.idx] is not slot:
                 # Already evicted — e.g. a co-slot's allocation earlier in
                 # the same step chose it as the youngest victim.  Growing
                 # it now would orphan the new blocks (the re-admission
                 # builds a fresh slot), i.e. leak pool memory.
-                return
-            got = self.engine.alloc_blocks(n_needed - len(slot.blocks))
+                return None
+            got = eng.alloc_blocks(n)
             if got is not None:
-                for b in got:
-                    slot.table[len(slot.blocks)] = b
-                    slot.blocks.append(b)
-                return
-            # Pool exhausted: evict the youngest slot (possibly `slot`
-            # itself — then this allocation is moot) and retry.
-            victim_was_self = (
-                self._slots[slot.idx] is slot
-                and max(
-                    (s.admit_seq for s in self._slots if s is not None),
-                ) == slot.admit_seq
-            )
-            if victim_was_self and sum(
-                s is not None for s in self._slots
-            ) == 1:
+                return got
+            # Cached-only prefix blocks are reuse POTENTIAL — release
+            # them before taking work away from a live request.
+            if eng.prefix is not None:
+                need = n - eng.pool.allocator.free_blocks
+                released = eng.prefix.evict(need)
+                if released:
+                    self._m_px_evicted.inc(released)
+                    continue
+            # Evict the youngest slot (possibly `slot` itself) and retry.
+            live = [s for s in self._slots if s is not None]
+            if len(live) == 1 and live[0] is slot:
                 raise PoolExhausted(
                     f"request {slot.entry.req.id} cannot fit the pool "
                     "even running alone — grow num_blocks"
                 )
             self._evict_youngest()
-            if self._slots[slot.idx] is not slot:
+
+    def _alloc_for(self, slot: _Slot, n_needed: int) -> None:
+        """Grow ``slot`` to ``n_needed`` blocks, evicting under pressure."""
+        while len(slot.blocks) < n_needed:
+            got = self._alloc_blocks(slot, n_needed - len(slot.blocks))
+            if got is None:
                 return  # the needy slot evicted itself; re-queued
+            for b in got:
+                slot.table[len(slot.blocks)] = b
+                slot.blocks.append(b)
+
+    def _resolve_cow(self, slot: _Slot) -> None:
+        """Copy-on-write the slot's borrowed PARTIAL prefix block before
+        its first write into it: fresh block, one jitted whole-block
+        copy (target + draft pools), borrowed reference dropped.  The
+        cached original is never mutated."""
+        if slot.cow_idx is None:
+            return
+        got = self._alloc_blocks(slot, 1)
+        if got is None:
+            return  # evicted itself under pressure; moot
+        idx = slot.cow_idx
+        src = slot.blocks[idx]
+        self.engine.cow_copy(src, got[0])
+        slot.table[idx] = got[0]
+        slot.blocks[idx] = got[0]
+        self.engine.release_blocks([src])
+        slot.cow_idx = None
+        self._m_px_cow.inc()
 
     # ------------------------------------------------------------ prefill
     def _prefill_round(self) -> bool:
@@ -454,20 +657,20 @@ class Scheduler:
     def _prefill_chunk(self, slot: _Slot) -> bool:
         eng = self.engine
         p0 = slot.pos
-        # Ladder policy: full-size chunks while more than prefill_chunk
-        # tokens remain, then the smallest ladder geometry covering the
-        # tail — one final call with minimal padded compute instead of a
-        # full prefill_chunk of mostly-pad forward.
-        remaining = len(slot.text) - p0
-        ladder = eng.prefill_ladder
-        if remaining >= ladder[-1]:
-            size = ladder[-1]
-        else:
-            size = next(c for c in ladder if c >= remaining)
+        # Ladder policy (one definition: _ladder_size): full-size chunks
+        # while more than prefill_chunk tokens remain, then the smallest
+        # ladder geometry covering the tail — one final call with
+        # minimal padded compute instead of a full prefill_chunk of
+        # mostly-pad forward.
+        size = self._ladder_size(len(slot.text) - p0)
         end = min(p0 + size, len(slot.text))
         self._alloc_for(slot, blocks_for(end, eng.block_len))
         if self._slots[slot.idx] is not slot:
             return True  # evicted itself under pressure; progress made
+        # First write into a borrowed partial prefix block → COW now.
+        self._resolve_cow(slot)
+        if self._slots[slot.idx] is not slot:
+            return True
         chunk = np.zeros((size,), np.int32)
         chunk[: end - p0] = slot.text[p0:end]
         last = end == len(slot.text)
@@ -493,6 +696,15 @@ class Scheduler:
         slot.pos = end
         if last:
             slot.prefilling = False
+            # The full text is now in cache — register its full blocks
+            # with the prefix trie so concurrent and future requests map
+            # instead of recompute (dedupes against existing chains).
+            if eng.prefix is not None:
+                eng.prefix.insert(
+                    slot.text,
+                    slot.blocks[: len(slot.text) // eng.block_len],
+                )
+                self._m_px_cached.set(eng.prefix.cached_blocks)
             first_token_ever = not slot.entry.carried
             self._emit(slot, int(tok))
             if first_token_ever and self.slo is not None:
@@ -510,14 +722,16 @@ class Scheduler:
         if not live:
             return False
         S = self.engine.capacity
+        k = self.engine.spec_k
         tokens = np.zeros((S,), np.int32)
         pos = np.zeros((S,), np.int32)
         tables = np.zeros((S, self.engine.max_blocks), np.int32)
         active = np.zeros((S,), bool)
         for s in live:
-            # The step writes position `pos` — make sure its block exists.
+            # The step writes position `pos` (a speculative round writes
+            # through `pos + spec_k`) — make sure those blocks exist.
             self._alloc_for(
-                s, blocks_for(s.pos + 1, self.engine.block_len)
+                s, blocks_for(s.pos + 1 + k, self.engine.block_len)
             )
         live = [
             s for s in self._slots if s is not None and not s.prefilling
@@ -538,7 +752,12 @@ class Scheduler:
             # injected stretch lands in this iteration's histogram
             # exactly like a real slowdown would.
             self._fault.hook("serve_step", count=self._iterations)
-        out = self.engine.step(tokens, pos, tables, active)
+        if k:
+            out, n_accept = self.engine.spec_step(
+                tokens, pos, tables, active
+            )
+        else:
+            out = self.engine.step(tokens, pos, tables, active)
         dur_ms = (time.perf_counter() - t0) * 1e3
         # The token readback above drained the dispatch queue: any
         # prefill work queued before this step has now been absorbed
@@ -561,8 +780,41 @@ class Scheduler:
                 self._iterations % self.slo.check_every == 0:
             self.slo.check()
         for s in live:
-            s.pos += 1
-            self._emit(s, int(out[s.idx]))
+            if k:
+                # One speculative round: emit the accepted drafts plus
+                # the target's correction/bonus, token by token — EOS or
+                # the budget can retire the slot mid-round, and the
+                # over-accepted tail is simply dropped (its K/V is
+                # causally masked and rewritten by later steps: rollback
+                # is the position not advancing, nothing is copied).
+                na = int(n_accept[s.idx])
+                emitted = 0
+                for j in range(na + 1):
+                    s.pos += 1
+                    self._emit(s, int(out[s.idx, j]))
+                    emitted += 1
+                    if self._slots[s.idx] is not s:
+                        break  # retired mid-round (EOS / budget)
+                if s.entry.req.temperature <= 0:
+                    # Acceptance capped at what was EMITTED: a mid-run
+                    # retirement leaves the tail drafts unused — neither
+                    # accepted nor rejected — while a full emission
+                    # (correction/bonus included) adjudicated all k.
+                    acc = min(emitted, na)
+                    prop = acc if emitted <= na else k
+                    entry = s.entry
+                    entry.spec_proposed += prop
+                    entry.spec_accepted += acc
+                    self.spec_proposed += prop
+                    self.spec_accepted += acc
+                    self._m_spec_prop.inc(prop)
+                    self._m_spec_acc.inc(acc)
+                    self._m_spec_rate.set(
+                        self.spec_accepted / max(self.spec_proposed, 1)
+                    )
+            else:
+                s.pos += 1
+                self._emit(s, int(out[s.idx]))
         return True
 
     def _emit(self, slot: _Slot, tok: int) -> None:
@@ -578,7 +830,20 @@ class Scheduler:
             reason = "length"
         if reason is None:
             return
-        self.engine.release_blocks(slot.blocks)
+        eng = self.engine
+        if eng.prefix is not None:
+            # Multi-turn reuse: cache the full blocks of prompt +
+            # generated history (positions [0, pos) are written — the
+            # last emitted token's K/V never is, and a speculative
+            # round's rejected tail lies past pos).  The next turn's
+            # prompt embeds this text verbatim and maps it.
+            seq = slot.text + slot.generated
+            eng.prefix.insert(
+                seq[: slot.pos],
+                slot.blocks[: slot.pos // eng.block_len],
+            )
+            self._m_px_cached.set(eng.prefix.cached_blocks)
+        eng.release_blocks(slot.blocks)
         self._slots[slot.idx] = None
         now = self.clock.now()
         self.completions.append(Completion(
@@ -591,6 +856,9 @@ class Scheduler:
             finished_at=now,
             evictions=slot.entry.evictions,
             first_admitted_at=slot.entry.first_admit,
+            prefix_hit_tokens=slot.entry.prefix_hit_tokens,
+            spec_proposed=slot.entry.spec_proposed,
+            spec_accepted=slot.entry.spec_accepted,
         ))
         if self.timeline is not None:
             self.timeline.record(
@@ -668,6 +936,17 @@ class Scheduler:
             "clock": round(self.clock.now(), 6),
             "engine": self.engine.stats(),
         }
+        if self.engine.prefix is not None:
+            state["prefix"] = {
+                "hit_tokens": self.prefix_hit_tokens,
+                "lookup_tokens": self.prefix_lookup_tokens,
+                "cached_blocks": self.engine.prefix.cached_blocks,
+            }
+        if self.engine.spec_k:
+            state["spec"] = {
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+            }
         if self.slo is not None and self.slo.last_report:
             state["slo"] = self.slo.last_report
         if self.timeline is not None:

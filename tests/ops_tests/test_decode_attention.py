@@ -136,3 +136,84 @@ def test_validation_errors():
             jnp.asarray(q), jnp.asarray(kc, jnp.int8),
             jnp.asarray(vc, jnp.int8), jnp.asarray([8], jnp.int32)
         )
+
+
+# ---------------------------------------------------------------- paged
+def _paged_oracle(q, kp, vp, tbl, valid):
+    """fp32 reference for the paged kernel's multi-query (verify) mode:
+    gather each slot's logical cache through its block table, mask per
+    query offset ``t`` at ``valid + t`` (per-position causality inside a
+    speculative verify chunk)."""
+    S, T, H, Dh = q.shape
+    KH, NB, BL, _ = kp.shape
+    G = H // KH
+    MB = tbl.shape[1]
+    out = np.zeros((S, T, H, Dh), np.float32)
+    for s in range(S):
+        kg = np.asarray(kp, np.float32)[:, tbl[s]].reshape(KH, MB * BL, Dh)
+        vg = np.asarray(vp, np.float32)[:, tbl[s]].reshape(KH, MB * BL, Dh)
+        for t in range(T):
+            bound = int(valid[s]) + t
+            if int(valid[s]) <= 0 or bound <= 0:
+                continue
+            for h in range(H):
+                sc = (np.asarray(q, np.float32)[s, t, h]
+                      @ kg[h // G, :bound].T) / np.sqrt(Dh)
+                p = np.exp(sc - sc.max())
+                p /= p.sum()
+                out[s, t, h] = p @ vg[h // G, :bound]
+    return out
+
+
+def test_paged_multi_query_verify_matches_oracle():
+    """The speculative-verify mode: T query positions per slot, offset t
+    attending positions < valid + t, blocks walked through the table."""
+    rng = np.random.RandomState(0)
+    S, T, H, KH, Dh, NB, BL, MB = 3, 4, 4, 2, 8, 12, 4, 6
+    q = jnp.asarray(rng.randn(S, T, H, Dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    tbl = rng.randint(1, NB, size=(S, MB)).astype(np.int32)
+    valid = np.asarray([9, 1, 17], np.int32)
+    from chainermn_tpu.ops import paged_decode_attention
+
+    out = paged_decode_attention(q, kp, vp, jnp.asarray(tbl),
+                                 jnp.asarray(valid))
+    assert out.shape == (S, T, H, Dh)
+    ref = _paged_oracle(q, kp, vp, tbl, valid)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_paged_single_query_is_multi_query_t1():
+    """The classic decode call (3-D q) must be bit-identical to the
+    multi-query mode at T == 1 — one code path, two entry shapes."""
+    rng = np.random.RandomState(1)
+    S, H, KH, Dh, NB, BL, MB = 2, 4, 2, 8, 8, 4, 4
+    q = jnp.asarray(rng.randn(S, H, Dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    tbl = jnp.asarray(rng.randint(1, NB, size=(S, MB)), jnp.int32)
+    valid = jnp.asarray([6, 11], jnp.int32)
+    from chainermn_tpu.ops import paged_decode_attention
+
+    a = paged_decode_attention(q, kp, vp, tbl, valid)
+    b = paged_decode_attention(q[:, None], kp, vp, tbl, valid)[:, 0]
+    assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_paged_idle_slot_zero_valid_is_defined():
+    """valid == 0 (idle slot): offset-0 rows are fully masked and come
+    out as the zeros-over-guard convention; later offsets only see the
+    chunk's own parked writes — everything finite, engine discards it."""
+    rng = np.random.RandomState(2)
+    S, T, H, KH, Dh, NB, BL, MB = 2, 3, 4, 2, 8, 8, 4, 4
+    q = jnp.asarray(rng.randn(S, T, H, Dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    tbl = jnp.zeros((S, MB), jnp.int32)
+    valid = jnp.zeros((S,), jnp.int32)
+    from chainermn_tpu.ops import paged_decode_attention
+
+    out = np.asarray(paged_decode_attention(q, kp, vp, tbl, valid))
+    assert np.isfinite(out).all()
+    assert (out[:, 0] == 0).all()  # offset 0: fully masked

@@ -47,11 +47,23 @@ def prompts():
 
 @pytest.fixture(scope="session")
 def oracle():
-    """Per-request sequential greedy reference."""
+    """Per-request sequential greedy reference, MEMOIZED per session:
+    every lm_generate call re-traces the whole scan, and the serving
+    tiers ask for the same (model config, prompt, n_new) references over
+    and over — equal flax configs produce identical outputs, so the
+    session cache turns repeat oracle calls into dict hits (a real chunk
+    of the tier's budget)."""
     from chainermn_tpu.models import lm_generate
 
+    cache = {}
+
     def run(model, params, prompt, n_new):
-        pr = jnp.asarray(np.asarray(prompt, np.int32))[None]
-        return np.asarray(lm_generate(model, params, pr, n_new))[0].tolist()
+        key = (model, tuple(prompt), n_new)
+        if key not in cache:
+            pr = jnp.asarray(np.asarray(prompt, np.int32))[None]
+            cache[key] = np.asarray(
+                lm_generate(model, params, pr, n_new)
+            )[0].tolist()
+        return cache[key]
 
     return run

@@ -67,7 +67,16 @@ def test_continuous_batching_matches_sequential_greedy(
 
 
 def test_all_blocks_recycled_after_drain(fused_engine_run):
+    """After the drain the prefix trie still pins the retired requests'
+    full blocks (reuse potential is the point of sharing); dropping the
+    cache — the gc/retire pass — returns the allocator to its
+    construction baseline, i.e. zero leaked blocks."""
     _, eng, _ = fused_engine_run
+    assert eng.prefix.cached_blocks > 0
+    assert eng.free_blocks() == (
+        eng.pool.num_blocks - 1 - eng.prefix.cached_blocks
+    )
+    eng.drop_prefix_cache()
     assert eng.free_blocks() == eng.pool.num_blocks - 1
 
 

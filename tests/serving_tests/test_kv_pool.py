@@ -41,14 +41,44 @@ def test_free_recycles_lifo():
     assert a.alloc(1) == [first[-1]]
 
 
-def test_double_free_and_foreign_free_raise():
+def test_over_free_and_foreign_free_raise():
     a = BlockAllocator(4)
     got = a.alloc(2)
     a.free([got[0]])
-    with pytest.raises(ValueError, match="double"):
+    # Refcount hit zero: another free is an over-free, not a decrement.
+    with pytest.raises(ValueError, match="over-free or foreign"):
         a.free([got[0]])
     with pytest.raises(ValueError, match="never allocated"):
         a.free([0])  # the reserved block was never issued
+
+
+def test_share_refcounts_and_decrement_free():
+    """Prefix-sharing semantics: ``share`` lends references, ``free`` of
+    a ref>1 block is a DECREMENT (the old double-free) and the block is
+    reclaimed only at zero."""
+    a = BlockAllocator(6)
+    got = a.alloc(2)
+    a.share([got[0]])
+    assert a.refcount(got[0]) == 2 and a.refcount(got[1]) == 1
+    free_before = a.free_blocks
+    a.free([got[0]])  # decrement, NOT a reclaim
+    assert a.refcount(got[0]) == 1
+    assert a.free_blocks == free_before
+    a.free([got[0]])  # last holder: reclaimed
+    assert a.refcount(got[0]) == 0
+    assert a.free_blocks == free_before + 1
+    with pytest.raises(ValueError, match="over-free or foreign"):
+        a.free([got[0]])
+
+
+def test_share_requires_live_block():
+    a = BlockAllocator(4)
+    got = a.alloc(1)
+    with pytest.raises(ValueError, match="not allocated"):
+        a.share([got[0] + 1 if got[0] + 1 < 4 else got[0] - 1])
+    a.free(got)
+    with pytest.raises(ValueError, match="not allocated"):
+        a.share(got)  # sharing a freed block would resurrect it
 
 
 def test_too_small_pool_rejected():

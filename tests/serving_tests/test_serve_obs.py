@@ -176,8 +176,10 @@ def test_flight_provider_names_live_state(obs_run, prompts, tmp_path):
     # ring, so the flight record's span dump shows serving activity too.
     ops = [s["op"] for s in tracer().ring.snapshot()]
     assert "serve.admit" in ops
-    # Drain so the shared engine's pool is clean for the next test.
+    # Drain (and drop the prefix trie's retained blocks) so the shared
+    # engine's pool is clean for the next test.
     sched.run([])
+    eng.drop_prefix_cache()
     assert eng.free_blocks() == eng.pool.num_blocks - 1
 
 
