@@ -269,6 +269,22 @@ def _obs_overhead_headline() -> dict | None:
     return _best_result("obs_overhead*.json", cands)
 
 
+def _serving_tpu_probe_date() -> str | None:
+    """Newest recorded attempt at the standing on-chip serving capture
+    (``result/serving_tpu_probe.json``); None when no probe was ever
+    recorded.  Surfaced in the summary only while the serving speedup
+    is still null."""
+    try:
+        with open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "result", "serving_tpu_probe.json",
+        )) as f:
+            rec = json.load(f)
+        return rec.get("probed_at")
+    except (OSError, ValueError):
+        return None
+
+
 def _emit(payload: dict) -> None:
     # ALWAYS recompute: a cached payload embeds the headlines as of its
     # own capture time, but the composite is compiled from result/ on disk
@@ -324,6 +340,15 @@ def _emit(payload: dict) -> None:
             obs.get("overhead_pct") if obs is not None else None
         ),
     }
+    # While the serving headline stays CPU-only, carry the newest
+    # TPU-probe attempt date (result/serving_tpu_probe.json — written
+    # each time a session tries the standing on-chip capture and finds
+    # the tunnel down), so the driver tail shows the capture was
+    # ATTEMPTED, not forgotten.
+    if summary["serving_speedup_vs_static"] is None:
+        probe = _serving_tpu_probe_date()
+        if probe is not None:
+            summary["serving_tpu_probe"] = probe
     for k in ("cache_age_hours", "cache_source_commit", "error"):
         if payload.get(k) is not None:
             summary[k] = payload[k]

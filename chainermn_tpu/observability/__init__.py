@@ -34,6 +34,17 @@ Four cooperating pieces, all default-on and all bounded:
   RequestTimeline`) exportable as Chrome trace-event JSON
   (:func:`~chainermn_tpu.observability.tracing.write_chrome_trace`,
   Perfetto-loadable).
+* :mod:`~chainermn_tpu.observability.fleet` — the fleet plane: NTP-style
+  clock offsets over the host p2p plane, ONE rank-0 merged Perfetto
+  trace (collectives aligned across ranks by per-op span ``seq``),
+  collective-skew histograms and gated straggler attribution
+  (``fleet.*``); :mod:`~chainermn_tpu.observability.analyze` is the
+  offline per-step critical-path reporter over a merged trace.
+* :mod:`~chainermn_tpu.observability.memory` — device-memory plane: HBM
+  watermark gauges (host-RSS fallback), a KV-pool occupancy /
+  fragmentation timeline fed by the serving scheduler, a drain-cycle
+  leak detector, and the ``"memory"`` flight-record provider
+  (``mem.*``).
 
 Env knobs (see ``docs/observability.md`` for the full table):
 
@@ -112,6 +123,19 @@ from chainermn_tpu.observability.aggregate import (  # noqa: E402
     MetricsAggregator,
     render_prometheus,
 )
+from chainermn_tpu.observability.fleet import (  # noqa: E402
+    ClockOffset,
+    FleetClock,
+    attribute_straggler,
+    collective_occurrences,
+    export_fleet_trace,
+    merge_fleet_trace,
+)
+from chainermn_tpu.observability.memory import (  # noqa: E402
+    MemoryMonitor,
+    device_memory_stats,
+    kv_pool_sample,
+)
 
 __all__ = [
     "enabled",
@@ -141,4 +165,13 @@ __all__ = [
     "snapshot_on_crash",
     "MetricsAggregator",
     "render_prometheus",
+    "ClockOffset",
+    "FleetClock",
+    "attribute_straggler",
+    "collective_occurrences",
+    "export_fleet_trace",
+    "merge_fleet_trace",
+    "MemoryMonitor",
+    "device_memory_stats",
+    "kv_pool_sample",
 ]

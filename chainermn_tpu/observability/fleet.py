@@ -1,0 +1,526 @@
+"""Fleet plane — clock alignment, merged traces, straggler attribution.
+
+PR 3 gave every rank excellent *local* telemetry: a span ring that says
+what THIS rank was doing, metrics that say how ITS ops distributed.  What
+no per-rank view can answer is the multi-rank question operations
+actually asks: **which rank stalled the allreduce**, and what was
+everyone else doing while they waited.  This module is that layer:
+
+* :class:`FleetClock` — NTP-style offset estimation between every rank's
+  monotonic clock and rank 0's, over the **existing host object plane**
+  (framed p2p ``send_obj``/``recv_obj`` — the same wire heartbeats and
+  votes ride; zero new meshes or ports).  Rank 0 holds per-rank offsets
+  (best-of-N probes, minimum-RTT sample wins, uncertainty ~ rtt/2); call
+  :meth:`~FleetClock.sync` at startup and again on a slow cadence to
+  track drift (``MetricsReport(fleet_trace=...)`` does both).
+* :func:`export_fleet_trace` — rank 0 gathers every rank's span-ring
+  dump via the same ``gather_obj`` path ``MetricsAggregator.collect``
+  uses, rebases each rank's monotonic timestamps onto rank 0's clock,
+  and writes ONE Perfetto-loadable Chrome trace: one process (track
+  group) per rank, collective spans (``barrier``/``bcast_obj``/
+  ``gather_obj``/…) visually aligned across ranks.
+* :func:`collective_occurrences` / :func:`attribute_straggler` — the
+  same merge, numerically: for each collective the per-rank *arrival*
+  spread (a collective completes only when its last rank shows up, so
+  the stall belongs to the last arriver), published as the
+  ``fleet.collective_skew_ms`` histogram (fixed default edges — the
+  exact-merge contract holds) and the ``fleet.straggler_rank`` gauge
+  (−1 = no attributable straggler: attribution is gated on an absolute
+  skew floor and a dominance share so an unfaulted run never names a
+  scapegoat out of scheduling noise).
+
+Cross-rank pairing rides two properties the tracer guarantees: spans
+carry ``t_mono`` (one monotonic base per rank — the clock the offsets
+map between) and ``seq`` (per-op open counter: host-plane collectives
+are issued in the same order on every rank, so the k-th ``barrier`` is
+the SAME barrier everywhere, however much each ring has evicted).
+
+The offline half lives in :mod:`~chainermn_tpu.observability.analyze`:
+``python -m chainermn_tpu.observability.analyze trace.merged.json``
+reports the per-step critical path (which rank + phase bounded each
+step) from an exported trace — causal attribution, where PR 2's
+heartbeat straggler stats were only distributional.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from chainermn_tpu.observability import metrics as _metrics
+from chainermn_tpu.observability import tracing as _tracing
+
+#: Host-plane composites whose cross-rank skew is worth attributing.
+COLLECTIVE_OPS = (
+    "barrier", "bcast_obj", "gather_obj", "allgather_obj", "allreduce_obj",
+)
+
+#: Merged-trace filename convention (under an obs dir).
+MERGED_TRACE = "trace.merged.json"
+
+#: Below this arrival spread a collective is considered aligned —
+#: sub-millisecond skew on a host plane is scheduling noise, not a
+#: straggler (``CMN_FLEET_MIN_SKEW_MS``).
+DEFAULT_MIN_SKEW_MS = 1.0
+#: A rank is named straggler only when it owns at least this share of
+#: the total attributed stall — a 60/40 split is contention, not a
+#: culprit.
+DEFAULT_MIN_SHARE = 0.5
+
+
+def ntp_offset(t0: float, t1: float, t2: float, t3: float):
+    """Classic NTP estimate from one round trip ``t0 → (t1, t2) → t3``
+    (local send, peer recv, peer reply, local recv — all raw clock
+    readings): returns ``(offset_s, rtt_s)`` where ``offset`` is *peer
+    clock minus local clock* (subtract it from a peer timestamp to land
+    on the local base) and ``rtt`` bounds the error at ``±rtt/2``."""
+    return ((t1 - t0) + (t2 - t3)) / 2.0, (t3 - t0) - (t2 - t1)
+
+
+@dataclass
+class ClockOffset:
+    """One peer's estimated clock relation to rank 0."""
+
+    rank: int
+    #: peer monotonic clock minus rank-0 monotonic clock, seconds.
+    offset_s: float
+    #: round-trip time of the winning (minimum-RTT) probe — the
+    #: alignment uncertainty is ~``rtt_s / 2``.
+    rtt_s: float
+    probes: int = 0
+
+    def to_dict(self) -> dict:
+        return {"rank": self.rank, "offset_s": self.offset_s,
+                "rtt_s": self.rtt_s, "probes": self.probes}
+
+
+class FleetClock:
+    """Pairwise monotonic-clock offsets, rank 0 ↔ every other rank.
+
+    ``comm`` is anything with ``rank``/``size``/``send_obj``/``recv_obj``
+    (a bare :class:`~chainermn_tpu.hostcomm.HostComm` or a
+    :class:`~chainermn_tpu.comm.base.CommunicatorBase`); ``None`` (or
+    size 1) degrades to the trivial single-rank clock, so one-process
+    runs export the same artifacts.
+
+    :meth:`sync` is a **collective**: every rank must call it together
+    (same rule as ``MetricsAggregator.collect``).  Rank 0 pings each
+    peer ``probes`` times in turn; each probe is two framed objects on
+    the existing p2p plane, and the minimum-RTT sample's offset wins
+    (congested probes inflate rtt symmetrically but their offset error
+    grows with it — the least-delayed exchange is the most truthful).
+    """
+
+    def __init__(self, comm=None, probes: int = 8):
+        if probes < 1:
+            raise ValueError(f"probes must be >= 1, got {probes}")
+        self.comm = comm
+        self.probes = int(probes)
+        self.rank = getattr(comm, "rank", 0) if comm is not None else 0
+        self.size = getattr(comm, "size", 1) if comm is not None else 1
+        # HostComm's p2p takes an ``op=`` label (span attribution);
+        # CommunicatorBase's does not — resolve the call shape once.
+        self._op_kw = False
+        if comm is not None:
+            import inspect
+
+            try:
+                self._op_kw = "op" in inspect.signature(
+                    comm.send_obj
+                ).parameters
+            except (TypeError, ValueError):
+                self._op_kw = False
+        # Clocks are per-PROCESS, not per mesh rank: on a HostComm mesh
+        # the two coincide, but an in-process multi-rank communicator
+        # (one process owning several mesh ranks — the forced-CPU test
+        # rig, hybrid meshes) has ONE clock for all its ranks, and a
+        # self-ping would deadlock on a queue nobody answers.  Sync
+        # between process REPRESENTATIVES: the first rank each process
+        # owns — the same identity a process reports under in the
+        # aggregation feed.
+        self.participants: List[int] = [self.rank]
+        if comm is not None:
+            nproc = getattr(comm, "_nproc", None)
+            topo = getattr(comm, "_topo", None)
+            if nproc is not None and hasattr(topo, "proc_of"):
+                reps: Dict[int, int] = {}
+                for r in range(self.size):
+                    reps.setdefault(topo.proc_of(r), r)
+                self.participants = [reps[p] for p in sorted(reps)]
+            else:
+                self.participants = list(range(self.size))
+        #: representative rank -> :class:`ClockOffset` (sync root only;
+        #: the root itself is the identity entry).  None until the
+        #: first :meth:`sync`.
+        self.offsets: Optional[Dict[int, ClockOffset]] = None
+        self.synced_at: Optional[float] = None
+
+    def _send(self, obj, dest: int) -> None:
+        if self._op_kw:
+            self.comm.send_obj(obj, dest, op="clock_sync")
+        else:
+            self.comm.send_obj(obj, dest)
+
+    def _recv(self, source: int):
+        if self._op_kw:
+            return self.comm.recv_obj(source, op="clock_sync")
+        return self.comm.recv_obj(source)
+
+    def sync(self) -> Optional[Dict[int, ClockOffset]]:
+        """Collective offset (re-)estimation; the root process returns
+        the offset map (and keeps it on ``self.offsets``), everyone else
+        None."""
+        now = time.perf_counter
+        root = self.participants[0] if self.participants else 0
+        if len(self.participants) <= 1 or self.comm is None:
+            self.offsets = {self.rank: ClockOffset(self.rank, 0.0, 0.0, 0)}
+            self.synced_at = now()
+            return self.offsets
+        if self.rank == root:
+            offsets = {root: ClockOffset(root, 0.0, 0.0, 0)}
+            for peer in self.participants[1:]:
+                best: Optional[ClockOffset] = None
+                for i in range(self.probes):
+                    t0 = now()
+                    self._send(i, peer)
+                    t1, t2 = self._recv(peer)
+                    t3 = now()
+                    off, rtt = ntp_offset(t0, t1, t2, t3)
+                    if best is None or rtt < best.rtt_s:
+                        best = ClockOffset(peer, off, rtt, self.probes)
+                # Sentinel closes the peer's probe loop — the peer never
+                # needs to know this side's probe count.
+                self._send(None, peer)
+                offsets[peer] = best
+            self.offsets = offsets
+            self.synced_at = now()
+            self._publish(offsets)
+            return offsets
+        while True:
+            msg = self._recv(root)
+            if msg is None:
+                # Participated (the root holds the offsets): mark it, or
+                # a later offsets-is-None check would re-enter the
+                # protocol alone and deadlock against the root.
+                self.synced_at = now()
+                return None
+            t1 = now()
+            self._send((t1, now()), root)
+
+    @staticmethod
+    def _publish(offsets: Dict[int, ClockOffset]) -> None:
+        import chainermn_tpu.observability as _obs
+
+        if not _obs.enabled():
+            return
+        reg = _metrics.registry()
+        worst = max((o.rtt_s for o in offsets.values()), default=0.0)
+        reg.gauge("fleet.clock_rtt_ms").set(worst * 1e3)
+
+    def offsets_s(self) -> Dict[int, float]:
+        """Plain ``{rank: offset_s}`` (identity when never synced)."""
+        if not self.offsets:
+            return {self.rank: 0.0}
+        return {r: o.offset_s for r, o in self.offsets.items()}
+
+
+# --------------------------------------------------------------- merging
+def span_dump(rank: int) -> dict:
+    """This rank's contribution to a fleet gather: the span ring plus
+    the epoch anchor (so the merged trace can be labeled in rank-0 wall
+    time) — all host-side state."""
+    tr = _tracing.tracer()
+    return {
+        "rank": int(rank),
+        "spans": tr.ring.snapshot(),
+        "spans_total": tr.ring.total,
+        "epoch_wall": _tracing.EPOCH_WALL,
+        "epoch_perf": _tracing.EPOCH_PERF,
+    }
+
+
+def _corrected(span: dict, offset_s: float) -> float:
+    """A span's start on the rank-0 monotonic base."""
+    return float(span["t_mono"]) - offset_s
+
+
+def collective_occurrences(
+    dumps: Sequence[dict],
+    offsets_s: Optional[Dict[int, float]] = None,
+    ops: Sequence[str] = COLLECTIVE_OPS,
+) -> List[dict]:
+    """Pair collective spans across rank dumps by ``(op, seq)`` and
+    measure per-occurrence arrival spread.
+
+    Returns one record per collective seen on ≥ 2 ranks, sorted by
+    median corrected arrival:  ``{"op", "seq", "arrival_s": {rank: t},
+    "end_s": {rank: t}, "skew_ms", "last_rank", "first_rank"}`` —
+    ``skew_ms`` is the arrival spread (max − min) and ``last_rank`` the
+    rank everyone else waited for.  Times are on the rank-0 monotonic
+    base (``offsets_s`` from :class:`FleetClock`; missing ranks default
+    to 0 offset — fine when all dumps share a host clock, e.g. tests).
+    """
+    offsets_s = offsets_s or {}
+    occ: Dict[tuple, dict] = {}
+    for dump in dumps:
+        rank = int(dump["rank"])
+        off = float(offsets_s.get(rank, 0.0))
+        for span in dump.get("spans", ()):
+            if span.get("op") not in ops or span.get("seq") is None:
+                continue
+            key = (span["op"], int(span["seq"]))
+            rec = occ.setdefault(
+                key, {"op": span["op"], "seq": int(span["seq"]),
+                      "arrival_s": {}, "end_s": {}}
+            )
+            t = _corrected(span, off)
+            rec["arrival_s"][rank] = t
+            rec["end_s"][rank] = t + float(span.get("ms", 0.0)) / 1e3
+    return finalize_occurrences(occ.values())
+
+
+def finalize_occurrences(records) -> List[dict]:
+    """Finish raw occurrence records (``{"op", "seq", "arrival_s",
+    "end_s"}``) into the shared occurrence contract: drop records seen
+    on < 2 ranks, stamp ``skew_ms``/``last_rank``/``first_rank``, and
+    order by median arrival.  THE one definition — the online merge and
+    the offline analyzer's trace reconstruction both finish through
+    here, so the skew/attribution semantics cannot drift between
+    them."""
+    out = []
+    for rec in records:
+        arr = rec["arrival_s"]
+        if len(arr) < 2:
+            continue
+        last = max(arr, key=arr.get)
+        first = min(arr, key=arr.get)
+        rec["skew_ms"] = (arr[last] - arr[first]) * 1e3
+        rec["last_rank"] = last
+        rec["first_rank"] = first
+        out.append(rec)
+    out.sort(key=lambda r: sorted(r["arrival_s"].values())
+             [len(r["arrival_s"]) // 2])
+    return out
+
+
+def attribute_straggler(
+    occurrences: Sequence[dict],
+    min_skew_ms: Optional[float] = None,
+    min_share: float = DEFAULT_MIN_SHARE,
+) -> dict:
+    """Causal straggler attribution over a run's collective occurrences.
+
+    Each occurrence's stall (its arrival spread) is charged to its
+    last-arriving rank, but only when the spread clears ``min_skew_ms``
+    (``CMN_FLEET_MIN_SKEW_MS``, default 1 ms) — sub-floor spreads are
+    scheduling noise.  A rank is *named* (``straggler_rank``) only when
+    its attributed stall owns ≥ ``min_share`` of the total; otherwise
+    ``straggler_rank`` is None and the per-rank ledger still tells the
+    contention story.  Gating both ways is what lets an unfaulted run
+    assert "no straggler" instead of electing whoever lost the most
+    coin flips.
+    """
+    if min_skew_ms is None:
+        min_skew_ms = float(
+            os.environ.get("CMN_FLEET_MIN_SKEW_MS", str(DEFAULT_MIN_SKEW_MS))
+        )
+    stall_ms: Dict[int, float] = {}
+    charged = 0
+    for rec in occurrences:
+        if rec["skew_ms"] < min_skew_ms:
+            continue
+        charged += 1
+        stall_ms[rec["last_rank"]] = (
+            stall_ms.get(rec["last_rank"], 0.0) + rec["skew_ms"]
+        )
+    total = sum(stall_ms.values())
+    straggler = None
+    if total > 0:
+        worst = max(stall_ms, key=stall_ms.get)
+        if stall_ms[worst] / total >= min_share:
+            straggler = worst
+    return {
+        "straggler_rank": straggler,
+        "stall_ms_by_rank": {str(r): round(v, 3)
+                             for r, v in sorted(stall_ms.items())},
+        "charged_collectives": charged,
+        "total_collectives": len(occurrences),
+        "total_stall_ms": round(total, 3),
+        "min_skew_ms": min_skew_ms,
+        "min_share": min_share,
+    }
+
+
+def chrome_fleet_events(
+    dumps: Sequence[dict],
+    offsets_s: Optional[Dict[int, float]] = None,
+    occurrences: Optional[Sequence[dict]] = None,
+) -> List[dict]:
+    """Chrome trace-event objects for a fleet of span dumps: one
+    *process* per rank (``pid`` = rank, named and sorted), every span a
+    complete ``X`` slice at its offset-corrected time (collectives under
+    cat ``collective``, everything else ``host_op``), plus a
+    ``straggler`` instant on the last-arriving rank's track for every
+    occurrence whose skew cleared the attribution floor.  Timestamps are
+    microseconds from the earliest corrected span, so the trace opens at
+    ~0 regardless of how long the processes were up."""
+    offsets_s = offsets_s or {}
+    t0 = None
+    for dump in dumps:
+        off = float(offsets_s.get(int(dump["rank"]), 0.0))
+        for span in dump.get("spans", ()):
+            t = _corrected(span, off)
+            t0 = t if t0 is None else min(t0, t)
+    if t0 is None:
+        t0 = 0.0
+    out: List[dict] = []
+    for dump in sorted(dumps, key=lambda d: int(d["rank"])):
+        rank = int(dump["rank"])
+        off = float(offsets_s.get(rank, 0.0))
+        out.append({"name": "process_name", "ph": "M", "pid": rank,
+                    "args": {"name": f"cmn rank {rank}"}})
+        out.append({"name": "process_sort_index", "ph": "M", "pid": rank,
+                    "args": {"sort_index": rank}})
+        for span in dump.get("spans", ()):
+            args = {k: span[k] for k in
+                    ("peer", "nbytes", "detail", "seq") if k in span}
+            if not span.get("ok", True):
+                args["error"] = span.get("error")
+            cat = ("collective" if span.get("op") in COLLECTIVE_OPS
+                   else "host_op")
+            out.append({
+                "name": span["op"], "cat": cat, "ph": "X",
+                "pid": rank, "tid": 0,
+                "ts": round((_corrected(span, off) - t0) * 1e6, 3),
+                "dur": round(float(span.get("ms", 0.0)) * 1e3, 3),
+                "args": args,
+            })
+    min_skew_ms = float(
+        os.environ.get("CMN_FLEET_MIN_SKEW_MS", str(DEFAULT_MIN_SKEW_MS))
+    )
+    for rec in occurrences or ():
+        if rec["skew_ms"] < min_skew_ms:
+            continue
+        out.append({
+            "name": "straggler", "cat": "fleet", "ph": "i", "s": "p",
+            "pid": rec["last_rank"], "tid": 0,
+            "ts": round((rec["arrival_s"][rec["last_rank"]] - t0) * 1e6, 3),
+            "args": {"op": rec["op"], "seq": rec["seq"],
+                     "skew_ms": round(rec["skew_ms"], 3)},
+        })
+    return out
+
+
+def merge_fleet_trace(
+    dumps: Sequence[dict],
+    offsets: Optional[Dict[int, "ClockOffset"]] = None,
+    registry=None,
+) -> dict:
+    """The rank-0 merge, comm-free (testable on synthetic dumps): skew
+    analysis + straggler attribution + the Chrome trace payload, and the
+    ``fleet.*`` metrics published (one ``fleet.collective_skew_ms``
+    observation per paired collective; ``fleet.straggler_rank`` −1 when
+    no rank clears the attribution gate).  Returns
+    ``{"payload", "summary"}`` — write ``payload`` with
+    :func:`write_fleet_trace`/``json.dump``."""
+    import chainermn_tpu.observability as _obs
+
+    offsets_s = (
+        {r: o.offset_s for r, o in offsets.items()} if offsets else {}
+    )
+    occurrences = collective_occurrences(dumps, offsets_s)
+    verdict = attribute_straggler(occurrences)
+    summary = {
+        "nranks": len(dumps),
+        "spans": sum(len(d.get("spans", ())) for d in dumps),
+        "max_skew_ms": round(
+            max((r["skew_ms"] for r in occurrences), default=0.0), 3
+        ),
+        "clock_offsets": (
+            {str(r): o.to_dict() for r, o in offsets.items()}
+            if offsets else None
+        ),
+        **verdict,
+    }
+    payload = {
+        "traceEvents": chrome_fleet_events(dumps, offsets_s, occurrences),
+        "displayTimeUnit": "ms",
+        # Extra top-level keys are legal Chrome-trace metadata: the
+        # offline analyzer reads this block, Perfetto ignores it.
+        "cmn_fleet": {
+            **summary,
+            "collectives": [
+                {"op": r["op"], "seq": r["seq"],
+                 "skew_ms": round(r["skew_ms"], 3),
+                 "last_rank": r["last_rank"],
+                 "arrival_s": {str(k): round(v, 6)
+                               for k, v in r["arrival_s"].items()},
+                 "end_s": {str(k): round(v, 6)
+                           for k, v in r["end_s"].items()}}
+                for r in occurrences
+            ],
+        },
+    }
+    if registry is not None or _obs.enabled():
+        reg = registry if registry is not None else _metrics.registry()
+        hist = reg.histogram("fleet.collective_skew_ms",
+                             _metrics.DEFAULT_MS_EDGES)
+        for rec in occurrences:
+            hist.observe(rec["skew_ms"])
+        reg.gauge("fleet.straggler_rank").set(
+            -1 if verdict["straggler_rank"] is None
+            else verdict["straggler_rank"]
+        )
+        reg.gauge("fleet.straggler_stall_ms").set(
+            verdict["total_stall_ms"]
+        )
+    return {"payload": payload, "summary": summary}
+
+
+def write_fleet_trace(path: str, payload: dict) -> str:
+    from chainermn_tpu.observability import aggregate as _oagg
+
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(_oagg.sanitize_json(payload), f)
+    return path
+
+
+def export_fleet_trace(
+    comm=None,
+    path: str = MERGED_TRACE,
+    clock: Optional[FleetClock] = None,
+    probes: int = 8,
+    registry=None,
+) -> Optional[dict]:
+    """**Collective**: gather every rank's span-ring dump to rank 0 (the
+    same ``gather_obj`` ride the metrics aggregation takes — zero new
+    meshes) and write ONE offset-corrected, Perfetto-loadable merged
+    trace.  Pass an already-synced :class:`FleetClock` to reuse its
+    offsets; otherwise a sync runs first (also collective).  Rank 0
+    returns the summary (with ``"path"``), everyone else None.
+    ``comm=None`` exports this process alone — same artifact shape."""
+    if clock is None:
+        clock = FleetClock(comm, probes=probes)
+    if clock.synced_at is None:
+        # Never synced ANYWHERE (synced_at is set on every participant,
+        # offsets only on the root) — run the collective sync now.
+        clock.sync()
+    rank = getattr(comm, "rank", 0) if comm is not None else 0
+    size = getattr(comm, "size", 1) if comm is not None else 1
+    dump = span_dump(rank)
+    if comm is not None and size > 1:
+        gathered = comm.gather_obj(dump, root=0)
+        if rank != 0:
+            return None
+    else:
+        gathered = [dump]
+    merged = merge_fleet_trace(gathered, clock.offsets, registry=registry)
+    merged["summary"]["path"] = write_fleet_trace(
+        path, merged["payload"]
+    )
+    return merged["summary"]

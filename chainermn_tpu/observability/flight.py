@@ -57,7 +57,9 @@ def register_provider(name: str, fn: Callable[[], dict]) -> None:
     The guard registers ``guard_report``; the detector its liveness view;
     the serving scheduler registers ``serving`` (live slot map, allocator
     occupancy, queue depth, in-flight request ids — see
-    ``docs/serving.md``)."""
+    ``docs/serving.md``); the memory monitor registers ``memory`` (fresh
+    HBM/RSS watermarks + the newest KV-pool sample — see
+    ``docs/observability.md`` "Memory")."""
     with _providers_lock:
         _providers[name] = fn
 

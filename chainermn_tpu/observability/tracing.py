@@ -40,6 +40,26 @@ from chainermn_tpu.observability import metrics as _metrics
 #: Bucket edges for host-op latency histograms (ms) — the registry default.
 _OP_EDGES = _metrics.DEFAULT_MS_EDGES
 
+#: Per-process epoch anchor: ONE wall-clock reading paired with ONE
+#: monotonic reading, captured together at import.  Every span timestamp
+#: is recorded on the monotonic clock (``perf_counter`` — the same clock
+#: that times durations) and converted to wall time only through this
+#: pair, so a rank's exported timestamps can never skew against its own
+#: durations the way mixing ``time.time()`` starts with ``perf_counter``
+#: durations could (NTP stepping the wall clock mid-run, coarse wall
+#: resolution).  Cross-rank alignment maps between ranks' monotonic
+#: clocks directly (:mod:`~chainermn_tpu.observability.fleet` estimates
+#: the pairwise offsets); the wall anchor exists only to label a merged
+#: trace with human time.
+EPOCH_WALL = time.time()
+EPOCH_PERF = time.perf_counter()
+
+
+def mono_to_wall(t_mono: float) -> float:
+    """Map a ``perf_counter`` timestamp onto this process's wall clock
+    via the import-time epoch anchor."""
+    return EPOCH_WALL + (t_mono - EPOCH_PERF)
+
 
 @dataclass
 class Span:
@@ -48,8 +68,15 @@ class Span:
     op: str
     peer: Optional[int] = None
     nbytes: Optional[int] = None
-    #: wall-clock start, seconds since epoch (for cross-rank alignment).
-    wall_start: float = 0.0
+    #: start on the MONOTONIC clock (``perf_counter`` — one clock base
+    #: per rank for both timestamps and durations; wall time is derived
+    #: through the epoch anchor at export).
+    t_mono: Optional[float] = None
+    #: per-op sequence number (assigned at span open by the tracer):
+    #: the k-th ``barrier`` span on every rank describes the SAME
+    #: collective, however much each rank's ring has evicted — the
+    #: fleet merge pairs collectives across ranks by this.
+    seq: Optional[int] = None
     ms: float = 0.0
     ok: bool = True
     error: Optional[str] = None
@@ -57,9 +84,11 @@ class Span:
     detail: Optional[str] = None
 
     def to_dict(self) -> dict:
-        d = {"op": self.op, "wall_start": self.wall_start,
+        t = self.t_mono if self.t_mono is not None else EPOCH_PERF
+        d = {"op": self.op, "t_mono": round(t, 6),
+             "wall_start": round(mono_to_wall(t), 6),
              "ms": round(self.ms, 3), "ok": self.ok}
-        for k in ("peer", "nbytes", "error", "detail"):
+        for k in ("peer", "nbytes", "error", "detail", "seq"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -124,6 +153,8 @@ class Tracer:
         #: thread ident -> stack of open spans (dict, not thread-local:
         #: the flight recorder reads OTHER threads' stacks).
         self._open: Dict[int, List[_OpenSpan]] = {}
+        #: per-op open counters: source of each span's ``seq``.
+        self._op_seq: Dict[str, int] = {}
         self._last_error: Optional[Span] = None
 
     # ----------------------------------------------------------------- spans
@@ -133,11 +164,19 @@ class Tracer:
         :class:`Span` is mutable — callers that only learn the byte count
         mid-op (recv) set ``span.nbytes`` before exit."""
         return _SpanCtx(self, Span(op=op, peer=peer, nbytes=nbytes,
-                                   detail=detail, wall_start=time.time()))
+                                   detail=detail))
 
     def _push(self, open_span: _OpenSpan) -> None:
         tid = threading.get_ident()
+        span = open_span.span
         with self._lock:
+            # Stamp at OPEN, under the tracer lock: ``t_mono`` shares the
+            # exact reading the duration pair uses, and ``seq`` counts
+            # opens per op — collectives open in the same order on every
+            # rank, so equal (op, seq) across ranks is the same event.
+            span.t_mono = open_span.t0
+            span.seq = self._op_seq.get(span.op, 0)
+            self._op_seq[span.op] = span.seq + 1
             self._open.setdefault(tid, []).append(open_span)
 
     def _pop(self, open_span: _OpenSpan, error: Optional[BaseException]):
@@ -286,8 +325,8 @@ class RequestTimeline:
                 else None
             )
             self.ring.append(Span(
-                op=f"serve.{kind}", peer=slot, wall_start=time.time(),
-                ms=dur_ms, detail=detail,
+                op=f"serve.{kind}", peer=slot,
+                t_mono=time.perf_counter(), ms=dur_ms, detail=detail,
             ))
 
     def events(self) -> List[LifecycleEvent]:

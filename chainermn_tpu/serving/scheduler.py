@@ -218,10 +218,11 @@ class Scheduler:
     :class:`~chainermn_tpu.serving.engine.DecodeEngine`."""
 
     def __init__(self, engine, registry=None, clock: Optional[_Clock] = None,
-                 slo=None, timeline=None):
+                 slo=None, timeline=None, memory=None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability import flight as _flight
         from chainermn_tpu.observability import tracing as _tracing
+        from chainermn_tpu.observability.memory import MemoryMonitor
         from chainermn_tpu.observability.metrics import (
             DEFAULT_MS_EDGES,
             registry as global_registry,
@@ -289,6 +290,17 @@ class Scheduler:
         #: when the master switch turned metrics off).
         self.slo = slo if slo is not None else (
             SLOMonitor(registry=reg) if reg is not None else None
+        )
+        #: Device-memory monitor (HBM watermarks + KV-pool occupancy /
+        #: fragmentation timeline): explicit wins; else it shares the
+        #: scheduler's publishing decision.  Sampled on the SLO check
+        #: cadence — a handful of gauge sets off allocator counters,
+        #: never a device sync.
+        self.memory = memory if memory is not None else (
+            MemoryMonitor(registry=reg) if reg is not None else None
+        )
+        self._mem_every = (
+            self.slo.check_every if self.slo is not None else 16
         )
         #: Request-lifecycle timeline: explicit wins; else ride the
         #: master switch, mirroring events into the process span ring
@@ -779,6 +791,9 @@ class Scheduler:
         if self.slo is not None and \
                 self._iterations % self.slo.check_every == 0:
             self.slo.check()
+        if self.memory is not None and \
+                self._iterations % self._mem_every == 0:
+            self.memory.sample(kv=self._kv_sample())
         for s in live:
             if k:
                 # One speculative round: emit the accepted drafts plus
@@ -903,9 +918,25 @@ class Scheduler:
         self._m_occ.set(0.0)
         if self.slo is not None:
             self.slo.check()
+        if self.memory is not None:
+            # Closing sample: the drained pool state (prefix pins only)
+            # is the baseline the leak detector measures against.
+            self.memory.sample(kv=self._kv_sample())
         return list(self.completions)
 
     # ------------------------------------------------------- observability
+    def _kv_sample(self) -> dict:
+        """KV-pool accounting sample for the memory monitor — live
+        slots' written positions vs held capacity feed the
+        fragmentation number."""
+        from chainermn_tpu.observability.memory import kv_pool_sample
+
+        return kv_pool_sample(
+            self.engine,
+            [(s.pos, len(s.blocks))
+             for s in self._slots if s is not None],
+        )
+
     def _flight_state(self) -> dict:
         """The ``"serving"`` flight-record section: what this engine is
         serving *right now* — readable even while :meth:`run` is live

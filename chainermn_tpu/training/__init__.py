@@ -144,11 +144,25 @@ class MetricsReport(Extension):
     ``CMN_OBS=0`` turns the whole extension into a no-op — set it for the
     *job*, never for a subset of ranks, or the enabled ranks block in a
     gather the disabled ones skip.
+
+    Fleet plane (``docs/observability.md`` "Fleet tracing"): with
+    ``fleet_trace`` set, the first tick runs an NTP-style clock sync
+    over the host object plane (re-run every ``fleet_resync`` ticks to
+    track drift), and ``finalize`` gathers every rank's span ring to
+    rank 0 and writes ONE offset-corrected, Perfetto-loadable merged
+    trace at that path — collective spans aligned across ranks,
+    ``fleet.collective_skew_ms`` / ``fleet.straggler_rank`` published.
+    Both steps are collectives on the same cadence contract as the
+    metrics gather.  ``memory=True`` (default) also publishes the
+    ``mem.*`` device watermarks each tick, so the merged feed carries
+    HBM alongside step time.
     """
 
     def __init__(self, comm=None, trigger=(10, "iteration"),
                  out_dir: str = "obs", prometheus: bool = False,
-                 aggregate: bool = True):
+                 aggregate: bool = True, memory: bool = True,
+                 fleet_trace: Optional[str] = None,
+                 fleet_probes: int = 8, fleet_resync: int = 64):
         super().__init__(self._fire, trigger=trigger, name="MetricsReport")
         self.comm = comm
         self.out_dir = out_dir
@@ -160,6 +174,13 @@ class MetricsReport(Extension):
             if aggregate else None
         )
         self._last_step: Optional[int] = None
+        self._memory = bool(memory)
+        self._mem_monitor = None
+        self.fleet_trace = fleet_trace
+        self._fleet_probes = int(fleet_probes)
+        self._fleet_resync = max(int(fleet_resync), 1)
+        self._fleet_clock = None
+        self._fires = 0
 
     @property
     def rank_path(self) -> str:
@@ -172,6 +193,28 @@ class MetricsReport(Extension):
         if it == self._last_step:  # finalize after an on-trigger last step
             return
         self._last_step = it
+        self._fires += 1
+        # Fleet clock: startup sync on the first tick, re-sync on a slow
+        # cadence (drift tracking).  Collective — same-iteration firing
+        # on every rank is the extension's existing contract.
+        if self.fleet_trace is not None and (
+                self._fleet_clock is None
+                or self._fires % self._fleet_resync == 0):
+            from chainermn_tpu.observability import fleet as _ofleet
+
+            if self._fleet_clock is None:
+                self._fleet_clock = _ofleet.FleetClock(
+                    self.comm, probes=self._fleet_probes
+                )
+            self._fleet_clock.sync()
+        # Device-memory watermarks land as gauges BEFORE the registry
+        # sample below, so this tick's feed line carries them.
+        if self._memory:
+            if self._mem_monitor is None:
+                from chainermn_tpu.observability import memory as _omem
+
+                self._mem_monitor = _omem.MemoryMonitor()
+            self._mem_monitor.sample()
         means = {}
         if trainer.last_metrics is not None:
             for k, v in trainer.last_metrics.items():
@@ -201,8 +244,28 @@ class MetricsReport(Extension):
     def finalize(self, trainer: "Trainer"):
         """Flush a final tick so a stop between triggers still lands the
         closing window (skipped when the last iteration already fired —
-        a duplicate step would desync feed consumers)."""
+        a duplicate step would desync feed consumers); then, with
+        ``fleet_trace`` configured, export the merged fleet trace
+        (collective — every rank reaches finalize at the same loop
+        exit)."""
         self._fire(trainer)
+        if self.fleet_trace is not None and _obs.enabled():
+            from chainermn_tpu.observability import fleet as _ofleet
+
+            summary = _ofleet.export_fleet_trace(
+                self.comm, path=self.fleet_trace,
+                clock=self._fleet_clock, probes=self._fleet_probes,
+            )
+            if summary is not None and jax.process_index() == 0:
+                _close_progress_line()
+                who = summary.get("straggler_rank")
+                print(
+                    f"[chainermn_tpu.fleet] merged trace -> "
+                    f"{summary['path']} ({summary['nranks']} ranks, "
+                    f"max skew {summary['max_skew_ms']} ms, straggler "
+                    f"{'none' if who is None else f'rank {who}'})",
+                    flush=True,
+                )
 
 
 class PrintReport(Extension):

@@ -1,0 +1,118 @@
+"""Fleet observability acceptance (ISSUE 8): real OS ranks, real p2p
+clock sync, one merged trace.
+
+1. **Faulted run** — a deterministic ``CMN_FAULT`` skew on rank 1's
+   work phase: the merged fleet trace must load as valid Chrome trace
+   JSON, every paired collective's per-rank spans must overlap within
+   the estimated clock-offset tolerance, and both the exporter's gauges
+   and the offline analyzer must name rank 1.
+2. **Unfaulted run** — same workload, no fault: no straggler attributed
+   (gauge −1, analyzer verdict None).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_HERE, "worker_fleet.py")
+REPO = os.path.dirname(os.path.dirname(_HERE))
+
+pytestmark = pytest.mark.resilience
+
+
+def _verdict(tmp_path, rank):
+    with open(tmp_path / f"verdict_{rank}.json") as f:
+        return json.load(f)
+
+
+def _occurrence_tolerance_s(summary):
+    """Alignment tolerance: the documented clock uncertainty (~rtt/2 of
+    the winning probes) plus a few ms of host scheduling slop."""
+    rtts = [
+        o["rtt_s"] for o in (summary.get("clock_offsets") or {}).values()
+    ]
+    return max(rtts, default=0.0) + 5e-3
+
+
+def test_skewed_rank_attributed_in_merged_trace(launch_job, tmp_path):
+    job = launch_job(
+        WORKER, nproc=2, timeout=420,
+        extra_env={
+            "CMN_FLEETW_ROUNDS": "8",
+            "CMN_FAULT": "skew@work:3:25ms",
+            "CMN_FAULT_RANK": "1",
+        },
+    )
+    assert job.returncode == 0, job.tail()
+    v0 = _verdict(tmp_path, 0)
+    assert _verdict(tmp_path, 1)["status"] == "ok"
+    summary = v0["summary"]
+
+    # Valid Chrome trace JSON with one process per rank.
+    trace = json.load(open(tmp_path / "trace.merged.json"))
+    assert isinstance(trace["traceEvents"], list)
+    pids = {e["pid"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert pids == {0, 1}
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {"cmn rank 0", "cmn rank 1"}
+
+    # Collective spans OVERLAP across ranks after offset correction: a
+    # collective completes only when every rank participates, so the
+    # last arrival must precede every rank's completion — within the
+    # estimated clock tolerance.
+    tol = _occurrence_tolerance_s(summary)
+    collectives = trace["cmn_fleet"]["collectives"]
+    assert len(collectives) >= 16  # 8 rounds x (barrier + allreduce...)
+    for rec in collectives:
+        last_arrival = max(rec["arrival_s"].values())
+        first_end = min(rec["end_s"].values())
+        assert last_arrival <= first_end + tol, (
+            f"{rec['op']}#{rec['seq']}: spans disjoint beyond the "
+            f"clock tolerance {tol * 1e3:.2f}ms "
+            f"(arrivals {rec['arrival_s']}, ends {rec['end_s']})"
+        )
+
+    # Attribution: the exporter, the gauges, and the offline analyzer
+    # all name the faulted rank.
+    assert summary["straggler_rank"] == 1
+    assert summary["max_skew_ms"] >= 20.0  # the injected 25ms, minus slop
+    assert v0["gauges"]["fleet.straggler_rank"] == 1
+    assert v0["gauges"]["fleet.straggler_stall_ms"] > 0
+    assert v0["skew_count"] == len(collectives)
+    r = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.observability.analyze",
+         str(tmp_path / "trace.merged.json"), "--json"],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(r.stdout)
+    assert report["straggler_rank"] == 1
+    # The skewed rounds' steps are bounded by rank 1.
+    assert report["bounded_steps_by_rank"].get("1", 0) >= 6
+
+
+def test_unfaulted_run_attributes_no_straggler(launch_job, tmp_path):
+    job = launch_job(
+        WORKER, nproc=2, timeout=420,
+        extra_env={"CMN_FLEETW_ROUNDS": "8"},
+    )
+    assert job.returncode == 0, job.tail()
+    v0 = _verdict(tmp_path, 0)
+    assert v0["summary"]["straggler_rank"] is None
+    assert v0["gauges"]["fleet.straggler_rank"] == -1
+    trace = json.load(open(tmp_path / "trace.merged.json"))
+    r = subprocess.run(
+        [sys.executable, "-m", "chainermn_tpu.observability.analyze",
+         str(tmp_path / "trace.merged.json"), "--json"],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert json.loads(r.stdout)["straggler_rank"] is None
+    assert trace["cmn_fleet"]["nranks"] == 2

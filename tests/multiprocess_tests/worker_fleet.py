@@ -1,0 +1,65 @@
+"""Worker for the fleet-observability acceptance test (real OS ranks).
+
+Control plane only: every rank builds the data-plane HostComm, runs an
+NTP clock sync, then a loop of work-phase + collectives (barrier and an
+``allreduce_obj``) with a ``work`` fault hook between fences.  Under
+``CMN_FAULT=skew@work:3:25ms`` scoped to rank 1, that rank arrives late
+at every collective from round 3 on — the exact fail-slow shape the
+fleet plane must attribute.  At the end every rank participates in
+``export_fleet_trace``; rank 0 writes the merged Perfetto trace and a
+verdict carrying the export summary plus its ``fleet.*`` gauges.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    from chainermn_tpu.hostcomm import HostComm
+    from chainermn_tpu.observability import fleet as ofleet
+    from chainermn_tpu.observability import metrics as omet
+    from chainermn_tpu.resilience import faults as ofaults
+
+    rank = int(os.environ["CMN_TPU_RANK"])
+    rounds = int(os.environ.get("CMN_FLEETW_ROUNDS", "8"))
+    tmp = os.environ["CMN_TEST_TMP"]
+    comm = HostComm(timeout_ms=30000)
+
+    clock = ofleet.FleetClock(comm, probes=8)
+    clock.sync()
+
+    inj = ofaults.process_injector()
+    for i in range(rounds):
+        # Work phase BETWEEN fences: skew@work delays this rank's
+        # arrival at the next collective (a genuine straggler), unlike a
+        # slow@barrier which would stretch the collective span itself.
+        if inj is not None:
+            inj.hook("work")
+        time.sleep(0.002)
+        comm.barrier()
+        comm.allreduce_obj(i, lambda a, b: a + b)
+
+    path = os.path.join(tmp, "trace.merged.json")
+    summary = ofleet.export_fleet_trace(comm, path=path, clock=clock)
+
+    verdict = {"status": "ok", "rank": rank}
+    if rank == 0:
+        snap = omet.registry().snapshot()
+        verdict["summary"] = summary
+        verdict["gauges"] = {
+            k: v.get("value") for k, v in snap.items()
+            if k.startswith("fleet.") and v["type"] == "gauge"
+        }
+        verdict["skew_count"] = snap["fleet.collective_skew_ms"]["count"]
+    comm.barrier()
+    comm.close()
+    out = os.path.join(tmp, f"verdict_{rank}.json")
+    with open(out, "w") as f:
+        json.dump(verdict, f)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -1,0 +1,256 @@
+"""Fleet plane, single-process: NTP offset math, clock sync over an
+in-process queue-pair "mesh", collective pairing under ring eviction,
+gated straggler attribution, Chrome-trace merging, and the offline
+critical-path analyzer.  The real 2-OS-rank acceptance (injected skew →
+merged trace + attribution) lives in
+``tests/multiprocess_tests/test_fleet_multiprocess.py``.
+"""
+
+import json
+import queue
+import threading
+
+import pytest
+
+from chainermn_tpu.observability import analyze as oanalyze
+from chainermn_tpu.observability import fleet as ofleet
+from chainermn_tpu.observability import metrics as omet
+
+pytestmark = pytest.mark.tier1
+
+
+# ------------------------------------------------------------ offset math
+def test_ntp_offset_recovers_known_offset():
+    # Peer clock runs 10.0s ahead; symmetric 2ms one-way delay.
+    # t0 local=100.0 -> arrives peer t1=110.002; replies t2=110.003;
+    # arrives local t3=100.005.
+    off, rtt = ofleet.ntp_offset(100.0, 110.002, 110.003, 100.005)
+    assert off == pytest.approx(10.0, abs=1e-9)
+    assert rtt == pytest.approx(0.004, abs=1e-9)
+
+
+def test_ntp_offset_error_bounded_by_asymmetry():
+    # Asymmetric delays (1ms out, 3ms back): the estimate is off by the
+    # asymmetry/2, never more — the documented rtt/2 bound.
+    off, rtt = ofleet.ntp_offset(100.0, 110.001, 110.001, 100.004)
+    assert abs(off - 10.0) <= rtt / 2
+
+
+# ------------------------------------------------- in-process clock sync
+class _PairComm:
+    """Two-rank object plane over queues — the p2p surface FleetClock
+    needs (send_obj/recv_obj with HostComm's ``op=`` kwarg), zero OS
+    processes."""
+
+    def __init__(self, rank, q_to_peer, q_from_peer):
+        self.rank = rank
+        self.size = 2
+        self._out = q_to_peer
+        self._in = q_from_peer
+
+    def send_obj(self, obj, dest, op="send_obj"):
+        self._out.put(obj)
+
+    def recv_obj(self, source, op="recv_obj"):
+        return self._in.get(timeout=30)
+
+
+def test_fleet_clock_sync_same_host_offset_near_zero():
+    """Both 'ranks' share one monotonic clock, so the estimated offset
+    must be ~0 (bounded by the winning probe's rtt) — the end-to-end
+    protocol check: probe loop, sentinel shutdown, min-rtt selection."""
+    a, b = queue.Queue(), queue.Queue()
+    c0, c1 = _PairComm(0, a, b), _PairComm(1, b, a)
+    clock0 = ofleet.FleetClock(c0, probes=5)
+    clock1 = ofleet.FleetClock(c1, probes=999)  # peer ignores its count
+    t = threading.Thread(target=clock1.sync, daemon=True)
+    t.start()
+    offsets = clock0.sync()
+    t.join(timeout=30)
+    assert not t.is_alive(), "peer never saw the sentinel"
+    assert set(offsets) == {0, 1}
+    est = offsets[1]
+    assert est.probes == 5
+    assert est.rtt_s < 0.5
+    assert abs(est.offset_s) <= max(est.rtt_s, 1e-3)
+    assert clock0.offsets_s()[1] == est.offset_s
+
+
+def test_fleet_clock_single_rank_identity():
+    clock = ofleet.FleetClock(None)
+    assert clock.sync() == {0: ofleet.ClockOffset(0, 0.0, 0.0, 0)}
+    assert clock.offsets_s() == {0: 0.0}
+
+
+# ------------------------------------------------------ pairing + verdict
+def _span(op, seq, t, ms=5.0, ok=True):
+    return {"op": op, "seq": seq, "t_mono": t, "ms": ms, "ok": ok}
+
+
+def _dumps(skew_s=0.025, n=6, from_k=3):
+    """Rank 1 arrives ``skew_s`` late at every collective from ``from_k``
+    on (sub-floor jitter before that)."""
+    d0 = {"rank": 0,
+          "spans": [_span("allreduce_obj", k, 10.0 + k, 30.0)
+                    for k in range(n)]}
+    d1 = {"rank": 1,
+          "spans": [_span("allreduce_obj", k,
+                          10.0 + k + (skew_s if k >= from_k else 2e-4))
+                    for k in range(n)]}
+    return [d0, d1]
+
+
+def test_collective_occurrences_pair_by_seq_and_measure_skew():
+    occ = ofleet.collective_occurrences(_dumps())
+    assert [o["seq"] for o in occ] == list(range(6))
+    assert all(o["last_rank"] == 1 for o in occ[3:])
+    assert occ[3]["skew_ms"] == pytest.approx(25.0, rel=1e-6)
+    assert occ[0]["skew_ms"] == pytest.approx(0.2, rel=1e-6)
+
+
+def test_collective_occurrences_survive_ring_eviction():
+    """seq is the pairing key, not ring position: a rank whose ring
+    evicted the early collectives still pairs the surviving ones."""
+    d0, d1 = _dumps()
+    d1["spans"] = d1["spans"][4:]  # rank 1's ring evicted seqs 0-3
+    occ = ofleet.collective_occurrences([d0, d1])
+    assert [o["seq"] for o in occ] == [4, 5]
+    assert all(o["last_rank"] == 1 for o in occ)
+
+
+def test_collective_occurrences_apply_clock_offsets():
+    """Rank 1's clock runs 100s ahead; after offset correction the fake
+    skew disappears into the injected one."""
+    d0, d1 = _dumps()
+    for s in d1["spans"]:
+        s["t_mono"] += 100.0
+    occ = ofleet.collective_occurrences([d0, d1], offsets_s={1: 100.0})
+    assert occ[3]["skew_ms"] == pytest.approx(25.0, rel=1e-6)
+    assert occ[0]["last_rank"] == 1 and occ[0]["skew_ms"] < 1.0
+
+
+def test_attribute_straggler_names_dominant_rank():
+    verdict = ofleet.attribute_straggler(
+        ofleet.collective_occurrences(_dumps())
+    )
+    assert verdict["straggler_rank"] == 1
+    assert verdict["charged_collectives"] == 3  # sub-floor jitter skipped
+    assert verdict["total_stall_ms"] == pytest.approx(75.0, rel=1e-5)
+    assert verdict["stall_ms_by_rank"] == {"1": pytest.approx(75.0, rel=1e-5)}
+
+
+def test_attribute_straggler_noise_names_nobody():
+    """An unfaulted run (sub-floor spreads only) must attribute NO
+    straggler — the gate that keeps the gauge honest."""
+    occ = ofleet.collective_occurrences(_dumps(skew_s=2e-4, from_k=0))
+    verdict = ofleet.attribute_straggler(occ)
+    assert verdict["straggler_rank"] is None
+    assert verdict["charged_collectives"] == 0
+
+
+def test_attribute_straggler_split_blame_names_nobody():
+    """Two ranks alternating as last-arriver split the stall ~50/50 —
+    contention, not a culprit; the share gate holds the name back."""
+    d0 = {"rank": 0, "spans": [
+        _span("barrier", k, 10.0 + k + (0.02 if k % 2 else 0.0))
+        for k in range(6)
+    ]}
+    d1 = {"rank": 1, "spans": [
+        _span("barrier", k, 10.0 + k + (0.0 if k % 2 else 0.02))
+        for k in range(6)
+    ]}
+    verdict = ofleet.attribute_straggler(
+        ofleet.collective_occurrences([d0, d1]), min_share=0.6
+    )
+    assert verdict["straggler_rank"] is None
+    assert set(verdict["stall_ms_by_rank"]) == {"0", "1"}
+
+
+# ------------------------------------------------------------ trace merge
+def test_merge_fleet_trace_payload_and_metrics():
+    reg = omet.MetricsRegistry()
+    merged = ofleet.merge_fleet_trace(_dumps(), registry=reg)
+    payload, summary = merged["payload"], merged["summary"]
+    # Valid, self-contained Chrome trace JSON.
+    blob = json.loads(json.dumps(payload))
+    evs = blob["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert "process_name" in names and "allreduce_obj" in names
+    pids = {e["pid"] for e in evs if e["ph"] == "X"}
+    assert pids == {0, 1}
+    # Slices start at ~0 (rebased to the earliest corrected span).
+    assert min(e["ts"] for e in evs if e["ph"] == "X") == 0.0
+    # One straggler instant per charged collective, on rank 1's track.
+    instants = [e for e in evs if e["ph"] == "i" and e["name"] == "straggler"]
+    assert len(instants) == 3 and all(e["pid"] == 1 for e in instants)
+    assert summary["straggler_rank"] == 1
+    assert summary["max_skew_ms"] == pytest.approx(25.0, rel=1e-5)
+    # fleet.* metrics: one skew observation per paired collective, the
+    # gauge names the culprit.
+    snap = reg.snapshot()
+    assert snap["fleet.collective_skew_ms"]["count"] == 6
+    assert snap["fleet.straggler_rank"]["value"] == 1
+    assert snap["fleet.straggler_stall_ms"]["value"] == \
+        pytest.approx(75.0, rel=1e-5)
+
+
+def test_merge_fleet_trace_unfaulted_gauges_minus_one():
+    reg = omet.MetricsRegistry()
+    ofleet.merge_fleet_trace(_dumps(skew_s=2e-4, from_k=0), registry=reg)
+    assert reg.snapshot()["fleet.straggler_rank"]["value"] == -1
+
+
+def test_export_fleet_trace_single_process(tmp_path):
+    """comm=None degrades to a one-rank export with the same artifact
+    shape (and real spans from the process tracer)."""
+    from chainermn_tpu.observability import tracing as otrace
+
+    tr = otrace.tracer()
+    with tr.span("barrier"):
+        pass
+    path = str(tmp_path / "trace.merged.json")
+    summary = ofleet.export_fleet_trace(None, path=path)
+    assert summary["path"] == path and summary["nranks"] == 1
+    blob = json.load(open(path))
+    assert {"traceEvents", "cmn_fleet"} <= set(blob)
+    assert summary["straggler_rank"] is None  # nobody to blame alone
+
+
+# -------------------------------------------------------------- analyzer
+def test_analyzer_critical_path_bounds_steps_on_last_rank():
+    merged = ofleet.merge_fleet_trace(_dumps(),
+                                      registry=omet.MetricsRegistry())
+    report = oanalyze.analyze(merged["payload"])
+    assert report["straggler_rank"] == 1
+    assert report["bounded_steps_by_rank"]["1"] >= 3
+    skewed = [s for s in report["steps"] if s["seq"] >= 3]
+    assert all(s["bound_rank"] == 1 for s in skewed)
+    assert all(s["wait_ms"] == pytest.approx(25.0, rel=1e-5)
+               for s in skewed)
+    # The bounding rank's phase covers its work since the previous
+    # fence: ~1s gaps in the synthetic dumps.
+    assert all(900.0 < s["bound_phase_ms"] < 1100.0
+               for s in report["steps"][1:] if s["bound_rank"] == 1)
+
+
+def test_analyzer_reconstructs_occurrences_without_metadata():
+    merged = ofleet.merge_fleet_trace(_dumps(),
+                                      registry=omet.MetricsRegistry())
+    payload = dict(merged["payload"])
+    payload.pop("cmn_fleet")  # any conforming chrome trace works
+    occ = oanalyze.occurrences_from_trace(payload)
+    assert [o["seq"] for o in occ] == list(range(6))
+    assert oanalyze.analyze(payload)["straggler_rank"] == 1
+
+
+def test_analyzer_cli_human_and_json(tmp_path, capsys):
+    merged = ofleet.merge_fleet_trace(_dumps(),
+                                      registry=omet.MetricsRegistry())
+    path = str(tmp_path / "t.json")
+    json.dump(merged["payload"], open(path, "w"))
+    assert oanalyze.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "straggler: rank 1" in out and "bounded by rank" in out
+    assert oanalyze.main([path, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["straggler_rank"] == 1 and len(rep["steps"]) == 6

@@ -36,7 +36,7 @@ def fresh_registry(monkeypatch):
 
 
 def _train(tmp_path, n_iter=5, trigger=2, prometheus=False,
-           extensions=()):
+           extensions=(), **report_kw):
     comm = cmn.create_communicator("flat")
     ds = cmn.scatter_dataset(
         make_synthetic_classification(64, 8, 4, seed=9), comm
@@ -49,7 +49,7 @@ def _train(tmp_path, n_iter=5, trigger=2, prometheus=False,
     it = SerialIterator(ds, 16, shuffle=True, seed=2)
     report = MetricsReport(
         comm=comm, trigger=(trigger, "iteration"), out_dir=str(tmp_path),
-        prometheus=prometheus,
+        prometheus=prometheus, **report_kw,
     )
     trainer = Trainer(
         opt, opt.init(params), classification_loss(model), it,
@@ -142,6 +142,86 @@ def test_nan_metrics_keep_feeds_strict_json(tmp_path):
     assert merged[-1]["merged"]["train.poisoned"]["per_rank"] == [None]
     text = open(os.path.join(str(tmp_path), "metrics.prom")).read()
     assert 'cmn_train_blown{stat="min"} +Inf' in text
+
+
+def test_memory_watermarks_ride_the_feed(tmp_path):
+    """MetricsReport samples the device-memory monitor before each
+    registry snapshot, so every feed line carries the mem.* gauges."""
+    report, _ = _train(tmp_path, n_iter=4, trigger=2)
+    last = _lines(report.rank_path)[-1]["registry"]
+    assert last["mem.in_use_bytes"]["value"] > 0
+    assert last["mem.in_use_bytes"]["type"] == "gauge"
+
+
+def test_fleet_trace_exported_at_finalize(tmp_path):
+    """The degenerate 1-rank fleet export through the extension: clock
+    sync at first tick, merged (single-process) trace at finalize — the
+    same artifact shape the multi-rank acceptance checks."""
+    path = tmp_path / "trace.merged.json"
+    report, _ = _train(tmp_path, n_iter=4, trigger=2,
+                       fleet_trace=str(path))
+    blob = json.loads(open(path).read())
+    assert blob["cmn_fleet"]["nranks"] == 1
+    assert blob["cmn_fleet"]["straggler_rank"] is None
+    assert report._fleet_clock is not None
+    off = report._fleet_clock.offsets
+    assert set(off) == {0} and off[0].offset_s == 0.0
+
+
+def test_fleet_quantiles_from_skewed_two_rank_merge(tmp_path):
+    """Satellite (ISSUE 8): ``MetricsAggregator(quantiles=...)`` +
+    ``histogram_quantile`` through a REAL 2-rank merge with deliberately
+    skewed per-rank distributions — the property straggler attribution
+    leans on: the fleet quantile estimated from exactly-merged buckets
+    EQUALS the estimate a single observer of every value would produce,
+    and the slow rank's tail owns the fleet p95."""
+    from chainermn_tpu.observability.aggregate import MetricsAggregator
+    from chainermn_tpu.observability.metrics import (
+        MetricsRegistry,
+        histogram_quantile,
+    )
+
+    fast = [1.0 + 4.0 * i / 94 for i in range(95)]        # rank 0: 1-5ms
+    slow = [200.0 + 700.0 * i / 94 for i in range(95)]    # rank 1: 0.2-0.9s
+    reg_a, reg_b, reg_one = (MetricsRegistry() for _ in range(3))
+    for v in fast:
+        reg_a.histogram("serve.slo.token_ms").observe(v)
+        reg_one.histogram("serve.slo.token_ms").observe(v)
+    for v in slow:
+        reg_b.histogram("serve.slo.token_ms").observe(v)
+        reg_one.histogram("serve.slo.token_ms").observe(v)
+    snap_a, snap_b = reg_a.snapshot(), reg_b.snapshot()
+
+    class _Comm:
+        rank, size = 0, 2
+
+        def gather_obj(self, entry, root=0):
+            return [{"rank": 0, "registry": snap_a},
+                    {"rank": 1, "registry": snap_b}]
+
+    agg = MetricsAggregator(comm=_Comm(), out_dir=str(tmp_path),
+                            quantiles=(0.5, 0.95, 0.995))
+    line = agg.collect(1, {"rank": 0, "registry": snap_a})
+    qs = line["quantiles"]["serve.slo.token_ms"]
+    # Sub-percent labels stay distinct (the :g formatting fix).
+    assert set(qs) == {"p50", "p95", "p99.5"}
+    # THE exactness property: merged-bucket estimates == the single
+    # observer's estimates, for every requested quantile.
+    one = reg_one.snapshot()["serve.slo.token_ms"]
+    merged_h = line["merged"]["serve.slo.token_ms"]
+    assert merged_h["counts"] == one["counts"]
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.995, "p99.5")):
+        assert qs[key] == pytest.approx(histogram_quantile(one, q))
+    # The skewed rank dominates the fleet tail; the median sits between
+    # the two populations.  190 samples: p95 is inside rank 1's range,
+    # clamped no higher than the recorded max.
+    assert 200.0 <= qs["p95"] <= 900.0
+    assert qs["p50"] <= qs["p95"]
+    # Per-rank p95s remain recoverable from the verbatim entries — the
+    # spread a straggler report would surface.
+    p95_a = histogram_quantile(snap_a["serve.slo.token_ms"], 0.95)
+    p95_b = histogram_quantile(snap_b["serve.slo.token_ms"], 0.95)
+    assert p95_b > 40 * p95_a
 
 
 def test_render_prometheus_on_merged_feed_line(tmp_path):

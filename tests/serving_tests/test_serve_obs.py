@@ -283,6 +283,59 @@ def test_observability_off_disables_lifecycle_layer(obs_run):
     try:
         sched = Scheduler(eng)
         assert sched.timeline is None and sched.slo is None
+        assert sched.memory is None
         assert sched.export_trace("/tmp/unused_trace.json") is None
     finally:
         obs.set_enabled(None)
+
+
+# ------------------------------------------------- device-memory plane
+def test_memory_monitor_samples_kv_pool(obs_run):
+    """The scheduler feeds the memory monitor on the SLO check cadence
+    plus a closing drain sample: mem.* gauges carry the pool accounting,
+    the timeline is non-empty, and the final sample shows the drained
+    state (no live slots, trie pins only)."""
+    eng, reg, _, _, sched, _ = obs_run
+    snap = reg.snapshot()
+    assert snap["mem.in_use_bytes"]["value"] > 0
+    assert snap["mem.kv.used_blocks"]["value"] is not None
+    assert 0.0 <= snap["mem.kv.occupancy"]["value"] <= 1.0
+    assert 0.0 <= snap["mem.kv.fragmentation"]["value"] <= 1.0
+    assert sched.memory is not None and len(sched.memory) >= 1
+    kv = sched.memory.last_kv
+    assert kv["bytes_per_block"] == eng.pool.bytes_per_block
+    assert kv["live_slots"] == 0  # closing sample: drained
+    assert kv["used_blocks"] == kv["cached_blocks"]  # only trie pins
+
+
+def test_flight_record_from_serving_process_has_memory_section(
+        obs_run, prompts, tmp_path):
+    """Acceptance: a flight record taken from a serving process carries
+    the ``"memory"`` provider section — HBM watermarks + the KV-pool
+    sample of the engine that was serving."""
+    from chainermn_tpu.observability.flight import FlightRecorder
+
+    eng = obs_run[0]
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    sched.run([Request(id=60, prompt=prompts[1], max_new_tokens=4)])
+    path = FlightRecorder(str(tmp_path), rank=0).record("sigusr1")
+    entry = json.loads(open(path).read().splitlines()[-1])
+    mem = entry["resilience"]["memory"]
+    assert mem["device"]["in_use_bytes"] > 0
+    assert mem["device"]["source"] in ("device", "host_rss")
+    assert mem["kv"]["num_blocks"] == eng.pool.num_blocks
+    assert mem["kv"]["block_len"] == eng.block_len
+    assert mem["timeline_samples"] >= 1
+
+
+def test_serving_drain_zero_leak_baseline(obs_run):
+    """Acceptance: after a full drain, the leak detector confirms the
+    PR-7 zero-leak baseline — a prefix-cache gc returns EVERY allocatable
+    block to the free list, and the gauge reads 0."""
+    eng, reg, _, _, sched, _ = obs_run
+    leaked = sched.memory.check_drained(eng)
+    assert leaked == 0
+    assert eng.free_blocks() == eng.pool.num_blocks - 1
+    assert reg.snapshot()["mem.kv.leaked_blocks"]["value"] == 0
+    # The post-gc resample reflects the empty pool.
+    assert sched.memory.last_kv["used_blocks"] == 0

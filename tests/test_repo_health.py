@@ -144,6 +144,78 @@ def test_metric_names_match_doc_catalog():
     )
 
 
+#: Env-var reads/sets in code: ``os.environ.get/[]/.setdefault`` plus the
+#: SLO module's ``_env_float`` indirection, plain or f-string literal.
+_ENV_CALL_RE = re.compile(
+    r"(?:environ\.get\(|environ\[|environ\.setdefault\(|_env_float\()"
+    r"\s*(f?)[\"']((?:CMN_|CHAINERMN_TPU_)[A-Za-z0-9_{}().]*)",
+    re.S,
+)
+
+
+def test_env_knob_names_match_doc_tables():
+    """Doc-drift lint, env-knob edition (ISSUE 8 satellite): every
+    ``CMN_*``/``CHAINERMN_TPU_*`` env var the code reads appears in some
+    docs/*.md knob-table row (first cell, backticked), and every
+    documented knob is actually read somewhere — the same two-way
+    contract the metric-catalog lint enforces.  F-string segments and
+    doc ``<placeholder>`` s both normalize to ``*`` and compare by
+    wildcard match (``CMN_SLO_*_P95_MS`` covers the per-stream rows)."""
+    import fnmatch
+
+    code_names = {}
+    for dirpath, dirnames, filenames in _walk("chainermn_tpu"):
+        if os.path.basename(dirpath) == "__pycache__":
+            continue
+        for f in filenames:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            with open(path) as fh:
+                src = fh.read()
+            for m in _ENV_CALL_RE.finditer(src):
+                code_names.setdefault(
+                    _normalize_metric(m.group(2)),
+                    os.path.relpath(path, REPO),
+                )
+    assert code_names, "env-literal scan found nothing — regex rot?"
+    doc_names = set()
+    docs_dir = os.path.join(REPO, "docs")
+    for doc in sorted(os.listdir(docs_dir)):
+        if not doc.endswith(".md"):
+            continue
+        with open(os.path.join(docs_dir, doc)) as fh:
+            for line in fh:
+                if not line.startswith("|"):
+                    continue
+                first_cell = line.split("|")[1]
+                for tok in re.findall(r"`([^`]+)`", first_cell):
+                    if re.fullmatch(
+                        r"(CMN_|CHAINERMN_TPU_)[A-Za-z0-9_<>]*", tok
+                    ):
+                        doc_names.add(_normalize_metric(tok))
+
+    def covered(name, others):
+        return any(
+            fnmatch.fnmatch(name, o) or fnmatch.fnmatch(o, name)
+            for o in others
+        )
+
+    undocumented = {
+        n: where for n, where in code_names.items()
+        if not covered(n, doc_names)
+    }
+    stale = {n for n in doc_names if not covered(n, set(code_names))}
+    assert not undocumented, (
+        "env knobs read in code but absent from every docs/*.md knob "
+        f"table (add a table row): {undocumented}"
+    )
+    assert not stale, (
+        "documented env knobs no code reads (delete or fix the row): "
+        f"{sorted(stale)}"
+    )
+
+
 def test_every_package_dir_has_init():
     missing = []
     for dirpath, dirnames, filenames in _walk("chainermn_tpu"):
