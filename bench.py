@@ -303,12 +303,26 @@ def _emit(payload: dict) -> None:
     if obs is not None:
         payload["observability_overhead"] = obs
     print(json.dumps(payload))
-    # Compact FINAL summary line (VERDICT r5 items 2 & 8): the composite
-    # payload above has grown past tail windows that capture only the last
-    # few hundred bytes of driver output — a consumer reading just the
-    # final line still gets the verdict: headline metric, the LM-MFU
-    # number (incl. flash-core FLOPs when present), and an unambiguous
-    # cached-vs-live provenance flag.
+    print(json.dumps(_summary_line(payload, lm, dec, srv, obs)))
+
+
+#: Byte budget for the FINAL ``bench_summary`` line.  The driver's
+#: mechanical capture reads only a tail window of stdout; once nested
+#: headline blobs grew the last line past it, the driver's ``parsed``
+#: field read null (VERDICT r5 weak #1).  Full payloads stay in the
+#: composite line above; the final line carries compact scalars +
+#: artifact POINTERS only, and ``_fit_summary`` enforces the budget
+#: (tier-1: ``tests/test_bench_summary.py``).
+SUMMARY_MAX_BYTES = 1024
+
+
+def _summary_line(payload: dict, lm=None, dec=None, srv=None,
+                  obs=None) -> dict:
+    """Compact FINAL summary (VERDICT r5 items 2 & 8): a consumer
+    reading just the last line gets the verdict — headline metric, the
+    LM-MFU number (incl. flash-core FLOPs when present), an unambiguous
+    cached-vs-live provenance flag, pointers to the headline artifacts,
+    and the perf sentinel's trajectory verdict — never a nested blob."""
     platform = str(payload.get("platform", ""))
     summary = {
         "bench_summary": True,
@@ -340,6 +354,13 @@ def _emit(payload: dict) -> None:
             obs.get("overhead_pct") if obs is not None else None
         ),
     }
+    # Artifact POINTERS, not payloads: the full headline dicts ride the
+    # composite line above; the tail line names where each number came
+    # from so a consumer can open the file.
+    for key, head in (("lm_artifact", lm), ("decode_artifact", dec),
+                      ("serving_artifact", srv)):
+        if head is not None and head.get("artifact"):
+            summary[key] = head["artifact"]
     # While the serving headline stays CPU-only, carry the newest
     # TPU-probe attempt date (result/serving_tpu_probe.json — written
     # each time a session tries the standing on-chip capture and finds
@@ -352,7 +373,50 @@ def _emit(payload: dict) -> None:
     for k in ("cache_age_hours", "cache_source_commit", "error"):
         if payload.get(k) is not None:
             summary[k] = payload[k]
-    print(json.dumps(summary))
+    # Perf-regression sentinel (ISSUE 11): compact trajectory verdict
+    # over the result/*.json history + this live headline — green, or
+    # regressed(metric, magnitude, first-bad artifact).  The FULL
+    # payload goes in as the live sample (not this summary): it carries
+    # the platform and batch/arch discriminator fields, so a forced-CPU
+    # plumbing run or a different-config capture is never judged against
+    # the TPU history.  Best-effort: the sentinel must never sink a
+    # bench emit.
+    try:
+        from chainermn_tpu.observability import perf as _operf
+
+        summary["perf_sentinel"] = _operf.sentinel(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "result"),
+            live=payload,
+        )
+    except Exception:
+        pass
+    return _fit_summary(summary)
+
+
+def _fit_summary(summary: dict) -> dict:
+    """Shrink the final line into :data:`SUMMARY_MAX_BYTES`, dropping
+    optional fields (least load-bearing first) before ever touching the
+    verdict scalars."""
+    def over():
+        return len(json.dumps(summary)) > SUMMARY_MAX_BYTES
+
+    if not over():
+        return summary
+    if isinstance(summary.get("error"), str):
+        summary["error"] = summary["error"][:80]
+    for k in ("serving_tpu_probe", "cache_source_commit",
+              "serving_artifact", "decode_artifact", "lm_artifact",
+              "cache_age_hours", "perf_sentinel", "error"):
+        if not over():
+            break
+        summary.pop(k, None)
+    if over():  # pathological (a huge metric/unit string): truncate all
+        summary = {
+            k: (v[:100] if isinstance(v, str) else v)
+            for k, v in summary.items()
+        }
+    return summary
 
 
 def _fail(reason: str, cache_ok: bool = False) -> None:

@@ -98,27 +98,38 @@ def main():
     params = jax.jit(
         lambda r: model.init(r, np.zeros((1, args.seq), np.int32))
     )(jax.random.PRNGKey(0))["params"]
-    opt = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
     loss_fn = lm_loss(model)
     rng = np.random.RandomState(0)
     toks = rng.randint(
         0, args.vocab, size=(args.batch, args.seq)
     ).astype(np.int32)
     batch = (toks, toks)
-    state0 = opt.init(params)
+    _opt0 = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
+    state0 = _opt0.init(params)
 
     obs_dir = tempfile.mkdtemp(prefix="cmn_obs_bench_")
 
     def run_arm(on: bool) -> float:
-        """Per-step wall ms through the Trainer (shared jitted step: the
-        SAME opt + loss callable hits the optimizer's step cache, so both
-        arms run one executable and the delta is pure host-side)."""
+        """Per-step wall ms through the Trainer.  Each arm builds its
+        OWN optimizer (→ its own jitted step, compiled in that arm's
+        warmup): the compile-watch wrap latches at the step's birth
+        (ISSUE 11), so a step born in the off arm would be a raw jit and
+        the on arm would silently measure a stack with its fourth plane
+        missing.  Identical programs compile identically; the compile
+        lands in the warmup either way, never in the timed window."""
         obs.set_enabled(on)
+        opt = cmn.create_multi_node_optimizer(optax.adamw(3e-4), comm)
         try:
+            # device=True: the obs-on arm carries the FULL stack under
+            # measurement, compile watcher + device roofline gauges
+            # included (ISSUE 11 — the A/B proves the fourth plane also
+            # fits the <1% contract; the one-time cost capture lands in
+            # the arm's warmup, not the timed window).
             exts = (
                 [MetricsReport(comm, trigger=(args.report_every,
                                               "iteration"),
-                               out_dir=os.path.join(obs_dir, "on"))]
+                               out_dir=os.path.join(obs_dir, "on"),
+                               device=True)]
                 if on else []
             )
             # Fresh trainer + a fresh COPY of the state per arm: the step
@@ -132,6 +143,16 @@ def main():
                 stop=(args.warmup, "iteration"), has_aux=True,
             )
             trainer.run()  # warmup (compile on first arm, cache after)
+            if on:
+                # Pre-warm the device plane's ONE-TIME cost capture (an
+                # extra lowering of the step) outside the timed window —
+                # the A/B measures the steady-state cost of the plane,
+                # exactly as step compiles live in the warmup.
+                from chainermn_tpu.observability import device as odev
+
+                wf = odev.watch().find("train_step")
+                if wf is not None:
+                    wf.cost_analysis()
             trainer.stop_n = args.warmup + args.iters
             trainer.extensions = list(exts)
             t0 = time.perf_counter()

@@ -45,6 +45,17 @@ Four cooperating pieces, all default-on and all bounded:
   fragmentation timeline fed by the serving scheduler, a drain-cycle
   leak detector, and the ``"memory"`` flight-record provider
   (``mem.*``).
+* :mod:`~chainermn_tpu.observability.device` — device/compile plane:
+  the :class:`~chainermn_tpu.observability.device.CompileWatch` records
+  every compilation of a wrapped jitted program (signature, compile
+  time, recompile **blame** diffs, declared budgets → ``compile.*``),
+  captures XLA's per-program cost model, and publishes MFU/roofline
+  gauges (``device.*``); the FLOP helpers (``PEAK_BF16_FLOPS``,
+  ``compiled_flops``, ``attention_core_flops``) live here now.
+* :mod:`~chainermn_tpu.observability.perf` — offline perf-regression
+  sentinel over the ``result/*.json`` artifact history
+  (``python -m chainermn_tpu.observability.perf``); ``bench.py`` folds
+  its compact verdict into ``bench_summary.perf_sentinel``.
 
 Env knobs (see ``docs/observability.md`` for the full table):
 
@@ -136,6 +147,17 @@ from chainermn_tpu.observability.memory import (  # noqa: E402
     device_memory_stats,
     kv_pool_sample,
 )
+from chainermn_tpu.observability.device import (  # noqa: E402
+    PEAK_BF16_FLOPS,
+    CompileWatch,
+    WatchedFunction,
+    attention_core_flops,
+    compiled_flops,
+    mfu_pct,
+    roofline,
+    signature_diff,
+    watch,
+)
 
 __all__ = [
     "enabled",
@@ -174,4 +196,13 @@ __all__ = [
     "MemoryMonitor",
     "device_memory_stats",
     "kv_pool_sample",
+    "PEAK_BF16_FLOPS",
+    "CompileWatch",
+    "WatchedFunction",
+    "attention_core_flops",
+    "compiled_flops",
+    "mfu_pct",
+    "roofline",
+    "signature_diff",
+    "watch",
 ]

@@ -516,7 +516,20 @@ class MultiNodeOptimizer:
             check_vma=not isinstance(comm, DummyCommunicator),
         )
         donate_argnums = (0,) if donate else ()
-        return jax.jit(mapped, donate_argnums=donate_argnums)
+        # The step rides the compile watcher (PR 11): every compilation
+        # is recorded with its triggering argument signature, a batch-
+        # shape-change recompile emits a structured blame diff, and
+        # MetricsReport(device=True) reads the captured cost model for
+        # the device.* MFU/roofline gauges.  No budget: several variants
+        # are legitimate (ladder of loss closures, uneven final batch);
+        # churn still shows up as compile.count + blame records.  With
+        # CMN_OBS=0 this returns the raw jit (the wrap-time latch).
+        from chainermn_tpu.observability import device as _odevice
+
+        return _odevice.watch().wrap(
+            jax.jit(mapped, donate_argnums=donate_argnums),
+            program="train_step",
+        )
 
     # --------------------------------------------------------------- update
     def update(

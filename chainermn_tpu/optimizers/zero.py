@@ -387,7 +387,15 @@ class ZeroMultiNodeOptimizer:
             out_specs=(state_spec, P()),
             check_vma=True,
         )
-        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+        # Same compile-watch wrap as the base optimizer's step (PR 11):
+        # recompiles get signature-diff blame, MetricsReport(device=True)
+        # reads the cost model for the device.* gauges.
+        from chainermn_tpu.observability import device as _odevice
+
+        return _odevice.watch().wrap(
+            jax.jit(mapped, donate_argnums=(0,) if donate else ()),
+            program="train_step",
+        )
 
 
     # --------------------------------------------------------------- update

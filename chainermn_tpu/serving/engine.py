@@ -339,13 +339,37 @@ class DecodeEngine:
                 dpools = [dup(p) for p in dpools]
             return pools, dpools
 
-        self._step = jax.jit(step_impl, donate_argnums=(0,))
-        self._prefill = jax.jit(prefill_impl, donate_argnums=(0, 1))
+        # Every engine program rides the compile watcher (PR 11): each
+        # compilation is recorded with the triggering argument signature,
+        # a recompile emits a structured blame diff instead of a bare
+        # counter bump, and the declared budgets below feed the
+        # ``compile.budget_exceeded`` gauge the recompile-guard tests
+        # pin at 0.  The watcher consults CMN_OBS at wrap time — with
+        # observability off these are the raw jits (zero overhead) and
+        # the ``*_compiles`` properties read ``_cache_size()`` exactly
+        # as before.
+        from chainermn_tpu.observability import device as _odevice
+
+        _w = _odevice.watch()
+        self._step = _w.wrap(
+            jax.jit(step_impl, donate_argnums=(0,)),
+            program="decode_step", budget=1,
+        )
+        self._prefill = _w.wrap(
+            jax.jit(prefill_impl, donate_argnums=(0, 1)),
+            program="prefill", budget=len(self.prefill_ladder),
+        )
         self._spec = (
-            jax.jit(spec_impl, donate_argnums=(0, 1))
+            _w.wrap(
+                jax.jit(spec_impl, donate_argnums=(0, 1)),
+                program="spec_round", budget=1,
+            )
             if draft_model is not None else None
         )
-        self._cow = jax.jit(cow_impl, donate_argnums=(0, 1))
+        self._cow = _w.wrap(
+            jax.jit(cow_impl, donate_argnums=(0, 1)),
+            program="cow", budget=1,
+        )
 
     # ------------------------------------------------------------- slots
     def seed_slot(self, slot: int, seed: int, temperature: float) -> None:
@@ -480,14 +504,23 @@ class DecodeEngine:
 
     # ------------------------------------------------------- introspection
     @property
+    def hot_program(self):
+        """The steady-state loop's (watched) program: the speculative
+        round when a draft is armed — the plain step is never dispatched
+        then — else the decode step.  What the scheduler's ``device.*``
+        roofline gauges attribute to."""
+        return self._spec if self._spec is not None else self._step
+
+    @property
     def decode_compiles(self) -> int:
         """Compiled-variant count of the hot-loop decode program — the
         recompile guard's subject: must stay 1 under arbitrary slot
-        churn.  For a speculative engine the hot loop is the fused
-        draft+verify round program (the plain step is never dispatched),
-        so that is what is counted."""
-        prog = self._spec if self._spec is not None else self._step
-        return int(prog._cache_size())
+        churn.  Backed by the compile watcher since PR 11 (same number
+        as the jit cache's ``_cache_size()`` — the watcher additionally
+        records WHAT signature change triggered any recompile); for a
+        speculative engine the hot loop is the fused draft+verify round
+        program, so that is what is counted."""
+        return int(self.hot_program._cache_size())
 
     @property
     def verify_compiles(self) -> int:
@@ -530,6 +563,17 @@ class DecodeEngine:
         if self.spec_k:
             out["spec_k"] = self.spec_k
             out["verify_compiles"] = self.verify_compiles
+        # Watched programs over their declared compile budget (empty on a
+        # healthy engine; absent when CMN_OBS=0 left the programs as raw
+        # jits).  The flight record's "compile" section carries the full
+        # per-program ledger + blame diffs.
+        over = [
+            getattr(p, "program", "?")
+            for p in (self._step, self._prefill, self._spec, self._cow)
+            if p is not None and getattr(p, "over_budget", False)
+        ]
+        if over:
+            out["compile_over_budget"] = over
         return out
 
     def alloc_blocks(self, n: int) -> Optional[List[int]]:

@@ -302,6 +302,23 @@ class Scheduler:
         self._mem_every = (
             self.slo.check_every if self.slo is not None else 16
         )
+        #: Device-plane roofline gauges (PR 11): on the same cadence as
+        #: the memory sample, publish achieved TFLOP/s / MFU / arithmetic
+        #: intensity for the engine's HOT program (decode step or
+        #: speculative round) from its captured cost model and the mean
+        #: CLEAN decode iteration time since the last publish.  Shares
+        #: the scheduler's publishing latch; ``CMN_OBS_DEVICE=0`` turns
+        #: just this feed off (the one-time cost capture lowers the
+        #: program once more — steady state is untouched).
+        import os as _os
+
+        self._dev_enabled = (
+            reg is not None
+            and _os.environ.get("CMN_OBS_DEVICE", "1") != "0"
+        )
+        self._dev_reg = reg
+        self._dev_ms_sum = 0.0
+        self._dev_ms_n = 0
         #: Request-lifecycle timeline: explicit wins; else ride the
         #: master switch, mirroring events into the process span ring
         #: (flight records then show recent serving activity).
@@ -782,6 +799,9 @@ class Scheduler:
             self._m_decode.observe(dur_ms)
             if self.slo is not None:
                 self.slo.observe("token", dur_ms)
+            if self._dev_enabled:
+                self._dev_ms_sum += dur_ms
+                self._dev_ms_n += 1
         if self.timeline is not None:
             self.timeline.record(
                 "decode", t=tc, dur_ms=dur_ms,
@@ -794,6 +814,12 @@ class Scheduler:
         if self.memory is not None and \
                 self._iterations % self._mem_every == 0:
             self.memory.sample(kv=self._kv_sample())
+        if self._dev_enabled and \
+                self._iterations % self._mem_every == 0:
+            # capture=False: live requests are between decode steps
+            # right here — the one-time cost capture is a synchronous
+            # backend compile and belongs at drain, never mid-traffic.
+            self._publish_device(capture=False)
         for s in live:
             if k:
                 # One speculative round: emit the accepted drafts plus
@@ -922,9 +948,39 @@ class Scheduler:
             # Closing sample: the drained pool state (prefix pins only)
             # is the baseline the leak detector measures against.
             self.memory.sample(kv=self._kv_sample())
+        if self._dev_enabled and self._iterations >= self._mem_every:
+            # Closing publish — but only for runs long enough to have
+            # meant it (the check cadence): a three-iteration unit drain
+            # must not pay the one-time cost capture's extra lowering.
+            self._publish_device()
         return list(self.completions)
 
     # ------------------------------------------------------- observability
+    def _publish_device(self, capture: bool = True) -> None:
+        """``device.*`` roofline gauges for the engine's hot program at
+        the mean clean-decode iteration time accumulated since the last
+        publish.  Best-effort: any failure must never sink a serving
+        loop.  ``capture=True`` (the drain path) may pay the ONE-TIME
+        cost capture — an extra lowering+compile, memoized process-wide
+        per signature; the on-cadence path passes False so live traffic
+        never stalls behind a backend compile (the first run of an
+        engine therefore publishes its gauges at drain, and every later
+        run publishes on the cadence too, off the memoized model)."""
+        if not self._dev_ms_n:
+            return
+        from chainermn_tpu.observability import device as _odevice
+
+        wf = self.engine.hot_program
+        if isinstance(wf, _odevice.WatchedFunction):
+            try:
+                _odevice.watch().publish_roofline(
+                    wf, self._dev_ms_sum / self._dev_ms_n,
+                    registry=self._dev_reg, capture=capture,
+                )
+            except Exception:
+                pass
+        self._dev_ms_sum = 0.0
+        self._dev_ms_n = 0
     def _kv_sample(self) -> dict:
         """KV-pool accounting sample for the memory monitor — live
         slots' written positions vs held capacity feed the

@@ -155,12 +155,16 @@ class MetricsReport(Extension):
     Both steps are collectives on the same cadence contract as the
     metrics gather.  ``memory=True`` (default) also publishes the
     ``mem.*`` device watermarks each tick, so the merged feed carries
-    HBM alongside step time.
+    HBM alongside step time.  ``device=True`` (opt-in — the one-time
+    cost capture re-lowers the step) publishes the train step's
+    ``device.*`` MFU/roofline gauges each tick from the compile
+    watcher's cost model (``docs/observability.md`` "Device roofline").
     """
 
     def __init__(self, comm=None, trigger=(10, "iteration"),
                  out_dir: str = "obs", prometheus: bool = False,
                  aggregate: bool = True, memory: bool = True,
+                 device: bool = False,
                  fleet_trace: Optional[str] = None,
                  fleet_probes: int = 8, fleet_resync: int = 64):
         super().__init__(self._fire, trigger=trigger, name="MetricsReport")
@@ -176,6 +180,14 @@ class MetricsReport(Extension):
         self._last_step: Optional[int] = None
         self._memory = bool(memory)
         self._mem_monitor = None
+        #: Device/compile plane (PR 11): each tick, publish the train
+        #: step's ``device.*`` MFU/roofline gauges from the compile
+        #: watcher's captured cost model and the mean ``train.step_ms``
+        #: since the last tick.  Opt-in: the one-time cost capture
+        #: lowers the step program once more, which on a big model is a
+        #: real compile.
+        self._device = bool(device)
+        self._dev_last = (0.0, 0)  # (sum_ms, count) of train.step_ms
         self.fleet_trace = fleet_trace
         self._fleet_probes = int(fleet_probes)
         self._fleet_resync = max(int(fleet_resync), 1)
@@ -215,6 +227,12 @@ class MetricsReport(Extension):
 
                 self._mem_monitor = _omem.MemoryMonitor()
             self._mem_monitor.sample()
+        # Device-plane roofline gauges for the train step, from the
+        # compile watcher's cost model + the step-time histogram's delta
+        # since the last tick — landed BEFORE the registry sample so
+        # this tick's feed line carries them (like the memory gauges).
+        if self._device:
+            self._publish_device_gauges()
         means = {}
         if trainer.last_metrics is not None:
             for k, v in trainer.last_metrics.items():
@@ -240,6 +258,31 @@ class MetricsReport(Extension):
             f.write(json.dumps(_oagg.sanitize_json(entry)) + "\n")
         if self._agg is not None:
             self._agg.collect(it, entry)
+
+    def _publish_device_gauges(self) -> None:
+        """Best-effort ``device.*`` publish for the newest live
+        ``train_step`` program: mean step wall ms since the last tick ×
+        the watcher's captured cost model (one extra lowering the first
+        time, memoized) → achieved TFLOP/s, MFU, arithmetic intensity,
+        roofline gap.  MFU reads None (gauge absent) off the
+        ``PEAK_BF16_FLOPS`` table — e.g. CPU CI."""
+        from chainermn_tpu.observability import device as _odevice
+
+        wf = _odevice.watch().find("train_step")
+        if wf is None:
+            return
+        h = _omet.registry().histogram("train.step_ms").to_dict()
+        d_sum = h["sum"] - self._dev_last[0]
+        d_n = h["count"] - self._dev_last[1]
+        self._dev_last = (h["sum"], h["count"])
+        if d_n <= 0:
+            return
+        try:
+            _odevice.watch().publish_roofline(
+                wf, d_sum / d_n, n_devices=len(jax.devices())
+            )
+        except Exception:
+            pass
 
     def finalize(self, trainer: "Trainer"):
         """Flush a final tick so a stop between triggers still lands the

@@ -174,81 +174,30 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
-#: bf16 peak matmul throughput per chip by jax ``device_kind`` (public
-#: specs) — the MFU denominator.  ``bench.py`` and user code share this one
-#: table so a headline MFU and a quick estimate can never disagree.
-PEAK_BF16_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
-def compiled_flops(compiled) -> Optional[float]:
-    """Per-execution FLOP count from XLA's own cost analysis of a lowered-
-    and-compiled function (``jax.jit(f).lower(...).compile()``), or ``None``
-    when the backend does not report it."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        f = float(cost.get("flops", 0.0))
-        return f if f > 0 else None
-    except Exception:
-        return None
-
-
-def attention_core_flops(batch: int, heads: int, q_len: int,
-                         head_dim: int, kv_len: Optional[int] = None,
-                         causal: bool = False, n_forward: int = 1,
-                         n_backward: int = 1) -> float:
-    """Analytic FLOPs of the attention-core matmuls (``QKᵀ`` and ``AV``)
-    for one attention call — the term XLA's ``cost_analysis`` CANNOT see
-    when the core runs as a Pallas flash kernel (custom calls are opaque
-    to the compiler's FLOP counter, so every flash MFU in this repo is a
-    lower bound without this correction).
-
-    Accounting (MAC-based, the convention the XLA counter itself uses for
-    the materialized-scores arm, cross-checked against the measured
-    flash-vs-XLA ``tflops_per_step`` gap — 1.93 TF measured vs 1.8 TF
-    analytic at the seq2seq T=512 geometry, `result/seq2seq_tpu_packed.json`):
-
-    * forward = ``4·B·H·Tq·Tkv·Dh`` (two matmuls), halved for causal
-      (only the lower-triangular area is computed by both the flash
-      kernel and XLA's masked arm);
-    * backward = 2.5× forward (five matmuls: score recompute, dV, dP,
-      dQ, dK — the flash backward recomputes scores internally);
-    * ``n_forward=2`` when the surrounding block is rematerialized
-      (``jax.checkpoint`` re-runs the forward kernel for the backward
-      pass — matching how the XLA count includes remat recompute of the
-      non-flash matmuls).
-
-    GQA/MQA leave the core count unchanged (every query head still
-    attends the full key length); ``heads`` is the QUERY head count.
-    """
-    if kv_len is None:
-        kv_len = q_len
-    area = q_len * kv_len
-    if causal:
-        area *= 0.5
-    fwd = 4.0 * batch * heads * area * head_dim
-    return n_forward * fwd + n_backward * 2.5 * fwd
+# The FLOP/MFU primitives moved to the observability device plane
+# (PR 11): the compile watcher captures cost_analysis() per compiled
+# program and the ``device.*`` gauges share the same peak table and
+# utilization formula as the benches.  These names stay importable here
+# — ``from chainermn_tpu.utils import PEAK_BF16_FLOPS`` keeps working —
+# but new code should import from ``chainermn_tpu.observability.device``.
+from chainermn_tpu.observability.device import (  # noqa: E402,F401
+    PEAK_BF16_FLOPS,
+    attention_core_flops,
+    compiled_flops,
+)
+from chainermn_tpu.observability.device import (  # noqa: E402
+    mfu_pct as _device_mfu_pct,
+)
 
 
 def _mfu_pct(flops: float, step_time_s: float, n_devices: int,
              device_kind: Optional[str]) -> Optional[float]:
     """The one utilization formula both public entry points share, so the
     convention can never drift between ``mfu_pct`` and
-    ``mfu_pct_incl_flash`` in an artifact."""
-    if device_kind is None:
-        device_kind = jax.devices()[0].device_kind
-    peak = PEAK_BF16_FLOPS.get(device_kind)
-    if peak is None or not flops or step_time_s <= 0:
-        return None
-    return 100.0 * flops / (step_time_s * peak * n_devices)
+    ``mfu_pct_incl_flash`` in an artifact — now delegating to the device
+    plane's single implementation."""
+    return _device_mfu_pct(flops, step_time_s, n_devices,
+                           device_kind=device_kind)
 
 
 def flash_mfu_fields(base_flops: Optional[float], extra_flops: float,

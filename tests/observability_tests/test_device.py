@@ -1,0 +1,305 @@
+"""Device/compile plane: the watcher, blame diffs, budgets, roofline.
+
+The load-bearing contracts (ISSUE 11):
+
+* a watched program's ``compiles`` reads IDENTICALLY to the jit cache's
+  ``_cache_size()`` (the hand-rolled counters the watcher replaced);
+* an induced shape-change recompile yields a blame record naming the
+  changed argument and axis, and flips ``compile.budget_exceeded``;
+* the ``device.*`` MFU gauge agrees with ``bench.py``'s existing MFU
+  arithmetic (``utils.mfu`` over the same compiled step) to < 0.1 %;
+* the FLOP helpers hoisted out of ``utils`` stay importable from both
+  homes and are the SAME objects (no forked accounting).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.observability import device as odev
+from chainermn_tpu.observability.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.tier1
+
+
+def _watch():
+    return odev.CompileWatch(registry=MetricsRegistry())
+
+
+# ------------------------------------------------------------ re-exports
+def test_flop_helpers_hoisted_with_back_compat_reexports():
+    import chainermn_tpu.utils as utils
+
+    assert utils.PEAK_BF16_FLOPS is odev.PEAK_BF16_FLOPS
+    assert utils.compiled_flops is odev.compiled_flops
+    assert utils.attention_core_flops is odev.attention_core_flops
+    # The package-level exports too.
+    import chainermn_tpu.observability as obs
+
+    assert obs.PEAK_BF16_FLOPS is odev.PEAK_BF16_FLOPS
+
+
+def test_utils_mfu_delegates_to_device_formula():
+    from chainermn_tpu.utils import _mfu_pct
+
+    want = odev.mfu_pct(1e12, 0.1, 2, device_kind="TPU v5e")
+    got = _mfu_pct(1e12, 0.1, 2, "TPU v5e")
+    assert want is not None and got == want
+
+
+# ---------------------------------------------------------- the watcher
+def test_watched_function_counts_match_cache_size():
+    w = _watch()
+    f = w.wrap(jax.jit(lambda x: x * 2), "p")
+    assert f.compiles == 0 == f._cache_size()
+    f(jnp.ones((4,)))
+    assert f.compiles == 1 == f._cache_size()
+    f(jnp.ones((4,)))  # cache hit
+    assert f.compiles == 1 == f._cache_size()
+    f(jnp.ones((6,)))  # new variant
+    assert f.compiles == 2 == f._cache_size()
+
+
+def test_compile_records_carry_signature_and_time():
+    w = _watch()
+    f = w.wrap(jax.jit(lambda x, n: x + n), "sig")
+    f(jnp.ones((3, 5), jnp.float32), 7)
+    recs = [r for r in w.records() if r["program"] == "sig"]
+    assert len(recs) == 1
+    sig = recs[0]["signature"]
+    arr = [v for v in sig.values() if v.get("shape") == [3, 5]]
+    assert arr and arr[0]["dtype"] == "float32"
+    # Python-int args record type only: their VALUE never retriggers a
+    # compile, so recording it would pollute every later blame diff.
+    assert {"py": "int"} in sig.values()
+    assert recs[0]["compile_s"] >= 0.0
+
+
+def test_induced_recompile_blames_changed_axis():
+    w = _watch()
+    f = w.wrap(jax.jit(lambda x: x.sum()), "blame", budget=1)
+    f(jnp.ones((4, 8)))
+    f(jnp.ones((4, 16)))  # axis 1 grows -> recompile
+    blames = w.blames()
+    assert len(blames) == 1
+    rec = blames[0]
+    assert rec["program"] == "blame" and rec["budget_exceeded"] is True
+    (change,) = rec["diff"]
+    assert change["axes"] == [1]
+    assert change["before"]["shape"] == [4, 8]
+    assert change["after"]["shape"] == [4, 16]
+    assert "dtype_changed" not in change
+
+
+def test_dtype_change_blamed_as_dtype():
+    w = _watch()
+    f = w.wrap(jax.jit(lambda x: x * 1), "dt")
+    f(jnp.ones((4,), jnp.float32))
+    f(jnp.ones((4,), jnp.int32))
+    (change,) = w.blames()[-1]["diff"]
+    assert change["dtype_changed"] is True and change["axes"] == []
+
+
+def test_budget_gauge_flips_only_past_budget():
+    reg = MetricsRegistry()
+    w = odev.CompileWatch(registry=reg)
+    f = w.wrap(jax.jit(lambda x: x + 1), "b", budget=2)
+    f(jnp.ones((2,)))
+    f(jnp.ones((3,)))  # 2 variants: at budget, not over
+    assert reg.snapshot()["compile.budget_exceeded"]["value"] == 0
+    assert w.budget_violations == 0 and not f.over_budget
+    f(jnp.ones((5,)))  # third variant: over
+    assert reg.snapshot()["compile.budget_exceeded"]["value"] == 1
+    assert f.over_budget
+    assert reg.snapshot()["compile.count"]["value"] == 3
+
+
+def test_wrap_returns_raw_jit_when_obs_disabled():
+    import chainermn_tpu.observability as obs
+
+    obs.set_enabled(False)
+    try:
+        raw = jax.jit(lambda x: x)
+        assert odev.watch().wrap(raw, "off") is raw
+    finally:
+        obs.set_enabled(None)
+
+
+def test_wrapper_forwards_lower_and_attrs():
+    w = _watch()
+    f = w.wrap(jax.jit(lambda x: x * 3), "fwd")
+    compiled = f.lower(jnp.ones((2, 2))).compile()
+    cost = odev.cost_dict(compiled)
+    assert cost and cost["flops"] > 0
+    # Arbitrary attribute access forwards to the underlying jit object.
+    assert callable(f.lower)
+
+
+def test_ring_is_bounded():
+    w = odev.CompileWatch(registry=MetricsRegistry(), ring=4)
+    f = w.wrap(jax.jit(lambda x: x - 1), "ring")
+    for n in range(2, 9):
+        f(jnp.ones((n,)))
+    assert len(w.records()) == 4
+    assert w.total_compiles == 7
+
+
+def test_flight_section_names_programs_and_blames():
+    # The flight section reads the PROCESS watch — wrap through it, with
+    # a private program name so parallel state never collides.
+    w = odev.watch()
+    f = w.wrap(jax.jit(lambda x: x / 2), "flighty")
+    f(jnp.ones((2,)))
+    sec = w.flight_section()
+    mine = [p for p in sec["programs"] if p["program"] == "flighty"]
+    assert mine == [{"program": "flighty", "compiles": 1, "budget": None,
+                     "over_budget": False}]
+    assert sec["total_compiles"] >= 1
+    # Blame entries in the flight section elide the full signature.
+    f(jnp.ones((9,)))
+    sec = w.flight_section()
+    mine = [b for b in sec["recent_blames"]
+            if b["program"] == "flighty"]
+    assert mine and "signature" not in mine[0] and mine[0]["diff"]
+
+
+def test_flight_record_carries_compile_section(tmp_path):
+    from chainermn_tpu.observability.flight import FlightRecorder
+
+    odev.watch()  # ensure the provider is installed
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    rec.record("test")
+    import json
+
+    with open(rec.path) as f:
+        entry = json.loads(f.readline())
+    sec = entry["resilience"]["compile"]
+    assert "programs" in sec and "recent_blames" in sec
+
+
+# ------------------------------------------------------------- roofline
+def test_roofline_fields():
+    cost = {"flops": 2e12, "bytes accessed": 1e10}
+    r = odev.roofline(cost, 0.5, n_devices=1, device_kind="TPU v5e")
+    assert r["tflops_per_device"] == pytest.approx(4.0)
+    assert r["arithmetic_intensity"] == pytest.approx(200.0)
+    # peak 197e12 -> mfu = 4/197*100
+    assert r["mfu_pct"] == pytest.approx(100 * 4e12 / 197e12)
+    assert r["roofline_gap_x"] == pytest.approx(100 / r["mfu_pct"])
+    # Flash correction adds to the FLOPs but not to the AI (the kernel's
+    # HBM traffic is equally invisible to the counter).
+    r2 = odev.roofline(cost, 0.5, device_kind="TPU v5e",
+                       extra_flops=2e12)
+    assert r2["tflops_per_device"] == pytest.approx(8.0)
+    assert r2["arithmetic_intensity"] == pytest.approx(200.0)
+    # Unknown device kind: throughput still reported, MFU absent.
+    r3 = odev.roofline(cost, 0.5, device_kind="???")
+    assert r3["mfu_pct"] is None and r3["tflops_per_device"] > 0
+
+
+def test_publish_roofline_sets_device_gauges():
+    reg = MetricsRegistry()
+    w = odev.CompileWatch(registry=reg)
+    f = w.wrap(jax.jit(lambda a, b: a @ b), "mm")
+    f(jnp.ones((64, 64)), jnp.ones((64, 64)))
+    r = w.publish_roofline(f, 2.0, device_kind="TPU v5e")
+    assert r is not None
+    snap = reg.snapshot()
+    assert snap["device.mm.tflops"]["value"] == pytest.approx(
+        r["tflops_per_device"]
+    )
+    assert snap["device.mm.mfu_pct"]["value"] == pytest.approx(
+        r["mfu_pct"]
+    )
+    assert snap["device.mm.ai"]["value"] == pytest.approx(
+        r["arithmetic_intensity"]
+    )
+    assert snap["device.mm.roofline_gap_x"]["value"] == pytest.approx(
+        100.0 / r["mfu_pct"]
+    )
+
+
+def test_cost_analysis_capture_false_never_compiles(monkeypatch):
+    """``capture=False`` (the serving scheduler's on-cadence path) must
+    never trigger the one-time extra lowering — a synchronous backend
+    compile between decode iterations would stall live traffic."""
+    w = _watch()
+    f = w.wrap(jax.jit(lambda x: x + 1), "nocap")
+    f(jnp.ones((4,)))
+    monkeypatch.setattr(
+        f, "lower",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("compiled"))
+    )
+    assert f.cost_analysis(capture=False) is None
+    assert w.publish_roofline(f, 1.0, capture=False) is None
+    monkeypatch.undo()
+    assert f.cost_analysis() is not None  # the drain path captures
+    assert f.cost_analysis(capture=False) is not None  # now cached
+
+
+def test_cost_analysis_memoized_across_same_signature(monkeypatch):
+    w = _watch()
+    impl = lambda x: (x * 2).sum()  # noqa: E731
+    f1 = w.wrap(jax.jit(impl), "memo")
+    f2 = w.wrap(jax.jit(impl), "memo")
+    f1(jnp.ones((8,)))
+    f2(jnp.ones((8,)))
+    c1 = f1.cost_analysis()
+    assert c1 and c1["flops"] > 0
+    # Same (program, signature): the second engine's capture is a memo
+    # hit — prove it by forbidding further lowering.
+    monkeypatch.setattr(
+        f2, "lower",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("re-lowered"))
+    )
+    assert f2.cost_analysis() == c1
+
+
+# --------------------------------------- the LM train-step MFU contract
+def test_train_step_mfu_gauge_matches_bench_arithmetic(tmp_path):
+    """The acceptance pin: ``device.train_step.mfu_pct`` published off
+    the watcher's captured cost model agrees with ``bench.py``'s
+    existing arithmetic (``utils.mfu`` over the AOT-compiled step) to
+    < 0.1 % at the same step time / device kind."""
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models import TransformerLM, lm_loss
+    from chainermn_tpu.utils import mfu as utils_mfu
+
+    comm = cmn.create_communicator("xla")
+    model = TransformerLM(vocab=64, n_layers=1, d_model=32, n_heads=2,
+                          d_ff=64, max_len=16)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 16), np.int32)
+    )["params"]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    step = opt.make_train_step(lm_loss(model), has_aux=True)
+    assert isinstance(step, odev.WatchedFunction)
+    assert step.program == "train_step"
+    toks = np.random.RandomState(0).randint(
+        0, 64, size=(8, 16)
+    ).astype(np.int32)
+    batch = comm.shard_batch((toks, toks))
+    state = opt.init(params)
+    state, _ = step(state, batch)
+    assert step.compiles == 1
+
+    # bench.py's side: utils.mfu over the compiled step (its own
+    # lower().compile(), exactly like benchmarks/lm.py).
+    step_time_s, n_dev, kind = 0.050, 1, "TPU v5e"
+    compiled = step.lower(state, batch).compile()
+    want = utils_mfu(compiled, step_time_s, n_dev, kind)
+    assert want is not None and want > 0
+
+    # Watcher's side: publish_roofline off the captured cost model.
+    reg = MetricsRegistry()
+    r = odev.watch().publish_roofline(
+        step, step_time_s * 1e3, n_devices=n_dev, device_kind=kind,
+        registry=reg,
+    )
+    got = reg.snapshot()["device.train_step.mfu_pct"]["value"]
+    assert got == pytest.approx(r["mfu_pct"])
+    assert abs(got - want) / want < 1e-3  # < 0.1 %

@@ -1,0 +1,179 @@
+"""Perf-regression sentinel: green on the committed history, loud on an
+injected regression (ISSUE 11).
+
+The committed ``result/`` tree is the acceptance fixture: the sentinel
+must read it as green (it records the repo's real, monotone-or-noisy
+bench trajectory).  The regression path is pinned on a synthetic series:
+an injected 10 % drop must flip the verdict, name the metric, and name
+the FIRST artifact of the slide — not merely the newest.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from chainermn_tpu.observability import perf
+
+pytestmark = pytest.mark.tier1
+
+RESULT_DIR = perf.default_result_dir()
+
+
+def _write(d, name, value, when, metric="widget_tokens_per_sec",
+           **extra):
+    rec = {
+        "metric": metric, "value": value, "unit": "tok/s",
+        "platform": "tpu",
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime(when)),
+        **extra,
+    }
+    with open(os.path.join(d, name), "w") as f:
+        json.dump(rec, f)
+
+
+def test_committed_history_is_green():
+    report = perf.analyze(RESULT_DIR)
+    assert report["verdict"] == "green", report["regressed"]
+    # The history is not vacuous: real multi-sample series were judged.
+    assert report["series_judged"] >= 2
+    assert report["series_total"] > report["series_judged"]
+
+
+def test_injected_regression_names_metric_and_first_bad(tmp_path):
+    t0 = 1_700_000_000
+    d = str(tmp_path)
+    for i, v in enumerate((1000.0, 1010.0, 995.0)):
+        _write(d, f"a{i}.json", v, t0 + i * 3600)
+    # The slide: two artifacts out of band — first_bad must be the
+    # EARLIER one (where the regression landed), not the newest.
+    _write(d, "bad0.json", 900.0, t0 + 10 * 3600)
+    _write(d, "bad1.json", 890.0, t0 + 11 * 3600)
+    report = perf.analyze(d)
+    assert report["verdict"] == "regressed"
+    (worst,) = report["regressed"]
+    assert worst["metric"] == "widget_tokens_per_sec"
+    assert worst["first_bad"] == "bad0.json"
+    assert worst["magnitude_pct"] == pytest.approx(11.0, abs=0.5)
+    # The compact bench_summary form carries the same verdict.
+    s = perf.sentinel(d)
+    assert s == {
+        "verdict": "regressed", "metric": "widget_tokens_per_sec",
+        "drop_pct": worst["magnitude_pct"], "first_bad": "bad0.json",
+        "regressed_series": 1,
+    }
+
+
+def test_noise_band_folds_observed_spread(tmp_path):
+    """A series whose history already swings 15 % must not page on a
+    10 % move — the band is max(floor, observed spread)."""
+    t0 = 1_700_000_000
+    d = str(tmp_path)
+    for i, v in enumerate((1000.0, 1150.0, 1000.0)):
+        _write(d, f"n{i}.json", v, t0 + i * 3600)
+    _write(d, "new.json", 950.0, t0 + 9 * 3600)
+    report = perf.analyze(d)
+    assert report["verdict"] == "green"
+
+
+def test_lower_better_direction_for_latency_metrics(tmp_path):
+    t0 = 1_700_000_000
+    d = str(tmp_path)
+    for i in range(3):
+        _write(d, f"l{i}.json", 10.0, t0 + i * 3600,
+               metric="decode_latency_ms")
+    _write(d, "lbad.json", 12.0, t0 + 9 * 3600,
+           metric="decode_latency_ms")  # latency UP = regression
+    report = perf.analyze(d)
+    assert report["verdict"] == "regressed"
+    assert report["regressed"][0]["metric"] == "decode_latency_ms"
+    # And an improvement (down) is green.
+    os.unlink(os.path.join(d, "lbad.json"))
+    _write(d, "lgood.json", 8.0, t0 + 9 * 3600,
+           metric="decode_latency_ms")
+    assert perf.analyze(d)["verdict"] == "green"
+
+
+def test_config_discriminator_splits_series(tmp_path):
+    """A batch-64 capture must never be judged against a batch-8
+    baseline — different configs form different series."""
+    t0 = 1_700_000_000
+    d = str(tmp_path)
+    _write(d, "b8.json", 6000.0, t0, batch=8)
+    _write(d, "b8b.json", 6010.0, t0 + 3600, batch=8)
+    _write(d, "b64.json", 48000.0, t0 + 7200, batch=64)
+    report = perf.analyze(d)
+    assert report["verdict"] == "green"
+    assert report["series_total"] == 2
+
+
+def test_live_payload_joins_exactly_its_series(tmp_path):
+    t0 = 1_700_000_000
+    d = str(tmp_path)
+    for i in range(3):
+        _write(d, f"s{i}.json", 2000.0, t0 + i * 3600)
+    live = {"metric": "widget_tokens_per_sec", "value": 1500.0,
+            "unit": "tok/s", "platform": "tpu", "cached": False}
+    s = perf.sentinel(d, live=live)
+    assert s["verdict"] == "regressed"
+    assert s["first_bad"] == "<live bench_summary>"
+    # A cached re-emit is NOT a fresh sample — never judged as one.
+    assert perf.sentinel(d, live={**live, "cached": True})["verdict"] \
+        == "green"
+    # A forced-CPU plumbing run (or a "tpu (cached ...)" platform
+    # string) must never be judged against the TPU history — the
+    # review-caught spurious-regression path.
+    assert perf.sentinel(d, live={**live, "platform": "cpu"})[
+        "verdict"] == "green"
+    assert perf.sentinel(d, live={**live, "platform":
+                                  "tpu (cached 2026)"})["verdict"] \
+        == "green"
+    # A different CONFIG joins its own (singleton) series, not this one.
+    assert perf.sentinel(d, live={**live, "batch": 512})["verdict"] \
+        == "green"
+
+
+def test_unstamped_artifacts_never_judged_as_newest(tmp_path):
+    """mtime is not capture time (a fresh clone resets it): an artifact
+    without ``measured_at`` contributes history but is never the judged
+    newest sample while any stamped one exists."""
+    t0 = 1_700_000_000
+    d = str(tmp_path)
+    for i in range(2):
+        _write(d, f"s{i}.json", 1000.0, t0 + i * 3600)
+    # Unstamped low value with the NEWEST mtime — would read as a
+    # regression if mtime ordered it last.
+    rec = {"metric": "widget_tokens_per_sec", "value": 700.0,
+           "unit": "tok/s", "platform": "tpu"}
+    with open(os.path.join(d, "zz_unstamped.json"), "w") as f:
+        json.dump(rec, f)
+    report = perf.analyze(d)
+    assert report["verdict"] == "green"
+    (series,) = [r for r in report["series"]
+                 if r["status"] != "insufficient"]
+    assert series["newest_file"] == "s1.json"
+
+
+def test_non_headline_artifacts_are_skipped(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "trace.json"), "w") as f:
+        json.dump({"traceEvents": []}, f)
+    with open(os.path.join(d, "cpu.json"), "w") as f:
+        json.dump({"metric": "m", "value": 1.0, "platform": "cpu"}, f)
+    with open(os.path.join(d, "broken.json"), "w") as f:
+        f.write("{not json")
+    report = perf.analyze(d)
+    assert report["verdict"] == "green" and report["series_total"] == 0
+
+
+def test_cli_json_and_table(tmp_path, capsys):
+    rc = perf.main(["--result-dir", RESULT_DIR, "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "green"
+    rc = perf.main(["--result-dir", RESULT_DIR])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "verdict: green" in out

@@ -229,3 +229,21 @@ def test_render_prometheus_on_merged_feed_line(tmp_path):
     merged = _lines(os.path.join(str(tmp_path), "metrics.merged.jsonl"))
     text = render_prometheus(merged[-1]["merged"])
     assert "cmn_train_loss" in text
+
+
+def test_device_gauges_published_each_tick(tmp_path):
+    """``MetricsReport(device=True)`` (ISSUE 11): each tick publishes
+    the train step's ``device.*`` roofline gauges from the compile
+    watcher's captured cost model + the step-time histogram delta.
+    Throughput and arithmetic intensity land everywhere; the MFU gauge
+    needs a ``PEAK_BF16_FLOPS`` device kind, so it is absent on CPU CI
+    (by design — an invented CPU peak would fake a utilization)."""
+    report, trainer = _train(tmp_path, n_iter=4, trigger=2, device=True)
+    last = _lines(report.rank_path)[-1]["registry"]
+    assert last["device.train_step.tflops"]["value"] > 0
+    assert last["device.train_step.ai"]["value"] > 0
+    # The step program itself is watched — one compile, signature known.
+    from chainermn_tpu.observability import device as odev
+
+    wf = odev.watch().find("train_step")
+    assert wf is not None and wf.compiles >= 1

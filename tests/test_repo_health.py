@@ -216,6 +216,46 @@ def test_env_knob_names_match_doc_tables():
     )
 
 
+#: Offline observability analyzers: every ``python -m
+#: chainermn_tpu.observability.<name>`` tool must keep supporting
+#: ``--json`` and exit 0 on the repo's committed sample artifacts —
+#: otherwise the offline half of the observability stack rots silently
+#: (nothing else executes these CLIs in CI).  One row per analyzer:
+#: (module, argv built from the repo checkout).
+_ANALYZERS = (
+    ("chainermn_tpu.observability.analyze",
+     [os.path.join("result", "sample_fleet_trace.json")]),
+    ("chainermn_tpu.observability.perf",
+     ["--result-dir", "result"]),
+)
+
+
+def test_observability_analyzers_run_offline_with_json():
+    import json
+    import subprocess
+    import sys
+
+    for module, args in _ANALYZERS:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", module, *args, "--json"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=240,
+        )
+        assert r.returncode == 0, (module, r.stdout, r.stderr)
+        report = json.loads(r.stdout)
+        assert isinstance(report, dict) and report, module
+        # And the human rendering exits 0 too.
+        r2 = subprocess.run(
+            [sys.executable, "-m", module, *args],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=240,
+        )
+        assert r2.returncode == 0, (module, r2.stdout, r2.stderr)
+        assert r2.stdout.strip(), module
+
+
 def test_every_package_dir_has_init():
     missing = []
     for dirpath, dirnames, filenames in _walk("chainermn_tpu"):
