@@ -391,6 +391,18 @@ def _summary_line(payload: dict, lm=None, dec=None, srv=None,
         )
     except Exception:
         pass
+    # Incident plane (ISSUE 12): bundles this process filed — 0 on a
+    # healthy bench; when nonzero, the newest bundle's path is the first
+    # thing a consumer should open (`observability.incident report`).
+    try:
+        from chainermn_tpu.observability import incident as _oincident
+
+        stats = _oincident.run_stats()
+        summary["incident_count"] = stats["count"]
+        if stats["count"] and stats.get("newest"):
+            summary["incident_newest"] = stats["newest"]
+    except Exception:
+        pass
     return _fit_summary(summary)
 
 
@@ -405,9 +417,10 @@ def _fit_summary(summary: dict) -> dict:
         return summary
     if isinstance(summary.get("error"), str):
         summary["error"] = summary["error"][:80]
-    for k in ("serving_tpu_probe", "cache_source_commit",
-              "serving_artifact", "decode_artifact", "lm_artifact",
-              "cache_age_hours", "perf_sentinel", "error"):
+    for k in ("incident_newest", "serving_tpu_probe",
+              "cache_source_commit", "serving_artifact",
+              "decode_artifact", "lm_artifact", "cache_age_hours",
+              "incident_count", "perf_sentinel", "error"):
         if not over():
             break
         summary.pop(k, None)

@@ -62,6 +62,18 @@ def _flight_dir(env_extra: dict = None) -> str:
     )
 
 
+def _incident_dir(env_extra: dict = None) -> str:
+    """Where this attempt's incident bundles land (observability/
+    incident.py): an explicit ``CMN_OBS_INCIDENT_DIR`` wins, else the
+    plane's default — ``incidents/`` under the attempt's flight dir."""
+    explicit = (env_extra or {}).get(
+        "CMN_OBS_INCIDENT_DIR", os.environ.get("CMN_OBS_INCIDENT_DIR")
+    )
+    if explicit:
+        return explicit
+    return os.path.join(_flight_dir(env_extra), "incidents")
+
+
 def launch(
     nproc: int,
     argv: list,
@@ -238,11 +250,17 @@ def supervise(
             f"({kind}) duration={time.time() - t0:.1f}s\n"
         )
         if rc != 0:
-            # Post-mortem pointer: where this attempt's ranks left their
-            # flight records (if any rank got far enough to write one).
+            # Post-mortem pointers: where this attempt's ranks left their
+            # flight records (if any rank got far enough to write one)
+            # and their incident bundles (`python -m chainermn_tpu.
+            # observability.incident report <dir>` renders the newest).
             sys.stderr.write(
                 f"[chainermn_tpu.launch] attempt {attempt}: flight records "
                 f"(if any) under {_flight_dir(env)}\n"
+            )
+            sys.stderr.write(
+                f"[chainermn_tpu.launch] attempt {attempt}: incident "
+                f"bundles (if any) under {_incident_dir(env)}\n"
             )
         if rc == 0:
             return 0

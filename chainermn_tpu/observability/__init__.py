@@ -56,6 +56,13 @@ Four cooperating pieces, all default-on and all bounded:
   sentinel over the ``result/*.json`` artifact history
   (``python -m chainermn_tpu.observability.perf``); ``bench.py`` folds
   its compact verdict into ``bench_summary.perf_sentinel``.
+* :mod:`~chainermn_tpu.observability.incident` — the incident plane:
+  declarative :class:`~chainermn_tpu.observability.incident.Watch`
+  rules over the live registry (evaluated on the stack's existing
+  cadences), hysteresis + cooldown + fingerprint dedupe + a hard
+  per-run cap, cross-plane debug bundles captured at fire time
+  (``incident.*``; ``CMN_OBS_INCIDENT_*``), and the offline postmortem
+  analyzer ``python -m chainermn_tpu.observability.incident report``.
 
 Env knobs (see ``docs/observability.md`` for the full table):
 

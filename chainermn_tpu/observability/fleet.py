@@ -523,4 +523,22 @@ def export_fleet_trace(
     merged["summary"]["path"] = write_fleet_trace(
         path, merged["payload"]
     )
+    # Incident plane: the merge just published the fleet gauges
+    # (straggler attribution included) — evaluate the watch rules NOW,
+    # while the signal is live, so a gated straggler leaves a bundle
+    # whose manifest names the suspect rank.  Ambient-registry exports
+    # only (an explicit registry's gauges live where the process rules
+    # cannot see them), under the master switch like every publisher.
+    if registry is None:
+        import chainermn_tpu.observability as _obs
+
+        if _obs.enabled():
+            from chainermn_tpu.observability import incident as _oincident
+
+            try:
+                mgr = _oincident.manager()
+                mgr.note_fleet_clock(clock)
+                mgr.evaluate()
+            except Exception:
+                pass
     return merged["summary"]

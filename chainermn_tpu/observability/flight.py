@@ -74,7 +74,15 @@ def _default_rank() -> int:
 
 
 class FlightRecorder:
-    """Appends snapshot records to one per-rank JSONL file."""
+    """Appends snapshot records to one per-rank JSONL file.
+
+    Retention: the file keeps the newest ``CMN_OBS_FLIGHT_MAX`` records
+    (default 64; ``0`` disables pruning).  Under a supervised relaunch
+    loop with an explicit ``CMN_OBS_FLIGHT_DIR``, every attempt appends
+    to the SAME per-rank file — one record per crash/SIGUSR1, forever —
+    so without the cap a long-lived flaky deployment grows its black box
+    without bound.  Oldest records prune first; the crash being debugged
+    is always the newest."""
 
     def __init__(self, directory: str, rank: Optional[int] = None):
         self.rank = _default_rank() if rank is None else int(rank)
@@ -82,6 +90,10 @@ class FlightRecorder:
         self.path = os.path.join(
             directory, f"flight.rank{self.rank}.jsonl"
         )
+        self.max_records = int(
+            _metrics._env_float("CMN_OBS_FLIGHT_MAX", 64)
+        )
+        self._line_count: Optional[int] = None
 
     # ------------------------------------------------------------- recording
     def record(self, reason: str, exc: Optional[BaseException] = None,
@@ -102,6 +114,10 @@ class FlightRecorder:
                     os.fsync(f.fileno())
                 except OSError:
                     pass
+            try:
+                self._prune()
+            except Exception:
+                pass  # retention is best-effort; the record landed
             return self.path
         except Exception:  # pragma: no cover - last-resort guard
             try:
@@ -112,6 +128,31 @@ class FlightRecorder:
             except Exception:
                 pass
             return None
+
+    def _prune(self) -> None:
+        """Oldest-first retention (``CMN_OBS_FLIGHT_MAX``).  Record
+        events are rare (crash / SIGUSR1), so the occasional full-file
+        read is off every hot path; the rewrite is atomic so a reader
+        never sees a torn file.  The cached line count only delays
+        pruning when another recorder shares the file — the rewrite
+        recounts from the file itself, so the cap self-corrects."""
+        if self.max_records <= 0:
+            return
+        if self._line_count is None:
+            with open(self.path) as f:
+                self._line_count = sum(1 for _ in f)
+        else:
+            self._line_count += 1
+        if self._line_count <= self.max_records:
+            return
+        with open(self.path) as f:
+            lines = f.readlines()
+        keep = lines[-self.max_records:]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(keep)
+        os.replace(tmp, self.path)
+        self._line_count = len(keep)
 
     def _build(self, reason: str, exc: Optional[BaseException],
                extra: Optional[dict]) -> dict:
@@ -255,6 +296,17 @@ def snapshot_on_crash(exc: BaseException) -> Optional[str]:
     """Crash-path entry point (called by the global except hook, and by
     the preemption/health exits with their ``SystemExit`` subclasses).
     Never raises."""
+    try:
+        # Incident plane: judge the dying process's final registry state
+        # against the watch rules BEFORE the crash record, so a breach
+        # that killed the run leaves a bundle next to the flight record.
+        # Only when the run already wired the plane — a crash must not
+        # construct one.
+        from chainermn_tpu.observability import incident as _oincident
+
+        _oincident.evaluate_if_built()
+    except Exception:
+        pass
     try:
         rec = recorder()
         if rec is None:

@@ -287,4 +287,10 @@ class MemoryMonitor:
         # Resample so the timeline/flight provider reflect the post-gc
         # state (a drained pool, or the leak it just measured).
         self.sample(kv=kv_pool_sample(engine, ()))
+        # The leak gauge only ever lands HERE — evaluate the incident
+        # plane's watch rules now (the critical ``kv_leak`` rule has no
+        # other moment at which the signal is live), if a run wired it.
+        from chainermn_tpu.observability import incident as _oincident
+
+        _oincident.evaluate_if_built()
         return leaked

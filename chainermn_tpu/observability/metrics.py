@@ -34,6 +34,19 @@ import threading
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+def _env_float(name: str, default: float) -> float:
+    """Tolerant env-number read shared by the observability knobs
+    (incident cooldown/cap/window, flight retention): unset OR malformed
+    values fall back to the default — a typo'd knob must degrade to the
+    shipped behavior, never crash a publisher at construction."""
+    import os
+
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return float(default)
+
+
 #: Default histogram edges, in milliseconds: spans the host-plane range
 #: (sub-ms object sends → multi-second checkpoint commits).  Upper-open
 #: overflow bucket is implicit (``+Inf`` in Prometheus rendering).
@@ -215,6 +228,14 @@ class MetricsRegistry:
     def histogram(self, name: str,
                   edges: Sequence[float] = DEFAULT_MS_EDGES) -> Histogram:
         return self._get(name, Histogram, edges=edges)
+
+    def peek(self, name: str):
+        """The instrument registered under ``name``, or ``None`` —
+        never creates.  The incident plane's watch rules read through
+        this so evaluating a rule for a plane this process never built
+        cannot materialize phantom instruments."""
+        with self._lock:
+            return self._instruments.get(name)
 
     # ------------------------------------------------------------ snapshots
     def snapshot(self) -> Dict[str, dict]:

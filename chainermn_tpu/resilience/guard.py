@@ -305,6 +305,24 @@ class TrainingHealthGuard:
         """Rank-synchronized (every rank reaches the same decision from the
         same replicated verdicts): roll back if a known-good snapshot and
         budget remain, else exit :data:`HEALTH_EXIT_CODE`."""
+        # File a critical incident BEFORE any recovery action: a
+        # rollback restores params and drains observations, so the
+        # registry/span state that EXPLAINS the escalation exists only
+        # right now — the bundle (flight record with this guard's
+        # report, metrics snapshot, trace window) preserves the
+        # pre-rollback view.  The exit-76 path's own flight record still
+        # lands below; this is the cross-plane capture.
+        if self._obs_on:
+            from chainermn_tpu.observability import incident as _oincident
+
+            try:
+                _oincident.manager().file_incident(
+                    name="health_escalation", severity="critical",
+                    plane="resilience",
+                    detail=f"iteration {trainer.iteration}: {reason}",
+                )
+            except Exception:
+                pass
         ckpt = self._find_checkpointer(trainer)
         good = (
             ckpt.latest_known_good()

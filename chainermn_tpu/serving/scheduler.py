@@ -88,7 +88,13 @@ switch; ISSUE 6):
   ``slo.check_every`` decode iterations;
 * the scheduler registers a ``"serving"`` flight-record provider: any
   crash / exit-75 preemption / SIGUSR1 snapshot captures the live slot
-  map, allocator occupancy, queue depth, and in-flight request ids.
+  map, allocator occupancy, queue depth, and in-flight request ids;
+* the incident plane (ISSUE 12): the scheduler evaluates the process
+  :class:`~chainermn_tpu.observability.incident.IncidentManager`'s
+  watch rules on the same SLO-check cadence (and once at drain) — a
+  breaching ``serve.slo.p95_drift`` captures ONE deduplicated debug
+  bundle (flight record, span-ring trace window, metrics snapshot, the
+  newest SLO report and live slot map) under ``CMN_OBS_INCIDENT_DIR``.
 
 The decode step is also a ``CMN_FAULT`` hook point (site
 ``serve_step``, counted by decode iteration): ``skew@serve_step:N:ms``
@@ -218,7 +224,7 @@ class Scheduler:
     :class:`~chainermn_tpu.serving.engine.DecodeEngine`."""
 
     def __init__(self, engine, registry=None, clock: Optional[_Clock] = None,
-                 slo=None, timeline=None, memory=None):
+                 slo=None, timeline=None, memory=None, incidents=None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability import flight as _flight
         from chainermn_tpu.observability import tracing as _tracing
@@ -302,6 +308,43 @@ class Scheduler:
         self._mem_every = (
             self.slo.check_every if self.slo is not None else 16
         )
+        #: Incident manager (ISSUE 12): explicit wins; otherwise the
+        #: process manager rides the ambient-registry publishing
+        #: decision (an explicit registry's gauges live where the
+        #: process rules cannot see them, so no default there).  Rule
+        #: evaluation runs on the SLO-check cadence + at drain — the
+        #: already-paid moments; steady state never captures.
+        if incidents is not None:
+            self.incidents = incidents
+        elif registry is None and enabled:
+            from chainermn_tpu.observability import incident as _oincident
+
+            self.incidents = _oincident.manager()
+        else:
+            self.incidents = None
+        if self.incidents is not None:
+            import weakref as _weakref
+
+            _iref = _weakref.ref(self)
+            self.incidents.register_source(
+                "serving",
+                lambda: (
+                    s._flight_state() if (s := _iref()) is not None
+                    else {"released": True}
+                ),
+            )
+            # The newest SLO report rides every bundle (same weakref
+            # discipline as the flight provider: a dropped scheduler —
+            # and through it the engine's device pools — is never
+            # pinned by the incident plane).
+            self.incidents.register_source(
+                "slo",
+                lambda: (
+                    {"report": s.slo.last_report}
+                    if (s := _iref()) is not None and s.slo is not None
+                    else {"released": True}
+                ),
+            )
         #: Device-plane roofline gauges (PR 11): on the same cadence as
         #: the memory sample, publish achieved TFLOP/s / MFU / arithmetic
         #: intensity for the engine's HOT program (decode step or
@@ -811,6 +854,12 @@ class Scheduler:
         if self.slo is not None and \
                 self._iterations % self.slo.check_every == 0:
             self.slo.check()
+        if self.incidents is not None and \
+                self._iterations % self._mem_every == 0:
+            # Watch-rule evaluation on the SLO-check cadence, AFTER the
+            # check refreshed the drift gauge: a breach captures its
+            # bundle while the registry still shows the breach.
+            self.incidents.evaluate()
         if self.memory is not None and \
                 self._iterations % self._mem_every == 0:
             self.memory.sample(kv=self._kv_sample())
@@ -948,6 +997,12 @@ class Scheduler:
             # Closing sample: the drained pool state (prefix pins only)
             # is the baseline the leak detector measures against.
             self.memory.sample(kv=self._kv_sample())
+        if self.incidents is not None:
+            # Closing evaluation AFTER the final SLO check and memory
+            # sample: a breach that developed after the last on-cadence
+            # check (short drains, the final iterations) is judged
+            # against the freshest gauges, not one-cadence-stale ones.
+            self.incidents.evaluate()
         if self._dev_enabled and self._iterations >= self._mem_every:
             # Closing publish — but only for runs long enough to have
             # meant it (the check cadence): a three-iteration unit drain

@@ -159,6 +159,14 @@ class MetricsReport(Extension):
     cost capture re-lowers the step) publishes the train step's
     ``device.*`` MFU/roofline gauges each tick from the compile
     watcher's cost model (``docs/observability.md`` "Device roofline").
+
+    Incident plane (``docs/observability.md`` "Incidents"): each tick
+    also evaluates the process
+    :class:`~chainermn_tpu.observability.incident.IncidentManager`'s
+    watch rules against the live registry — a breaching headline signal
+    (straggler named, compile budget blown, KV leak) captures ONE
+    deduplicated debug bundle at that moment, per-rank and host-side
+    only.
     """
 
     def __init__(self, comm=None, trigger=(10, "iteration"),
@@ -258,6 +266,16 @@ class MetricsReport(Extension):
             f.write(json.dumps(_oagg.sanitize_json(entry)) + "\n")
         if self._agg is not None:
             self._agg.collect(it, entry)
+        # Incident plane (ISSUE 12): evaluate the process watch rules on
+        # this already-paid cadence — per rule, one registry lookup + a
+        # predicate; a breach captures its debug bundle NOW, before the
+        # gauge resets or the window rolls over.
+        from chainermn_tpu.observability import incident as _oincident
+
+        mgr = _oincident.manager()
+        if self._fleet_clock is not None:
+            mgr.note_fleet_clock(self._fleet_clock)
+        mgr.evaluate()
 
     def _publish_device_gauges(self) -> None:
         """Best-effort ``device.*`` publish for the newest live

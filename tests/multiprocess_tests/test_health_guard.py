@@ -141,3 +141,29 @@ def test_flip_param_vote_localizes_rollback_resumes_bit_exact(
     assert "diverged" in log, log[-3000:]
     assert "rollback #1" in log, log[-3000:]
     assert "resumed at iteration 6" in log, log[-3000:]
+
+    # Incident plane (ISSUE 12 satellite): the escalation filed a
+    # severity=critical bundle BEFORE rolling back — one per rank, under
+    # the launcher-exported flight dir — and the flight record inside
+    # preserves the PRE-rollback guard state (rollbacks still 0 at
+    # capture time, even though the run went on to roll back once).
+    inc_dir = tmp_path / "flight" / "incidents"
+    bundles = sorted(p for p in inc_dir.iterdir()
+                     if p.name.startswith("incident-"))
+    assert len(bundles) == 3, [p.name for p in bundles]
+    seen_ranks = set()
+    for b in bundles:
+        manifest = json.loads((b / "manifest.json").read_text())
+        assert manifest["rule"]["name"] == "health_escalation"
+        assert manifest["severity"] == "critical"
+        assert manifest["plane"] == "resilience"
+        assert "diverged" in manifest["detail"]
+        seen_ranks.add(manifest["rank"])
+        flight_lines = (
+            b / f"flight.rank{manifest['rank']}.jsonl"
+        ).read_text().splitlines()
+        rec = json.loads(flight_lines[-1])
+        guard_rep = rec["resilience"]["guard_report"]
+        assert guard_rep["rollbacks"]["count"] == 0, guard_rep
+        assert guard_rep["last_divergence"]["divergent"] == [1]
+    assert seen_ranks == {0, 1, 2}

@@ -164,3 +164,32 @@ def test_record_survives_unserializable_extra(tmp_path):
     assert rec.record("crash", extra={"obj": Weird()}) is not None
     (entry,) = _read_records(rec.path)
     assert entry["extra"]["obj"] == "<weird>"
+
+
+def test_retention_cap_prunes_oldest_first(tmp_path, monkeypatch):
+    """CMN_OBS_FLIGHT_MAX (ISSUE 12 satellite): under a supervised
+    relaunch loop with an explicit flight dir, every attempt appends to
+    the same per-rank file forever — the recorder keeps only the newest
+    N records, oldest pruned first."""
+    monkeypatch.setenv("CMN_OBS_FLIGHT_MAX", "3")
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    assert rec.max_records == 3
+    for i in range(5):
+        rec.record("sigusr1", extra={"i": i})
+    entries = _read_records(rec.path)
+    assert [e["extra"]["i"] for e in entries] == [2, 3, 4]
+    # A FRESH recorder on the already-over-cap file (a relaunched
+    # attempt) prunes on its first record too.
+    monkeypatch.setenv("CMN_OBS_FLIGHT_MAX", "2")
+    rec2 = FlightRecorder(str(tmp_path), rank=0)
+    rec2.record("crash", extra={"i": 99})
+    entries = _read_records(rec2.path)
+    assert [e["extra"]["i"] for e in entries] == [4, 99]
+
+
+def test_retention_cap_zero_disables(tmp_path, monkeypatch):
+    monkeypatch.setenv("CMN_OBS_FLIGHT_MAX", "0")
+    rec = FlightRecorder(str(tmp_path), rank=0)
+    for i in range(6):
+        rec.record("sigusr1", extra={"i": i})
+    assert len(_read_records(rec.path)) == 6

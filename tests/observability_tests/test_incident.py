@@ -1,0 +1,432 @@
+"""Incident-plane battery (ISSUE 12): watch-rule semantics (predicate /
+hysteresis / cooldown / fingerprint dedupe / per-run cap) on a synthetic
+registry, bundle schema round-trip, the ``CMN_OBS=0`` no-op, weakref'd
+sources, forced (guard-path) captures, and the offline ``report`` CLI.
+
+Everything runs on explicit registries/managers — the process singleton
+is never touched, so the battery cannot leak incidents into other tests.
+"""
+
+import gc
+import json
+import os
+import weakref
+
+import pytest
+
+import chainermn_tpu.observability as obs
+from chainermn_tpu.observability import incident as oincident
+from chainermn_tpu.observability.incident import IncidentManager, Watch
+from chainermn_tpu.observability.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.tier1
+
+
+class _Clock:
+    """Injectable cooldown clock."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _mgr(tmp_path, reg, **kw):
+    kw.setdefault("directory", str(tmp_path / "incidents"))
+    return IncidentManager(registry=reg, **kw)
+
+
+def _bundles(tmp_path):
+    d = tmp_path / "incidents"
+    if not d.is_dir():
+        return []
+    return sorted(p for p in d.iterdir() if p.name.startswith("incident-"))
+
+
+# ----------------------------------------------------------- predicates
+def test_string_predicate_grammar():
+    from chainermn_tpu.observability.incident import compile_predicate
+
+    fn, desc = compile_predicate("> 0.5")
+    assert fn(0.6) and not fn(0.5) and desc == "> 0.5"
+    fn, _ = compile_predicate(">= 0")
+    assert fn(0.0) and fn(3) and not fn(-1)
+    fn, _ = compile_predicate("!= 0")
+    assert fn(1) and not fn(0)
+    fn, desc = compile_predicate(lambda v: v > 10)
+    assert fn(11) and not fn(10) and desc == "<lambda>"
+    with pytest.raises(ValueError):
+        compile_predicate("around 5")
+    with pytest.raises(ValueError):
+        Watch("bad name!", "x", "> 0")
+    with pytest.raises(ValueError):
+        Watch("w", "x", "> 0", severity="urgent")
+    with pytest.raises(ValueError):
+        Watch("w", "x", "> 0", hysteresis=0)
+
+
+def test_plane_derivation():
+    assert Watch("a", "serve.slo.p95_drift", "> 0").plane == "serving"
+    assert Watch("b", "fleet.straggler_rank", ">= 0").plane == "fleet"
+    assert Watch("c", "compile.budget_exceeded", "> 0").plane == "device"
+    assert Watch("d", "mem.kv.leaked_blocks", "> 0").plane == "memory"
+    assert Watch("e", "something.else", "> 0").plane == "host"
+
+
+# ------------------------------------------------- firing + bundle schema
+def test_default_rule_fires_and_bundle_round_trips(tmp_path):
+    reg = MetricsRegistry()
+    mgr = _mgr(tmp_path, reg)
+    assert mgr.evaluate() == []  # nothing published yet — nothing fires
+    reg.gauge("serve.slo.p95_drift").set(2.0)
+    reg.gauge("serve.queue_depth").set(7)
+    filed = mgr.evaluate()
+    assert len(filed) == 1 and mgr.count == 1
+    bundles = _bundles(tmp_path)
+    assert len(bundles) == 1
+    assert bundles[0].name.endswith("slo_p95_drift")
+
+    manifest = json.loads((bundles[0] / "manifest.json").read_text())
+    assert manifest["schema"] == "cmn-incident-1"
+    assert manifest["rule"]["name"] == "slo_p95_drift"
+    assert manifest["rule"]["metric"] == "serve.slo.p95_drift"
+    assert manifest["rule"]["predicate"] == "> 0.5"
+    assert manifest["severity"] == "warning"
+    assert manifest["plane"] == "serving"
+    assert manifest["value"] == 2.0
+    assert manifest["suspect_rank"] is None
+    assert manifest["first_mover"] == "serving"
+    # Correlated signals carry the cross-plane headline values present.
+    assert manifest["signals"]["serve.slo.p95_drift"] == 2.0
+    assert manifest["signals"]["serve.queue_depth"] == 7
+    # Timeline: the firing rule is an ordered entry.
+    sigs = [e["signal"] for e in manifest["timeline"]]
+    assert "rule:slo_p95_drift" in sigs
+    ts = [e["t_mono"] for e in manifest["timeline"]]
+    assert ts == sorted(ts)
+    # Every artifact the manifest points at exists and parses.
+    for key, name in manifest["artifacts"].items():
+        p = bundles[0] / name
+        assert p.is_file(), (key, name)
+        if name.endswith(".json"):
+            json.loads(p.read_text())
+    # The flight record inside the bundle is a real cmn-flight-1 record
+    # with the incident id stamped.
+    fl = json.loads(
+        (bundles[0] / manifest["artifacts"]["flight"]).read_text()
+        .splitlines()[-1]
+    )
+    assert fl["schema"] == "cmn-flight-1"
+    assert fl["reason"] == "incident"
+    assert fl["extra"]["incident"] == manifest["id"]
+    # The trace window is Perfetto-shaped.
+    tr = json.loads((bundles[0] / "trace.json").read_text())
+    assert isinstance(tr["traceEvents"], list)
+    # The metrics snapshot carries the breaching gauge.
+    snap = json.loads((bundles[0] / "metrics.json").read_text())
+    assert snap["serve.slo.p95_drift"]["value"] == 2.0
+    # Incident metrics on the manager's registry.
+    s = reg.snapshot()
+    assert s["incident.count"]["value"] == 1
+    assert s["incident.open"]["value"] == 1
+
+
+def test_latch_dedupe_and_cooldown(tmp_path):
+    clock = _Clock()
+    reg = MetricsRegistry()
+    mgr = _mgr(tmp_path, reg, time_fn=clock, cooldown_s=60.0)
+    g = reg.gauge("serve.slo.p95_drift")
+    g.set(2.0)
+    assert len(mgr.evaluate()) == 1
+    # Still breaching: latched — repeated evaluations never re-file.
+    for _ in range(5):
+        assert mgr.evaluate() == []
+    assert mgr.count == 1 and mgr.dropped == 0
+    # Clears, re-breaches inside the cooldown: suppressed + counted.
+    g.set(0.0)
+    mgr.evaluate()
+    assert reg.snapshot()["incident.open"]["value"] == 0
+    g.set(3.0)
+    clock.t += 10.0
+    assert mgr.evaluate() == []
+    assert mgr.dropped == 1
+    # Beyond the cooldown the FINGERPRINT still dedupes: one bundle per
+    # distinct incident per run.
+    g.set(0.0)
+    mgr.evaluate()
+    g.set(4.0)
+    clock.t += 120.0
+    assert mgr.evaluate() == []
+    assert mgr.count == 1 and mgr.dropped == 2
+    assert len(_bundles(tmp_path)) == 1
+    assert reg.snapshot()["incident.dropped"]["value"] == 2
+
+
+def test_hysteresis_requires_consecutive_breaches(tmp_path):
+    reg = MetricsRegistry()
+    rule = Watch("flap", "serve.queue_depth", "> 10", hysteresis=3)
+    mgr = _mgr(tmp_path, reg, rules=[rule], cooldown_s=0.0)
+    g = reg.gauge("serve.queue_depth")
+    g.set(99)
+    assert mgr.evaluate() == [] and mgr.evaluate() == []
+    # A clean evaluation resets the streak — one noisy sample between
+    # breaches keeps the rule armed but unfired.
+    g.set(0)
+    mgr.evaluate()
+    g.set(99)
+    assert mgr.evaluate() == [] and mgr.evaluate() == []
+    filed = mgr.evaluate()  # third consecutive breach
+    assert len(filed) == 1 and mgr.count == 1
+
+
+def test_key_by_value_fingerprints_and_run_cap(tmp_path):
+    clock = _Clock()
+    reg = MetricsRegistry()
+    rule = Watch("strag", "fleet.straggler_rank", ">= 0",
+                 key_by_value=True)
+    mgr = _mgr(tmp_path, reg, rules=[rule], cooldown_s=0.0,
+               max_incidents=2, time_fn=clock)
+    g = reg.gauge("fleet.straggler_rank")
+    for rank, expect_total in ((0, 1), (1, 2), (2, 2)):
+        g.set(rank)
+        mgr.evaluate()
+        g.set(-1)
+        mgr.evaluate()  # clear so the rule re-arms
+        assert mgr.count == expect_total, rank
+    # Rank 2's incident hit the hard per-run cap: dropped, not filed.
+    assert mgr.dropped == 1
+    assert len(_bundles(tmp_path)) == 2
+
+
+def test_suspect_rank_and_fleet_first_mover(tmp_path):
+    reg = MetricsRegistry()
+    mgr = _mgr(tmp_path, reg)
+    reg.gauge("fleet.straggler_rank").set(1)
+    reg.gauge("fleet.straggler_stall_ms").set(154.0)
+    filed = mgr.evaluate()
+    assert len(filed) == 1
+    m = filed[0]
+    assert m["rule"]["name"] == "fleet_straggler"
+    assert m["suspect_rank"] == 1
+    assert m["first_mover"] == "fleet"
+    fleet_entries = [e for e in m["timeline"] if e["plane"] == "fleet"]
+    assert any(e.get("value") == 1 for e in fleet_entries)
+    assert m["signals"]["fleet.straggler_stall_ms"] == 154.0
+
+
+def test_cmn_obs_off_is_a_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("CMN_OBS_INCIDENT_DIR", raising=False)
+    obs.set_enabled(False)
+    try:
+        mgr = IncidentManager(directory=str(tmp_path / "incidents"))
+        # The ambient global registry may hold anything; the latched-off
+        # manager must neither evaluate nor capture.
+        assert mgr.evaluate() == []
+        assert mgr.file_incident("forced", severity="critical") is None
+        assert mgr.count == 0
+        assert _bundles(tmp_path) == []
+    finally:
+        obs.set_enabled(None)
+
+
+def test_dormant_without_directory(tmp_path, monkeypatch):
+    monkeypatch.delenv("CMN_OBS_INCIDENT_DIR", raising=False)
+    monkeypatch.delenv("CMN_OBS_FLIGHT_DIR", raising=False)
+    reg = MetricsRegistry()
+    mgr = IncidentManager(registry=reg)
+    assert mgr.directory is None
+    reg.gauge("serve.slo.p95_drift").set(9.0)
+    filed = mgr.evaluate()
+    # Counted and judged — like the dormant flight recorder, nothing on
+    # disk and no path to point at.
+    assert len(filed) == 1 and mgr.count == 1
+    assert filed[0]["bundle"] is None
+    assert mgr.newest_path is None
+
+
+def test_directory_defaults_under_flight_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("CMN_OBS_INCIDENT_DIR", raising=False)
+    monkeypatch.setenv("CMN_OBS_FLIGHT_DIR", str(tmp_path / "fl"))
+    mgr = IncidentManager(registry=MetricsRegistry())
+    assert mgr.directory == str(tmp_path / "fl" / "incidents")
+    monkeypatch.setenv("CMN_OBS_INCIDENT_DIR", str(tmp_path / "explicit"))
+    mgr2 = IncidentManager(registry=MetricsRegistry())
+    assert mgr2.directory == str(tmp_path / "explicit")
+
+
+def test_forced_file_and_weakref_source_release(tmp_path):
+    reg = MetricsRegistry()
+    mgr = _mgr(tmp_path, reg, cooldown_s=0.0)
+
+    class _Sched:
+        def state(self):
+            return {"slots": 3}
+
+    s = _Sched()
+    ref = weakref.ref(s)
+    mgr.register_source(
+        "serving",
+        lambda: (o.state() if (o := ref()) is not None
+                 else {"released": True}),
+    )
+    m1 = mgr.file_incident("health_escalation", severity="critical",
+                           plane="resilience", detail="skip budget")
+    assert m1 is not None and m1["severity"] == "critical"
+    assert m1["rule"]["name"] == "health_escalation"
+    assert m1["detail"] == "skip budget"
+    sig1 = json.loads(
+        (_bundles(tmp_path)[0] / "signals.json").read_text()
+    )
+    assert sig1["serving"] == {"slots": 3}
+    # Built-in sources ride every bundle.
+    assert "memory" in sig1 and "compile" in sig1
+    assert "device" in sig1["memory"]
+    # Drop the scheduler: the source must release, never pin.
+    del s
+    gc.collect()
+    m2 = mgr.file_incident("health_escalation", severity="critical")
+    sig2 = json.loads(
+        (_bundles(tmp_path)[1] / "signals.json").read_text()
+    )
+    assert sig2["serving"] == {"released": True}
+    assert mgr.count == 2
+    assert mgr.newest_path == m2["bundle"]
+
+
+def test_forced_file_respects_run_cap(tmp_path):
+    mgr = _mgr(tmp_path, MetricsRegistry(), max_incidents=1)
+    assert mgr.file_incident("a") is not None
+    assert mgr.file_incident("b") is None
+    assert mgr.count == 1 and mgr.dropped == 1
+
+
+def test_absent_and_unset_instruments_never_fire(tmp_path):
+    reg = MetricsRegistry()
+    mgr = _mgr(tmp_path, reg)
+    reg.gauge("serve.slo.p95_drift")  # registered but never set
+    assert mgr.evaluate() == []
+    assert mgr.count == 0
+
+
+def test_histogram_rules_read_count(tmp_path):
+    reg = MetricsRegistry()
+    rule = Watch("any_steps", "train.step_ms", "> 2")
+    mgr = _mgr(tmp_path, reg, rules=[rule])
+    h = reg.histogram("train.step_ms")
+    h.observe(1.0)
+    h.observe(1.0)
+    assert mgr.evaluate() == []
+    h.observe(1.0)
+    assert len(mgr.evaluate()) == 1
+
+
+# --------------------------------------------------------- offline report
+def test_report_cli_json_and_human(tmp_path, capsys):
+    reg = MetricsRegistry()
+    mgr = _mgr(tmp_path, reg)
+    reg.gauge("serve.slo.p95_drift").set(1.5)
+    bundle = mgr.evaluate()[0]["bundle"]
+
+    assert oincident.main(["report", bundle, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["manifest"]["rule"]["name"] == "slo_p95_drift"
+    assert all(a["present"] for a in rep["artifacts"].values())
+
+    assert oincident.main(["report", bundle]) == 0
+    out = capsys.readouterr().out
+    assert "slo_p95_drift" in out and "first mover" in out
+    assert "timeline" in out and "artifacts" in out
+
+    # An incidents ROOT resolves to the newest bundle (the launcher's
+    # printed pointer pastes straight into `report`).
+    assert oincident.main(
+        ["report", str(tmp_path / "incidents"), "--json"]
+    ) == 0
+    rep2 = json.loads(capsys.readouterr().out)
+    assert rep2["bundle"] == bundle
+
+    with pytest.raises(FileNotFoundError):
+        oincident.resolve_bundle(str(tmp_path / "nowhere"))
+
+
+# ------------------------------------------- code-review regression pins
+def test_relaunch_with_shared_dir_never_clobbers_bundles(tmp_path):
+    """Two processes/attempts sharing one incidents dir restart their
+    per-run seq at 1 — the second capture of the same id must uniquify,
+    never overwrite the evidence being debugged."""
+    d = str(tmp_path / "incidents")
+    m1 = IncidentManager(registry=MetricsRegistry(), directory=d)
+    b1 = m1.file_incident("crash_probe")["bundle"]
+    m2 = IncidentManager(registry=MetricsRegistry(), directory=d)  # "attempt 2"
+    b2 = m2.file_incident("crash_probe")["bundle"]
+    assert b1 != b2
+    man1 = json.loads(open(b1 + "/manifest.json").read())
+    man2 = json.loads(open(b2 + "/manifest.json").read())
+    assert man1["id"] != man2["id"]
+    assert man1["rule"]["name"] == man2["rule"]["name"] == "crash_probe"
+
+
+def test_key_by_value_rearms_when_identity_moves_without_clearing(
+        tmp_path):
+    """fleet_straggler latched on rank 2 must still file rank 0's
+    incident when the gauge moves directly 2 → 0 (no −1 in between):
+    a different rank stalling is a different incident."""
+    clock = _Clock()
+    reg = MetricsRegistry()
+    mgr = _mgr(tmp_path, reg, cooldown_s=0.0, time_fn=clock)
+    g = reg.gauge("fleet.straggler_rank")
+    g.set(2)
+    assert len(mgr.evaluate()) == 1
+    g.set(0)  # identity moves mid-breach
+    filed = mgr.evaluate()
+    assert len(filed) == 1 and filed[0]["suspect_rank"] == 0
+    assert mgr.count == 2
+    # Same identity persisting stays latched as before.
+    assert mgr.evaluate() == []
+
+
+def test_check_drained_leak_evaluates_incident_plane(tmp_path,
+                                                     monkeypatch):
+    """The kv_leak rule's ONLY live moment is check_drained — the leak
+    detector must evaluate the process manager right after gauging."""
+    from chainermn_tpu.observability.memory import MemoryMonitor
+
+    reg = MetricsRegistry()
+    mgr = _mgr(tmp_path, reg)
+    monkeypatch.setattr(oincident, "_manager", mgr)
+
+    class _Alloc:
+        used_blocks, free_blocks = 2, 5
+
+    class _Pool:
+        num_blocks, block_len, bytes_per_block = 8, 8, 1024
+        allocator = _Alloc()
+
+    class _Engine:
+        pool = _Pool()
+        prefix = None
+
+        def drop_prefix_cache(self):
+            pass
+
+    mon = MemoryMonitor(registry=reg)
+    leaked = mon.check_drained(_Engine())
+    assert leaked == 2
+    assert mgr.count == 1
+    manifest = mgr.incidents[0]
+    assert manifest["rule"]["name"] == "kv_leak"
+    assert manifest["severity"] == "critical"
+    assert manifest["signals"]["mem.kv.leaked_blocks"] == 2
+
+
+def test_resolve_bundle_newest_by_mtime_not_name(tmp_path):
+    """Bundle names sort rank-major (incident-r2-... > incident-r0-...);
+    'newest wins' must follow capture time, not the name."""
+    d = tmp_path / "incidents"
+    mgr = _mgr(tmp_path, MetricsRegistry(), cooldown_s=0.0)
+    old = mgr.file_incident("zz_lexicographically_last")["bundle"]
+    new = mgr.file_incident("aa_lexicographically_first")["bundle"]
+    past = os.path.getmtime(new + "/manifest.json") - 60
+    os.utime(old + "/manifest.json", (past, past))
+    assert oincident.resolve_bundle(str(d)) == new

@@ -275,6 +275,81 @@ def test_skew_fault_fires_drift_detector(obs_run, prompts, monkeypatch):
     assert eng.decode_compiles == 1
 
 
+def test_skew_fault_files_one_deduped_incident(obs_run, prompts,
+                                               monkeypatch, tmp_path):
+    """Incident plane (ISSUE 12): the same skew@serve_step fault that
+    fires the drift detector must file exactly ONE debug bundle — the
+    breach persists across every later evaluation, and latching +
+    fingerprint dedupe keep a sustained breach from filling the disk —
+    and its manifest names the firing rule and the correlated
+    serve.slo.* signals."""
+    import gc
+
+    from chainermn_tpu.observability.incident import IncidentManager
+    from chainermn_tpu.resilience import faults as faults_mod
+
+    inj = faults_mod.FaultInjector(
+        faults_mod.parse_fault_spec("skew@serve_step:17:25ms")
+    )
+    monkeypatch.setitem(faults_mod._process_injector, "built", True)
+    monkeypatch.setitem(faults_mod._process_injector, "inj", inj)
+    eng = obs_run[0]
+    reg = MetricsRegistry()
+    inc_dir = tmp_path / "incidents"
+    mgr = IncidentManager(registry=reg, directory=str(inc_dir))
+    slo = SLOMonitor(registry=reg, window=32, min_samples=8,
+                     tolerance=0.5, check_every=4)
+    sched = Scheduler(eng, registry=reg, slo=slo, incidents=mgr)
+    sched.run([Request(id=0, prompt=prompts[0], max_new_tokens=32)])
+    bundles = sorted(p for p in inc_dir.iterdir()
+                     if p.name.startswith("incident-"))
+    assert len(bundles) == 1, [p.name for p in bundles]
+    assert mgr.count == 1
+    manifest = json.loads((bundles[0] / "manifest.json").read_text())
+    assert manifest["rule"]["name"] == "slo_p95_drift"
+    assert manifest["rule"]["metric"] == "serve.slo.p95_drift"
+    assert manifest["severity"] == "warning"
+    assert manifest["first_mover"] == "serving"
+    assert manifest["signals"]["serve.slo.p95_drift"] > 0.5
+    assert any(k.startswith("serve.slo.") for k in manifest["signals"])
+    # The bundle's signal sections carry the scheduler's live state and
+    # the newest SLO report (the weakref'd sources the scheduler wired).
+    signals = json.loads((bundles[0] / "signals.json").read_text())
+    assert signals["serving"]["iterations"] >= 17
+    assert signals["slo"]["report"]["token"]["breached"] is True
+    assert reg.snapshot()["incident.count"]["value"] == 1
+    # Host-side watching + capture never recompiled the step.
+    assert eng.decode_compiles == 1
+    # Weakref discipline: dropping the scheduler releases its sections.
+    del sched
+    gc.collect()
+    forced = mgr.file_incident("probe", severity="info")
+    with open(forced["bundle"] + "/signals.json") as f:
+        sig2 = json.load(f)
+    assert sig2["serving"] == {"released": True}
+    assert sig2["slo"] == {"released": True}
+
+
+def test_unfaulted_twin_files_zero_incidents(obs_run, prompts, tmp_path):
+    """The quiet control for the incident plane: the identical workload
+    without the fault breaches nothing and files nothing."""
+    from chainermn_tpu.observability.incident import IncidentManager
+
+    eng = obs_run[0]
+    reg = MetricsRegistry()
+    inc_dir = tmp_path / "incidents"
+    mgr = IncidentManager(registry=reg, directory=str(inc_dir))
+    slo = SLOMonitor(registry=reg, window=32, min_samples=8,
+                     tolerance=0.5, check_every=4)
+    sched = Scheduler(eng, registry=reg, slo=slo, incidents=mgr)
+    sched.run([Request(id=1, prompt=prompts[0], max_new_tokens=32)])
+    assert mgr.count == 0 and mgr.dropped == 0
+    assert not inc_dir.is_dir() or not any(inc_dir.iterdir())
+    snap = reg.snapshot()
+    assert snap["serve.slo.token.breaches"]["value"] == 0
+    assert snap["incident.open"]["value"] == 0
+
+
 def test_observability_off_disables_lifecycle_layer(obs_run):
     import chainermn_tpu.observability as obs
 
@@ -283,7 +358,7 @@ def test_observability_off_disables_lifecycle_layer(obs_run):
     try:
         sched = Scheduler(eng)
         assert sched.timeline is None and sched.slo is None
-        assert sched.memory is None
+        assert sched.memory is None and sched.incidents is None
         assert sched.export_trace("/tmp/unused_trace.json") is None
     finally:
         obs.set_enabled(None)

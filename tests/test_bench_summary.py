@@ -63,6 +63,26 @@ sent = ok.get("perf_sentinel")
 assert sent and sent["verdict"] in ("green", "regressed"), sent
 if sent["verdict"] == "regressed":
     assert "metric" in sent and "first_bad" in sent
+
+# Incident plane (ISSUE 12): a healthy bench carries incident_count 0
+# and NO pointer (the pointer appears only when nonzero).
+assert ok["incident_count"] == 0, ok
+assert "incident_newest" not in ok
+
+# Drop-order pin: an oversized incident pointer is shed BEFORE the
+# verdict scalars (metric/value/perf_sentinel) are ever touched.
+fat = {
+    "bench_summary": True, "metric": "m", "value": 1.0,
+    "perf_sentinel": {"verdict": "green", "series": 3},
+    "incident_count": 2,
+    "incident_newest": "flightrecords/attempt0/incidents/" + "x" * 1500,
+}
+fit = bench._fit_summary(dict(fat))
+assert len(json.dumps(fit)) <= bench.SUMMARY_MAX_BYTES
+assert "incident_newest" not in fit
+assert fit["metric"] == "m" and fit["value"] == 1.0
+assert fit["perf_sentinel"] == {"verdict": "green", "series": 3}
+assert fit["incident_count"] == 2
 print("SUMMARY-OK", len(line), len(line2))
 """
 

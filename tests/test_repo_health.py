@@ -227,6 +227,8 @@ _ANALYZERS = (
      [os.path.join("result", "sample_fleet_trace.json")]),
     ("chainermn_tpu.observability.perf",
      ["--result-dir", "result"]),
+    ("chainermn_tpu.observability.incident",
+     ["report", os.path.join("result", "sample_incident_bundle")]),
 )
 
 
