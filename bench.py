@@ -237,6 +237,14 @@ def _serving_headline() -> dict | None:
             "spec_speedup_vs_plain": rec.get(
                 "speculative", {}
             ).get("speedup_vs_plain"),
+            # Multi-replica router arm (ISSUE 13), when the artifact
+            # carries it: N engines x M chips behind least-loaded
+            # dispatch — aggregate tokens/s and the replica/mesh shape.
+            "router_tokens_per_sec": rec.get(
+                "router", {}
+            ).get("aggregate_tokens_per_sec"),
+            "router_replicas": rec.get("router", {}).get("replicas"),
+            "router_mesh_model": rec.get("router", {}).get("mesh_model"),
         }
 
     return _best_result("serving*.json", cands)
@@ -354,6 +362,12 @@ def _summary_line(payload: dict, lm=None, dec=None, srv=None,
             obs.get("overhead_pct") if obs is not None else None
         ),
     }
+    # Router-arm pointer (ISSUE 13): present only when the serving
+    # artifact carries the multi-replica capture, so the tail line shows
+    # the pod-scale arm exists without paying bytes on single-engine
+    # artifacts.
+    if srv is not None and srv.get("router_tokens_per_sec") is not None:
+        summary["router_tokens_per_sec"] = srv["router_tokens_per_sec"]
     # Artifact POINTERS, not payloads: the full headline dicts ride the
     # composite line above; the tail line names where each number came
     # from so a consumer can open the file.
@@ -418,9 +432,10 @@ def _fit_summary(summary: dict) -> dict:
     if isinstance(summary.get("error"), str):
         summary["error"] = summary["error"][:80]
     for k in ("incident_newest", "serving_tpu_probe",
-              "cache_source_commit", "serving_artifact",
-              "decode_artifact", "lm_artifact", "cache_age_hours",
-              "incident_count", "perf_sentinel", "error"):
+              "router_tokens_per_sec", "cache_source_commit",
+              "serving_artifact", "decode_artifact", "lm_artifact",
+              "cache_age_hours", "incident_count", "perf_sentinel",
+              "error"):
         if not over():
             break
         summary.pop(k, None)

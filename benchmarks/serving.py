@@ -138,6 +138,19 @@ def main():
     ap.add_argument("--draft-layers", type=int, default=0,
                     help="distilled draft depth (default layers // 4, "
                          "min 1)")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="also run the multi-replica ROUTER arm: N "
+                         "engines behind least-loaded dispatch under "
+                         "the same Poisson traffic; reports aggregate "
+                         "tokens/s, per-replica occupancy spread and "
+                         "router dispatch latency (0 = skip)")
+    ap.add_argument("--mesh-model", type=int, default=1, metavar="M",
+                    help="shard EACH router-arm engine tensor-parallel "
+                         "over M devices (a GSPMD mesh per replica — "
+                         "N x M devices total, disjoint groups; 1 = "
+                         "unsharded replicas).  Requires the einsum "
+                         "decode path (forced for the router arm when "
+                         "M > 1)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--trace-out", default=None,
@@ -182,7 +195,7 @@ def main():
             new_min=4, new_max=64, layers=4, d_model=512, heads=8,
             d_ff=1024, vocab=4096, block_len=8, prefill_chunk=16,
             repeats=4, obs_pairs=12, prefix_reuse=4, spec_k=3,
-            draft_layers=1,
+            draft_layers=1, replicas=2,
         )
         for k, v in smoke_over.items():
             if getattr(args, k) == ap.get_default(k):
@@ -705,6 +718,109 @@ def main():
         }
         del sp_eng
 
+    # --------------------------------------------------- router arm
+    # N engines x M chips behind least-loaded dispatch (ISSUE 13): the
+    # same Poisson request stream through a Router over N fresh engines
+    # (each optionally sharded tensor-parallel over its own M-device
+    # mesh group).  The single-engine continuous arm above is the
+    # baseline: aggregate tokens/s should scale with N once the single
+    # engine saturates, and the occupancy spread shows the dispatch
+    # policy keeping the replicas even.  Router cost itself is
+    # host-side only — dispatch latency is reported so its budget is
+    # visible.
+    router_payload = None
+    if args.replicas:
+        from chainermn_tpu.observability.metrics import MetricsRegistry
+        from chainermn_tpu.serving import Router
+        from chainermn_tpu.serving.sharding import serving_mesh
+
+        N, M = args.replicas, max(1, args.mesh_model)
+        devs = jax.devices()
+        rt_model = model
+        if M > 1:
+            if args.decode_attention != "einsum":
+                # The Pallas paged kernel carries no GSPMD rule — the
+                # sharded router arm runs the gathered einsum path.
+                rt_model = model.clone(decode_attention="einsum")
+            if len(devs) < N * M:
+                print(f"# router arm: {N}x{M} devices requested, "
+                      f"{len(devs)} available — shrinking mesh to 1",
+                      flush=True)
+                M = 1
+                rt_model = model
+        meshes = [
+            serving_mesh(M, devices=devs[i * M:(i + 1) * M])
+            if M > 1 else None
+            for i in range(N)
+        ]
+        rt_engines = []
+        for i in range(N):
+            e = DecodeEngine(
+                rt_model, params, capacity=args.batch,
+                num_blocks=num_blocks, block_len=args.block_len,
+                prefill_chunk=args.prefill_chunk,
+                max_blocks_per_slot=blocks_for(
+                    padded_longest, args.block_len
+                ),
+                mesh=meshes[i],
+                # Unsharded replicas still get their own chip when the
+                # host has one to give — N engines piled on the default
+                # device would measure single-chip contention, not
+                # replica scaling.
+                device=(
+                    devs[i] if meshes[i] is None and len(devs) >= N
+                    else None
+                ),
+            )
+            warm_engine(e)
+            rt_engines.append(e)
+        rt_best, rt_router, rt_reg = float("inf"), None, None
+        for _ in range(repeats):
+            for e in rt_engines:
+                e.drop_prefix_cache()
+            # Fresh registry per pass: the dispatched/migrated/
+            # backpressure counters below must describe the BEST run,
+            # not accumulate across every repeat.
+            reg = MetricsRegistry()
+            router = Router(rt_engines, registry=reg)
+            rcs = router.run([
+                Request(id=40_000 + i, prompt=prompts[i].tolist(),
+                        max_new_tokens=int(new_counts[i]),
+                        arrival=float(arrivals[i]))
+                for i in range(args.requests)
+            ])
+            span = (
+                max(c.finished_at for c in rcs)
+                - min(c.arrival for c in rcs)
+            )
+            if span < rt_best:
+                rt_best, rt_router, rt_reg = span, router, reg
+        rstats = rt_router.replica_stats()
+        occs = [s["occupancy_mean"] for s in rstats]
+        dms = sorted(rt_router.dispatch_ms)
+        router_payload = {
+            "replicas": N,
+            "mesh_model": M,
+            "decode_attention": rt_model.decode_attention,
+            "aggregate_tokens_per_sec": round(useful_tokens / rt_best, 1),
+            "makespan_s": round(rt_best, 3),
+            "speedup_vs_single_engine": round(cont_makespan / rt_best, 3),
+            "per_replica_occupancy_mean": [round(o, 4) for o in occs],
+            "occupancy_spread": round(max(occs) - min(occs), 4),
+            "dispatch_ms_p50": round(_pct(dms, 0.5), 4) if dms else None,
+            "dispatch_ms_p95": round(_pct(dms, 0.95), 4) if dms else None,
+            "dispatched": rt_reg.peek("serve.router.dispatched").value,
+            "migrated": rt_reg.peek("serve.router.migrated").value,
+            "backpressure_deferrals": rt_reg.peek(
+                "serve.router.backpressure"
+            ).value,
+            "per_replica_served": [s["served"] for s in rstats],
+            "decode_compiles": [
+                s["engine"]["decode_compiles"] for s in rstats
+            ],
+        }
+        del rt_engines, rt_router
+
     payload = {
         "metric": "serving_tokens_per_sec",
         "value": round(cont_tps, 1),
@@ -789,6 +905,8 @@ def main():
         payload["prefix_reuse"] = prefix_payload
     if spec_payload is not None:
         payload["speculative"] = spec_payload
+    if router_payload is not None:
+        payload["router"] = router_payload
     print(json.dumps(payload))
     if args.out:
         from chainermn_tpu.utils import atomic_json_dump

@@ -237,6 +237,14 @@ def default_rules() -> List[Watch]:
             description="blocks still held after a drain + prefix-cache "
                         "gc — refcount drift",
         ),
+        Watch(
+            "router_backlog", "serve.router.queue_depth", "> 0",
+            severity="warning", hysteresis=3,
+            description="requests held back in the serving router's own "
+                        "queue across consecutive evaluations — every "
+                        "replica at its admission cap (fleet-wide "
+                        "backpressure; the scale-out signal)",
+        ),
     ]
 
 

@@ -7,7 +7,7 @@ while the scheduler streams requests through them — admission the moment a
 slot and pool blocks free up, retirement the moment EOS lands (Orca-style
 iteration-level scheduling over a vLLM-style paged KV pool).
 
-Four layers:
+Six layers:
 
 * :mod:`~chainermn_tpu.serving.kv_pool` — the fixed device-resident block
   pool + host-side REFCOUNTED free-list allocator (zero device syncs;
@@ -31,6 +31,16 @@ Four layers:
   :mod:`chainermn_tpu.observability.slo`), and a ``"serving"``
   flight-record provider (live slot map + allocator occupancy in every
   crash/preemption/SIGUSR1 snapshot).
+* :mod:`~chainermn_tpu.serving.sharding` — the pod-scale GSPMD plan: one
+  engine tensor-parallel over a 1-D ``Mesh(("model",))`` — params on the
+  Megatron cut, the paged KV pools (target and draft) sharded
+  kv-head-major on the layout's purpose-built ``(KH, ...)`` axis, all
+  host-side bookkeeping untouched (``DecodeEngine(mesh=...)``).
+* :mod:`~chainermn_tpu.serving.router` — N engines × M chips behind
+  least-loaded dispatch off each replica's live gauges, per-replica
+  admission backpressure (zero requests lost), queued-work rebalance,
+  ``serve.router.*`` metrics, and a merged fleet trace that shows one
+  request's life across replicas.
 
 See ``docs/serving.md`` and ``benchmarks/serving.py``.
 """
@@ -43,11 +53,13 @@ from chainermn_tpu.serving.kv_pool import (
     blocks_for,
 )
 from chainermn_tpu.serving.prefix_cache import PrefixCache
+from chainermn_tpu.serving.router import Router
 from chainermn_tpu.serving.scheduler import (
     Completion,
     Request,
     Scheduler,
 )
+from chainermn_tpu.serving.sharding import serving_mesh
 
 __all__ = [
     "BlockAllocator",
@@ -58,5 +70,7 @@ __all__ = [
     "DecodeEngine",
     "Completion",
     "Request",
+    "Router",
     "Scheduler",
+    "serving_mesh",
 ]

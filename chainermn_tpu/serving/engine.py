@@ -103,6 +103,23 @@ class DecodeEngine:
       prefix_cache: share identical prompt prefixes through a refcounted
         block trie (on by default).  Cached blocks survive their writers
         until pool pressure or :meth:`drop_prefix_cache` releases them.
+      mesh: optional 1-D ``jax.sharding.Mesh`` with a ``"model"`` axis
+        (:func:`~chainermn_tpu.serving.sharding.serving_mesh`): the
+        engine becomes TENSOR-PARALLEL over it — params sharded per
+        :func:`~chainermn_tpu.serving.sharding.param_spec`, the paged KV
+        pools (target AND draft) sharded kv-head-major on axis 0, block
+        tables / allocator / prefix trie untouched (pure host
+        bookkeeping over block ids), control vectors uploaded
+        replicated.  Requires ``decode_attention="einsum"`` and a
+        geometry every sharded axis of which divides the mesh (checked
+        at construction).  The one-compile contract is unchanged: input
+        shardings are stable across steps, so the jit caches never see
+        a second signature.
+      device: optional ``jax.Device`` pinning a single-device engine's
+        pools and control uploads (the router's N-replicas-on-N-chips
+        layout without sharding).  Mutually exclusive with ``mesh``.
+        Default ``None`` keeps the classic implicit-default-device fast
+        path: no extra transfers anywhere.
     """
 
     def __init__(self, model, params, capacity: int, num_blocks: int,
@@ -111,7 +128,7 @@ class DecodeEngine:
                  prefill_chunk: int = 32, top_k: int = 0,
                  prefill_ladder: Optional[List[int]] = None,
                  draft_model=None, draft_params=None, spec_k: int = 0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, mesh=None, device=None):
         import jax
         import jax.numpy as jnp
 
@@ -140,10 +157,37 @@ class DecodeEngine:
                     f"spec_k must be in [1, {MAX_VERIFY_T - 1}] "
                     f"(verify chunk is k + 1 positions), got {spec_k}"
                 )
+        if mesh is not None and device is not None:
+            raise ValueError(
+                "mesh and device are mutually exclusive — a sharded "
+                "engine's placement IS its mesh"
+            )
+        self.mesh = mesh
+        self.device = device
+        placement = None
+        if mesh is not None:
+            from chainermn_tpu.serving import sharding as _sharding
+
+            _sharding.validate_geometry(model, mesh)
+            params = _sharding.shard_params(params, mesh)
+            if draft_model is not None:
+                _sharding.validate_geometry(draft_model, mesh)
+                draft_params = _sharding.shard_params(draft_params, mesh)
+            placement = _sharding.pool_placement(mesh)
+            #: where small per-step host arrays (control vectors, RNG
+            #: lanes) go: replicated on the mesh — one upload, every
+            #: chip reads the same block tables.
+            self._ctrl = _sharding.replicated(mesh)
+        elif device is not None:
+            placement = (lambda arr: jax.device_put(arr, device))
+            self._ctrl = device
+        else:
+            self._ctrl = None
         self.model = model
         self.params = params
         self.capacity = capacity
-        self.pool = PagedKVPool(model, num_blocks, block_len)
+        self.pool = PagedKVPool(model, num_blocks, block_len,
+                                placement=placement)
         self.block_len = block_len
         self.spec_k = spec_k
         self.draft_model = draft_model
@@ -192,7 +236,8 @@ class DecodeEngine:
             # SHARE its allocator + block tables: one physical block id
             # addresses both pools, so admission/sharing/eviction/COW
             # remain a single accounting decision.
-            dpool = PagedKVPool(draft_model, num_blocks, block_len)
+            dpool = PagedKVPool(draft_model, num_blocks, block_len,
+                                placement=placement)
             self.draft_pools = dpool.pools
             #: HBM bytes per block across target + draft pools.
             self.pool.bytes_per_block += dpool.bytes_per_block
@@ -371,6 +416,23 @@ class DecodeEngine:
             program="cow", budget=1,
         )
 
+    # ----------------------------------------------------------- uploads
+    def _up(self, x):
+        """One control-vector upload: committed to the engine's injected
+        placement (replicated on the mesh / pinned device) when one was
+        given, else the classic uncommitted ``jnp.asarray`` fast path.
+        A stable upload sharding is part of the one-compile contract —
+        the jit caches key on input shardings.  The placed path goes
+        host→target directly (``device_put`` on the host array) — an
+        intermediate ``jnp.asarray`` would land on the DEFAULT device
+        first and pay a second device→device hop per step."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._ctrl is None:
+            return jnp.asarray(x)
+        return jax.device_put(np.asarray(x), self._ctrl)
+
     # ------------------------------------------------------------- slots
     def seed_slot(self, slot: int, seed: int, temperature: float) -> None:
         """Arm a slot's RNG base key + temperature (admission-time only)."""
@@ -387,7 +449,7 @@ class DecodeEngine:
 
         if self._rng_temp_dev is None:
             self._rng_temp_dev = (
-                jnp.asarray(self.rng), jnp.asarray(self.temp)
+                self._up(self.rng), self._up(self.temp)
             )
         return self._rng_temp_dev
 
@@ -407,8 +469,6 @@ class DecodeEngine:
         token is sampled from the logits at that in-chunk index and
         returned.
         """
-        import jax.numpy as jnp
-
         if chunk.ndim != 1 or chunk.shape[0] not in self.prefill_ladder:
             raise ValueError(
                 f"chunk must be 1-D with a ladder size "
@@ -417,9 +477,9 @@ class DecodeEngine:
         self.pools, self.draft_pools, tok = self._prefill(
             self.pools,
             self.draft_pools,
-            jnp.asarray(chunk, jnp.int32)[None],
+            self._up(np.asarray(chunk, np.int32)[None]),
             np.int32(p0),
-            jnp.asarray(table, jnp.int32)[None],
+            self._up(np.asarray(table, np.int32)[None]),
             np.int32(last_idx),
             self.rng[slot],
             np.float32(self.temp[slot]),
@@ -441,15 +501,13 @@ class DecodeEngine:
         Returns ``(capacity,)`` int32 sampled tokens (garbage at inactive
         slots — callers must mask by ``active``).
         """
-        import jax.numpy as jnp
-
         rng, temp = self._rng_temp()
         self.pools, nxt = self._step(
             self.pools,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(pos, jnp.int32),
-            jnp.asarray(tables, jnp.int32),
-            jnp.asarray(active, bool),
+            self._up(np.asarray(tokens, np.int32)),
+            self._up(np.asarray(pos, np.int32)),
+            self._up(np.asarray(tables, np.int32)),
+            self._up(np.asarray(active, bool)),
             rng, temp,
         )
         return np.asarray(nxt)
@@ -468,8 +526,6 @@ class DecodeEngine:
         (greedy: accepted drafts + the target's correction/bonus;
         sampling slots always emit exactly ``tokens[s, :1]``).
         """
-        import jax.numpy as jnp
-
         if self._spec is None:
             raise RuntimeError(
                 "spec_step on a non-speculative engine — construct with "
@@ -479,10 +535,10 @@ class DecodeEngine:
         self.pools, self.draft_pools, toks, n_accept = self._spec(
             self.pools,
             self.draft_pools,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(pos, jnp.int32),
-            jnp.asarray(tables, jnp.int32),
-            jnp.asarray(active, bool),
+            self._up(np.asarray(tokens, np.int32)),
+            self._up(np.asarray(pos, np.int32)),
+            self._up(np.asarray(tables, np.int32)),
+            self._up(np.asarray(active, bool)),
             rng, temp,
         )
         return np.asarray(toks), np.asarray(n_accept)

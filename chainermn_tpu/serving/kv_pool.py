@@ -137,9 +137,19 @@ class PagedKVPool:
     ``kv_dtype=jnp.int8`` models get int8 pools with fp32 scale planes —
     the same symmetric-absmax convention as the contiguous cache, at half
     the bf16 pool bytes.
+
+    ``placement`` makes device placement EXPLICIT and injected (it used
+    to be whatever ``jnp.zeros`` landed on — implicitly
+    ``jax.devices()[0]``): a callable applied to every freshly-built
+    pool array.  Pass
+    :func:`~chainermn_tpu.serving.sharding.pool_placement` for a
+    kv-head-major mesh shard, ``lambda a: jax.device_put(a, dev)`` to
+    pin a specific device, or ``None`` (the default-constructed
+    single-device fast path — no extra transfer, unchanged behavior).
     """
 
-    def __init__(self, model, num_blocks: int, block_len: int):
+    def __init__(self, model, num_blocks: int, block_len: int,
+                 placement=None):
         import jax.numpy as jnp
 
         if block_len < 1:
@@ -170,6 +180,11 @@ class PagedKVPool:
                 for _ in range(model.n_layers)
             ]
             per_layer = 2 * kvh * block_len * dh * jnp.dtype(kvd).itemsize
+        if placement is not None:
+            self.pools = [
+                {n: placement(arr) for n, arr in layer.items()}
+                for layer in self.pools
+            ]
         #: HBM bytes one physical block costs across all layers.  Computed
         #: from geometry, NOT the arrays: the engine donates the pool
         #: buffers to its jitted step, so these initial arrays are deleted

@@ -1,0 +1,406 @@
+"""Multi-replica serving router: N engines × M chips behind one queue.
+
+The second layer of the pod-scale story (ROADMAP item 1): one sharded
+engine spans chips, and the :class:`Router` puts N such engines behind
+**least-loaded dispatch** so the fleet serves one request stream.  Each
+replica is a full :class:`~chainermn_tpu.serving.Scheduler` over its own
+:class:`~chainermn_tpu.serving.DecodeEngine` (its own device group, pool,
+prefix trie) plus its OWN metrics registry and span ring — the router is
+deliberately thin host-side glue:
+
+* **Dispatch** reads each replica's LIVE gauges — ``serve.slot_occupancy``
+  and ``serve.queue_depth`` for load, ``mem.kv.occupancy`` as the
+  tie-break — exactly the signals every replica already publishes (PR 6/8);
+  the router adds only a count of its own dispatches since the gauges
+  last refreshed, so a burst between ticks still spreads.
+* **Backpressure** is per-replica admission: a replica whose queue is at
+  ``max_queue`` (``CMN_ROUTER_MAX_QUEUE``, default ``2 × capacity``)
+  takes no new work; when EVERY replica is saturated the request waits in
+  the router's own holdback queue (``serve.router.queue_depth`` — the
+  autoscaling signal, watched by the incident plane's ``router_backlog``
+  rule).  Nothing is ever dropped: holdback drains the moment any replica
+  dips below its cap.
+* **Rebalance** (``CMN_ROUTER_REBALANCE``, default on): when one replica
+  has arrived work queued behind full slots while another sits idle, the
+  router *steals* the youngest queued entry and resubmits it to the idle
+  replica — carried tokens and accounting ride along
+  (:meth:`Scheduler.steal_queued` / :meth:`Scheduler.submit_entry`).
+  A migrated request's lifecycle spans therefore land on BOTH replicas'
+  span rings, and :meth:`Router.export_fleet_trace` merges the per-replica
+  rings through the PR-8 fleet pipeline (one replica = one "rank"/pid in
+  the Perfetto trace), so one request's life is visible across replicas.
+
+Clock: all replicas share ONE scheduler clock, so cross-replica
+timestamps (and the merged trace) are coherent and idle gaps skip once
+for the whole fleet.
+
+Everything here is host-side: the router never touches a device buffer —
+its cost per tick is a few gauge reads and list operations, measured by
+``serve.router.dispatch_ms``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+from chainermn_tpu.observability.metrics import (
+    MetricsRegistry,
+    NoopInstrument as _NoopInstrument,
+)
+from chainermn_tpu.serving.scheduler import (
+    Completion,
+    Request,
+    Scheduler,
+    _Clock,
+)
+
+
+class Router:
+    """Least-loaded dispatch over N scheduler replicas.
+
+    Args:
+      engines: one :class:`~chainermn_tpu.serving.DecodeEngine` per
+        replica (each already placed — its own mesh or pinned device).
+        Replicas are assumed geometry-homogeneous: any replica's
+        :meth:`Scheduler.check_fit` gate speaks for all.
+      registry: where the ``serve.router.*`` family publishes.  Same
+        contract as the Scheduler: an explicit registry always
+        publishes; ``None`` rides the ``CMN_OBS`` master switch on the
+        ambient global registry.  (Each REPLICA always gets its own
+        private :class:`MetricsRegistry` regardless — the router's
+        dispatch signals must exist even with observability off, and
+        per-replica instruments must not collide in one registry.)
+      clock: injectable shared clock (tests/benchmarks).
+      max_queue: per-replica admission cap (requests queued at one
+        replica).  Default ``CMN_ROUTER_MAX_QUEUE``, else
+        ``2 × capacity``.
+      rebalance: steal queued work from a blocked replica for an idle
+        one.  Default ``CMN_ROUTER_REBALANCE`` (on).
+    """
+
+    def __init__(self, engines: Sequence, registry=None,
+                 clock: Optional[_Clock] = None,
+                 max_queue: Optional[int] = None,
+                 rebalance: Optional[bool] = None):
+        import chainermn_tpu.observability as _obs
+        from chainermn_tpu.observability.metrics import (
+            DEFAULT_MS_EDGES,
+            registry as global_registry,
+        )
+        from chainermn_tpu.observability.tracing import (
+            RequestTimeline,
+            SpanRing,
+        )
+
+        engines = list(engines)
+        if not engines:
+            raise ValueError("router needs at least one engine")
+        self.clock = clock or _Clock()
+        #: per-replica span rings: each replica is one "rank" in the
+        #: merged fleet trace (the timeline mirrors every lifecycle
+        #: event as a ``serve.<kind>`` span carrying ``req=<id>``).
+        self.rings = [SpanRing(4096) for _ in engines]
+        self.replica_registries = [MetricsRegistry() for _ in engines]
+        self.schedulers: List[Scheduler] = [
+            Scheduler(
+                eng, registry=reg, clock=self.clock,
+                timeline=RequestTimeline(ring=ring),
+            )
+            for eng, reg, ring in zip(
+                engines, self.replica_registries, self.rings
+            )
+        ]
+        if max_queue is None:
+            env = os.environ.get("CMN_ROUTER_MAX_QUEUE", "")
+            max_queue = (
+                int(env) if env.isdigit() and int(env) > 0
+                else 2 * max(e.capacity for e in engines)
+            )
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+        self.rebalance = (
+            rebalance if rebalance is not None
+            else os.environ.get("CMN_ROUTER_REBALANCE", "1") != "0"
+        )
+        #: router holdback queue (FIFO by submission; the traffic
+        #: generators submit in arrival order, same as the Scheduler).
+        self._queue: List[Request] = []
+        #: request id -> replica indices it was dispatched to, in order
+        #: (len > 1 = migrated) — the dispatch audit trail tests and
+        #: benchmarks read.
+        self.assignments: Dict[int, List[int]] = {}
+        #: dispatches since each replica's gauges last refreshed — the
+        #: burst corrector added onto the gauge-read load score.
+        self._since_gauge = [0] * len(engines)
+        #: per-replica occupancy accumulation (benchmark's spread
+        #: headline: mean occupancy per replica over the run).
+        self._occ_sum = [0.0] * len(engines)
+        self._occ_n = 0
+        #: host-side dispatch latencies, ms (the histogram's raw feed;
+        #: kept for the benchmark's percentile report).
+        self.dispatch_ms: List[float] = []
+        self._ticks = 0
+        enabled = _obs.enabled()
+        if registry is None and not enabled:
+            noop = _NoopInstrument()
+            self._m_disp = self._m_migr = self._m_bp = noop
+            self._m_rq = self._m_spread = self._m_disp_ms = noop
+        else:
+            reg = registry if registry is not None else global_registry()
+            self._m_disp = reg.counter("serve.router.dispatched")
+            self._m_migr = reg.counter("serve.router.migrated")
+            self._m_bp = reg.counter("serve.router.backpressure")
+            self._m_rq = reg.gauge("serve.router.queue_depth")
+            self._m_spread = reg.gauge("serve.router.occupancy_spread")
+            self._m_disp_ms = reg.histogram(
+                "serve.router.dispatch_ms", edges=DEFAULT_MS_EDGES
+            )
+        #: Incident plane: same resolution as the Scheduler — the
+        #: process manager rides the ambient-registry publishing
+        #: decision (an explicit registry's gauges live where the
+        #: process rules cannot see them); evaluated on a tick cadence
+        #: + once at finish, so a sustained ``serve.router.queue_depth``
+        #: backlog trips the ``router_backlog`` default rule.
+        if registry is None and enabled:
+            from chainermn_tpu.observability import incident as _oincident
+
+            self.incidents = _oincident.manager()
+        else:
+            self.incidents = None
+        self._inc_every = 16
+
+    # ---------------------------------------------------------- dispatch
+    @property
+    def replicas(self) -> int:
+        return len(self.schedulers)
+
+    def submit(self, req: Request) -> None:
+        """Accept a request into the router queue (validated against
+        replica 0's geometry — homogeneous replicas)."""
+        self.schedulers[0].check_fit(req)
+        self._queue.append(req)
+
+    def _gauge(self, i: int, name: str):
+        inst = self.replica_registries[i].peek(name)
+        v = inst.value if inst is not None else None
+        return None if v is None else float(v)
+
+    def _load(self, i: int) -> float:
+        """Replica load score off the LIVE gauges: occupied slots plus
+        queued requests, per slot of capacity, with the KV-pool
+        occupancy gauge as the fractional tie-break (two equally busy
+        replicas — prefer the one with more free pool).  Gauges refresh
+        once per tick, so the router adds its own dispatches since the
+        last refresh on top; before a replica's FIRST tick (cold start
+        — gauges never published) the scheduler's host-side truth
+        stands in, and already includes every dispatch."""
+        s = self.schedulers[i]
+        cap = s.engine.capacity
+        occ = self._gauge(i, "serve.slot_occupancy")
+        qd = self._gauge(i, "serve.queue_depth")
+        if occ is None or qd is None:
+            occ, qd = s.slot_occupancy, float(s.queue_depth)
+        else:
+            qd += self._since_gauge[i]
+        kv = self._gauge(i, "mem.kv.occupancy") or 0.0
+        return (occ * cap + qd) / cap + 0.1 * kv
+
+    def _pick_replica(self) -> Optional[int]:
+        """Least-loaded replica with admission headroom, or ``None``
+        when every replica is at ``max_queue`` (backpressure)."""
+        best, best_load = None, None
+        for i, s in enumerate(self.schedulers):
+            # queue_depth is LIVE (submit appends immediately), so it
+            # already counts this tick's dispatches — _since_gauge is
+            # only for correcting the stale gauges in _load.
+            if s.queue_depth >= self.max_queue:
+                continue
+            load = self._load(i)
+            if best_load is None or load < best_load:
+                best, best_load = i, load
+        return best
+
+    def _dispatch(self) -> bool:
+        """Move every ARRIVED router-queue request to the least-loaded
+        replica, FIFO; stop at the first backpressure refusal (order
+        preservation) or future arrival."""
+        progressed = False
+        now = self.clock.now()
+        while self._queue and self._queue[0].arrival <= now:
+            t0 = time.perf_counter()
+            best = self._pick_replica()
+            if best is None:
+                # Fleet-wide backpressure: the request WAITS here (and
+                # is never lost) — count the deferral, surface depth.
+                self._m_bp.inc()
+                break
+            req = self._queue.pop(0)
+            self.schedulers[best].submit(req)
+            self.assignments.setdefault(req.id, []).append(best)
+            self._since_gauge[best] += 1
+            ms = (time.perf_counter() - t0) * 1e3
+            self.dispatch_ms.append(ms)
+            self._m_disp.inc()
+            self._m_disp_ms.observe(ms)
+            progressed = True
+        self._m_rq.set(len(self._queue))
+        return progressed
+
+    def _rebalance(self) -> bool:
+        """Steal arrived queued work from a replica whose slots are all
+        busy for a replica with a free slot and an empty queue."""
+        if not self.rebalance:
+            return False
+        idle = [
+            i for i, s in enumerate(self.schedulers)
+            if s.has_free_slot and s.queue_depth == 0
+        ]
+        if not idle:
+            return False
+        donors = sorted(
+            (
+                i for i, s in enumerate(self.schedulers)
+                if s.queue_depth > 0 and not s.has_free_slot
+            ),
+            key=lambda i: -self.schedulers[i].queue_depth,
+        )
+        moved = False
+        for dst in idle:
+            for src in donors:
+                if src == dst:
+                    continue
+                entry = self.schedulers[src].steal_queued()
+                if entry is None:
+                    continue
+                self.schedulers[dst].submit_entry(entry)
+                self.assignments.setdefault(
+                    entry.req.id, []
+                ).append(dst)
+                self._m_migr.inc()
+                moved = True
+                break
+        return moved
+
+    # --------------------------------------------------------------- run
+    def tick(self) -> bool:
+        """One fleet iteration: dispatch arrived requests, tick every
+        replica, rebalance, refresh router gauges.  Returns whether
+        anything progressed anywhere."""
+        progressed = self._dispatch()
+        for s in self.schedulers:
+            if s.tick():
+                progressed = True
+        if self._rebalance():
+            progressed = True
+        self._since_gauge = [0] * len(self.schedulers)
+        occs = [
+            self._gauge(i, "serve.slot_occupancy")
+            for i in range(len(self.schedulers))
+        ]
+        self._m_spread.set(max(occs) - min(occs))
+        for i, o in enumerate(occs):
+            self._occ_sum[i] += o
+        self._occ_n += 1
+        self._ticks += 1
+        if self.incidents is not None and \
+                self._ticks % self._inc_every == 0:
+            self.incidents.evaluate()
+        return progressed
+
+    @property
+    def pending(self) -> bool:
+        return bool(
+            self._queue or any(s.pending for s in self.schedulers)
+        )
+
+    def run(self, requests: Optional[Sequence[Request]] = None
+            ) -> List[Completion]:
+        """Submit ``requests`` (optional) and drain the whole fleet.
+        Returns every replica's completions, merged (sorted by finish
+        time)."""
+        for r in requests or ():
+            self.submit(r)
+        while self.pending:
+            if not self.tick():
+                nxt = [r.arrival for r in self._queue[:1]]
+                nxt += [
+                    t for t in (
+                        s.next_arrival() for s in self.schedulers
+                    ) if t is not None
+                ]
+                if not nxt:  # pragma: no cover - defensive
+                    raise RuntimeError(
+                        "router made no progress with no future arrivals"
+                    )
+                self.clock.skip_to(min(nxt))
+        self.finish()
+        return self.completions
+
+    def finish(self) -> None:
+        """Close every replica's books + the router's own gauges."""
+        for s in self.schedulers:
+            s.finish()
+        self._m_rq.set(len(self._queue))
+        self._m_spread.set(0.0)
+        if self.incidents is not None:
+            self.incidents.evaluate()
+
+    # ------------------------------------------------------ introspection
+    @property
+    def completions(self) -> List[Completion]:
+        out: List[Completion] = []
+        for s in self.schedulers:
+            out.extend(s.completions)
+        return sorted(out, key=lambda c: (c.finished_at, c.id))
+
+    def replica_stats(self) -> List[dict]:
+        """Per-replica host-side summary (benchmarks/dashboards)."""
+        out = []
+        for i, s in enumerate(self.schedulers):
+            out.append({
+                "replica": i,
+                "dispatched": sum(
+                    1 for reps in self.assignments.values()
+                    if reps and reps[0] == i
+                ),
+                "served": sum(
+                    1 for reps in self.assignments.values()
+                    if reps and reps[-1] == i
+                ),
+                "completions": len(s.completions),
+                "occupancy_mean": (
+                    self._occ_sum[i] / self._occ_n if self._occ_n else 0.0
+                ),
+                "engine": s.engine.stats(),
+            })
+        return out
+
+    def export_fleet_trace(self, path: str) -> dict:
+        """Merge the per-replica span rings through the PR-8 fleet
+        pipeline — one replica = one "rank" (pid) — and write ONE
+        Perfetto-loadable trace.  A migrated request's ``serve.*``
+        spans (each carrying ``req=<id>`` detail) appear under every
+        replica that touched it.  Replicas share one process and one
+        monotonic clock, so no offset correction is needed (offsets
+        default to zero).  Returns the merge summary (with ``path``)."""
+        from chainermn_tpu.observability import fleet as _fleet
+        from chainermn_tpu.observability import tracing as _tracing
+
+        dumps = [
+            {
+                "rank": i,
+                "spans": ring.snapshot(),
+                "spans_total": ring.total,
+                "epoch_wall": _tracing.EPOCH_WALL,
+                "epoch_perf": _tracing.EPOCH_PERF,
+            }
+            for i, ring in enumerate(self.rings)
+        ]
+        merged = _fleet.merge_fleet_trace(dumps)
+        merged["summary"]["path"] = _fleet.write_fleet_trace(
+            path, merged["payload"]
+        )
+        return merged["summary"]

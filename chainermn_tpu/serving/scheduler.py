@@ -399,6 +399,22 @@ class Scheduler:
     def submit(self, req: Request) -> None:
         """Enqueue; raises :class:`PoolExhausted` if the request could
         never fit the pool/slot geometry even running alone."""
+        self.check_fit(req)
+        self._queue.append(_QueueEntry(req))
+        if self.timeline is not None:
+            # Stamped at the request's logical availability (its arrival
+            # on the scheduler clock) — the same origin the queue-wait
+            # metric uses, so the queue slice and the histogram agree.
+            self.timeline.record(
+                "submit", t=float(req.arrival), req=req.id,
+                info={"prompt_len": len(req.prompt),
+                      "max_new": req.max_new_tokens},
+            )
+
+    def check_fit(self, req: Request) -> None:
+        """The submit-time geometry gate, callable without enqueueing
+        (the router validates against one replica before dispatch —
+        replicas are assumed geometry-homogeneous)."""
         plen = len(req.prompt)
         if plen < 1:
             raise ValueError(f"request {req.id}: empty prompt")
@@ -449,15 +465,74 @@ class Scheduler:
                 f"position table ({eng.model.max_len}); use a rope model "
                 "or shorter requests"
             )
-        self._queue.append(_QueueEntry(req))
+
+    # --------------------------------------------- router integration
+    def submit_entry(self, entry: _QueueEntry) -> None:
+        """Re-enqueue an entry migrated from a peer replica (router
+        rebalance): carried tokens, eviction counts and prefix/spec
+        accounting ride along, so the destination engine recomputes the
+        carried text through its own prefill/prefix-cache and the
+        request continues exactly where it left off.  Geometry was
+        validated at the original :meth:`submit` (homogeneous
+        replicas)."""
+        self._queue.append(entry)
         if self.timeline is not None:
-            # Stamped at the request's logical availability (its arrival
-            # on the scheduler clock) — the same origin the queue-wait
-            # metric uses, so the queue slice and the histogram agree.
             self.timeline.record(
-                "submit", t=float(req.arrival), req=req.id,
-                info={"prompt_len": plen, "max_new": req.max_new_tokens},
+                "submit", t=self.clock.now(), req=entry.req.id,
+                info={"migrated": True,
+                      "carried": len(entry.carried)},
             )
+
+    def steal_queued(self) -> Optional[_QueueEntry]:
+        """Pop the YOUNGEST queued entry whose arrival has passed, for
+        migration to a less-loaded replica (router work rebalance).
+        Returns ``None`` when nothing stealable is queued.  The
+        youngest is the right victim for the same reason eviction picks
+        it: the head of the queue is the oldest waiter (possibly an
+        evicted re-admission carrying generated tokens) and keeps its
+        position."""
+        if not self._queue:
+            return None
+        entry = self._queue[-1]
+        if entry.req.arrival > self.clock.now():
+            return None
+        self._queue.pop()
+        if self.timeline is not None:
+            self.timeline.record(
+                "steal", t=self.clock.now(), req=entry.req.id,
+            )
+        return entry
+
+    @property
+    def pending(self) -> bool:
+        """Work outstanding: anything queued or resident in a slot."""
+        return bool(
+            self._queue or any(s is not None for s in self._slots)
+        )
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def slot_occupancy(self) -> float:
+        """Live slots / capacity — the host-side truth behind the
+        ``serve.slot_occupancy`` gauge (the router's cold-start
+        fallback before a replica's first tick publishes)."""
+        return (
+            sum(s is not None for s in self._slots)
+            / self.engine.capacity
+        )
+
+    @property
+    def has_free_slot(self) -> bool:
+        return any(s is None for s in self._slots)
+
+    def next_arrival(self) -> Optional[float]:
+        """The head entry's arrival time (admission is strictly FIFO,
+        so the head is the only entry whose arrival can unblock
+        anything), or None on an empty queue."""
+        return self._queue[0].req.arrival if self._queue else None
 
     def _worst_prefill_end(self, lo: int, hi: int) -> int:
         """Max padded prefill end over admission text lengths in
@@ -958,25 +1033,32 @@ class Scheduler:
             )
 
     # --------------------------------------------------------------- run
+    def tick(self) -> bool:
+        """ONE scheduling iteration — admit while possible, one prefill
+        chunk per refilling slot, one decode step — plus the queue/
+        occupancy gauge refresh.  Returns whether anything progressed
+        (False = idle: the queue head hasn't arrived yet, or there is no
+        work at all).  :meth:`run` is a tick loop over one scheduler;
+        the :class:`~chainermn_tpu.serving.router.Router` interleaves
+        ticks across replicas on a shared clock."""
+        progressed = False
+        while self._try_admit():
+            progressed = True
+        if self._prefill_round():
+            progressed = True
+        if self._decode_step():
+            progressed = True
+        self._m_queue.set(len(self._queue))
+        self._m_occ.set(self.slot_occupancy)
+        return progressed
+
     def run(self, requests: Optional[Sequence[Request]] = None
             ) -> List[Completion]:
         """Submit ``requests`` (optional) and drain queue + slots."""
         for r in requests or ():
             self.submit(r)
-        while self._queue or any(s is not None for s in self._slots):
-            progressed = False
-            while self._try_admit():
-                progressed = True
-            if self._prefill_round():
-                progressed = True
-            if self._decode_step():
-                progressed = True
-            self._m_queue.set(len(self._queue))
-            self._m_occ.set(
-                sum(s is not None for s in self._slots)
-                / self.engine.capacity
-            )
-            if not progressed:
+        while self.pending:
+            if not self.tick():
                 if not any(s is not None for s in self._slots):
                     # Idle: jump the clock to the HEAD entry's arrival —
                     # admission is strictly FIFO, so the head is the only
@@ -989,6 +1071,13 @@ class Scheduler:
                     raise RuntimeError(
                         "scheduler made no progress with live slots"
                     )
+        self.finish()
+        return list(self.completions)
+
+    def finish(self) -> None:
+        """The drain epilogue: closing gauge/SLO/memory/incident/device
+        publishes.  Split out of :meth:`run` so the router can drive
+        replicas tick-by-tick and still close each one's books."""
         self._m_queue.set(0)
         self._m_occ.set(0.0)
         if self.slo is not None:
@@ -1008,7 +1097,6 @@ class Scheduler:
             # meant it (the check cadence): a three-iteration unit drain
             # must not pay the one-time cost capture's extra lowering.
             self._publish_device()
-        return list(self.completions)
 
     # ------------------------------------------------------- observability
     def _publish_device(self, capture: bool = True) -> None:

@@ -45,6 +45,41 @@ def prompts():
     return [rng.randint(1, 128, size=n).tolist() for n in (5, 12, 9, 3, 17)]
 
 
+#: The multi-device CPU rig (ISSUE 13 satellite): tier-1 exercises REAL
+#: >= 2-way GSPMD sharding without TPUs because the top-level
+#: tests/conftest.py forces ``XLA_FLAGS=--xla_force_host_platform_
+#: device_count=8`` before jax initializes (the same env hook a bare
+#: subprocess would use — tests/serving_tests/test_sharding.py pins the
+#: hook itself end-to-end in a pristine interpreter).  These fixtures
+#: are the rig's front door: they fail LOUDLY when the forced pod is
+#: missing rather than silently collapsing every sharding test to one
+#: device.
+@pytest.fixture(scope="session")
+def pod_devices():
+    """The >= 8 forced CPU devices sharding/router tests partition."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip(
+            "multi-device CPU rig missing: run under tests/conftest.py "
+            "(or XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+    assert len(devs) >= 8, (
+        f"forced CPU pod expected 8 devices, got {len(devs)} — the "
+        "conftest env hook ran too late (jax already initialized?)"
+    )
+    return devs
+
+
+@pytest.fixture(scope="session")
+def model_mesh(pod_devices):
+    """A 2-way ``Mesh(("model",))`` over the rig — 2 divides the shared
+    geometry's kv heads (n_kv_heads=2), so the KV pools split one head
+    per device: the smallest REAL shard."""
+    from chainermn_tpu.serving.sharding import serving_mesh
+
+    return serving_mesh(2, devices=pod_devices[:2])
+
+
 @pytest.fixture(scope="session")
 def oracle():
     """Per-request sequential greedy reference, MEMOIZED per session:

@@ -1,0 +1,207 @@
+"""Router: least-loaded dispatch, backpressure, fleet-trace correlation.
+
+The multi-replica half of the pod-scale serving subsystem (ISSUE 13):
+
+* dispatch reads each replica's LIVE ``serve.slot_occupancy`` /
+  ``serve.queue_depth`` / ``mem.kv.occupancy`` gauges — skewed load must
+  route new work to the less-loaded replica;
+* per-replica admission backpressure holds overflow in the router's own
+  queue and loses NOTHING;
+* a rebalanced (stolen) request's lifecycle spans land on both replicas'
+  span rings, and the PR-8 merged fleet trace names that one request on
+  both replica pids;
+* the ``router_backlog`` default incident rule fires on a sustained
+  ``serve.router.queue_depth`` backlog (tier-1 pin of the ISSUE 13
+  CI/tooling satellite).
+"""
+
+import json
+
+import pytest
+
+from chainermn_tpu.observability.metrics import MetricsRegistry
+from chainermn_tpu.serving import DecodeEngine, Request, Router, Scheduler
+
+pytestmark = [pytest.mark.tier1, pytest.mark.serving]
+
+
+def _mk_router(make_model, tiny_params, n=2, capacity=1, **kw):
+    engines = [
+        DecodeEngine(
+            make_model(), tiny_params, capacity=capacity, num_blocks=24,
+            block_len=8, prefill_chunk=8,
+        )
+        for _ in range(n)
+    ]
+    reg = MetricsRegistry()
+    return Router(engines, registry=reg, **kw), reg
+
+
+def _reqs(prompts, n, max_new=6, **kw):
+    return [
+        Request(
+            id=i, prompt=prompts[i % len(prompts)], max_new_tokens=max_new,
+            **kw,
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def skewed_run(make_model, tiny_params, prompts):
+    """Replica 0 pre-loaded to the gills; fresh arrivals must go to
+    replica 1 off the live gauges.  Module-scoped: the trace test reads
+    the same run."""
+    router, reg = _mk_router(make_model, tiny_params, capacity=1,
+                             max_queue=8)
+    # Skew: 4 requests straight onto replica 0's scheduler (bypassing
+    # dispatch — the router discovers the imbalance only through the
+    # signals replica 0 publishes).  Every fresh arrival then scores
+    # replica 0 STRICTLY busier than replica 1 however many were just
+    # dispatched there.
+    for i in range(4):
+        router.schedulers[0].submit(
+            Request(id=100 + i, prompt=prompts[i], max_new_tokens=8)
+        )
+        router.assignments.setdefault(100 + i, []).append(0)
+    comps = router.run(_reqs(prompts, 4))
+    return router, reg, comps
+
+
+def test_least_loaded_dispatch_off_live_gauges(skewed_run):
+    router, _, comps = skewed_run
+    assert sorted(c.id for c in comps) == [0, 1, 2, 3, 100, 101, 102, 103]
+    # Every router-dispatched request was FIRST routed to the unloaded
+    # replica (replica 0's occupancy + queue gauges read saturated).
+    for rid in (0, 1, 2, 3):
+        assert router.assignments[rid][0] == 1, router.assignments
+    # The rebalancer pulled some of replica 0's backlog to replica 1
+    # once it idled — the migration audit trail shows both replicas.
+    migrated = [
+        rid for rid, reps in router.assignments.items()
+        if len(set(reps)) > 1
+    ]
+    assert migrated, router.assignments
+
+
+def test_merged_fleet_trace_names_request_on_both_replicas(
+    skewed_run, tmp_path
+):
+    router, _, _ = skewed_run
+    path = str(tmp_path / "fleet_router.json")
+    summary = router.export_fleet_trace(path)
+    assert summary["nranks"] == router.replicas
+    events = json.load(open(path))["traceEvents"]
+    by_req = {}
+    for e in events:
+        detail = e.get("args", {}).get("detail", "")
+        if isinstance(detail, str) and detail.startswith("req="):
+            by_req.setdefault(detail, set()).add(e["pid"])
+    migrated = {
+        rid for rid, reps in router.assignments.items()
+        if len(set(reps)) > 1
+    }
+    for rid in migrated:
+        assert by_req.get(f"req={rid}") == set(
+            router.assignments[rid][:1] + router.assignments[rid][-1:]
+        ) or len(by_req.get(f"req={rid}", ())) > 1, (
+            rid, by_req.get(f"req={rid}"), router.assignments[rid]
+        )
+    assert any(len(pids) > 1 for pids in by_req.values()), by_req
+
+
+def test_backpressure_loses_nothing(make_model, tiny_params, prompts):
+    """Tiny per-replica cap + a burst: the router's holdback queue
+    absorbs the overflow (counted, gauged) and every request still
+    completes exactly once."""
+    router, reg = _mk_router(make_model, tiny_params, capacity=1,
+                             max_queue=1)
+    n = 8
+    comps = router.run(_reqs(prompts, n, max_new=4))
+    assert sorted(c.id for c in comps) == list(range(n))
+    assert len(comps) == n  # exactly once — nothing dropped or doubled
+    assert reg.peek("serve.router.backpressure").value > 0
+    assert reg.peek("serve.router.dispatched").value == n
+    # Drained: holdback gauge closes at zero.
+    assert reg.peek("serve.router.queue_depth").value == 0
+    hist = reg.peek("serve.router.dispatch_ms")
+    assert hist is not None and hist.count == n
+
+
+def test_router_metric_family_and_spread(make_model, tiny_params, prompts):
+    router, reg = _mk_router(make_model, tiny_params, capacity=2)
+    router.run(_reqs(prompts, 6, max_new=4))
+    for name in (
+        "serve.router.dispatched", "serve.router.migrated",
+        "serve.router.backpressure", "serve.router.queue_depth",
+        "serve.router.occupancy_spread", "serve.router.dispatch_ms",
+    ):
+        assert reg.peek(name) is not None, name
+    stats = router.replica_stats()
+    assert len(stats) == 2
+    assert sum(s["completions"] for s in stats) == 6
+    # Balanced traffic through least-loaded dispatch: both replicas
+    # served work.
+    assert all(s["served"] > 0 for s in stats), stats
+
+
+def test_router_validates_and_rejects_misfits(make_model, tiny_params):
+    router, _ = _mk_router(make_model, tiny_params)
+    from chainermn_tpu.serving import PoolExhausted
+
+    with pytest.raises(PoolExhausted):
+        router.submit(
+            Request(id=0, prompt=[1] * 400, max_new_tokens=400)
+        )
+    with pytest.raises(ValueError):
+        Router([])
+
+
+def test_router_backlog_default_incident_rule(tmp_path):
+    """CI/tooling satellite pin: the shipped rule set watches
+    ``serve.router.queue_depth`` and a SUSTAINED backlog (hysteresis 3)
+    files exactly one incident bundle."""
+    from chainermn_tpu.observability.incident import (
+        IncidentManager,
+        default_rules,
+    )
+
+    rules = [r for r in default_rules() if r.name == "router_backlog"]
+    assert rules and rules[0].metric == "serve.router.queue_depth"
+    assert rules[0].hysteresis == 3
+    reg = MetricsRegistry()
+    mgr = IncidentManager(
+        registry=reg, rules=rules, directory=str(tmp_path),
+        cooldown_s=0.0,
+    )
+    reg.gauge("serve.router.queue_depth").set(5.0)
+    assert mgr.evaluate() == []   # 1st breaching evaluation
+    assert mgr.evaluate() == []   # 2nd — hysteresis still arming
+    fired = mgr.evaluate()        # 3rd consecutive -> files
+    assert len(fired) == 1 and fired[0]["rule"]["name"] == "router_backlog"
+    assert mgr.evaluate() == []   # latched while still breaching
+    reg.gauge("serve.router.queue_depth").set(0.0)
+    assert mgr.evaluate() == []   # clean evaluation re-arms quietly
+
+
+def test_scheduler_tick_refactor_equivalence(make_model, tiny_params,
+                                             prompts, oracle):
+    """run() is now a tick() loop: driving the SAME scheduler manually
+    tick-by-tick (the router's mode) produces the oracle's tokens and
+    the same drain bookkeeping."""
+    eng = DecodeEngine(
+        make_model(), tiny_params, capacity=2, num_blocks=24,
+        block_len=8, prefill_chunk=8,
+    )
+    sched = Scheduler(eng)
+    for i in range(3):
+        sched.submit(
+            Request(id=i, prompt=prompts[i], max_new_tokens=5)
+        )
+    while sched.pending:
+        assert sched.tick()  # all arrivals at t=0: always progresses
+    sched.finish()
+    assert len(sched.completions) == 3
+    for c in sched.completions:
+        assert c.tokens == oracle(eng.model, tiny_params, prompts[c.id], 5)
+    assert not sched.pending and sched.queue_depth == 0
