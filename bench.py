@@ -245,6 +245,19 @@ def _serving_headline() -> dict | None:
             ).get("aggregate_tokens_per_sec"),
             "router_replicas": rec.get("router", {}).get("replicas"),
             "router_mesh_model": rec.get("router", {}).get("mesh_model"),
+            # Disaggregated prefill/decode arm (ISSUE 14), when the
+            # artifact carries it: clean-decode p95 on the decode role
+            # vs the colocated engine, and the mixed-iteration count
+            # left on the decode role (the contract: zero).
+            "disagg_clean_decode_p95_ms": rec.get(
+                "disagg", {}
+            ).get("clean_decode_p95_ms"),
+            "disagg_colocated_decode_p95_ms": rec.get(
+                "disagg", {}
+            ).get("colocated_clean_decode_p95_ms"),
+            "disagg_mixed_decode_role": rec.get(
+                "disagg", {}
+            ).get("mixed_decode_role", {}).get("count"),
         }
 
     return _best_result("serving*.json", cands)
@@ -368,6 +381,11 @@ def _summary_line(payload: dict, lm=None, dec=None, srv=None,
     # artifacts.
     if srv is not None and srv.get("router_tokens_per_sec") is not None:
         summary["router_tokens_per_sec"] = srv["router_tokens_per_sec"]
+    # Disagg-arm pointer (ISSUE 14): the decode role's clean-decode p95,
+    # present only when the serving artifact carries the role-split arm.
+    if srv is not None and \
+            srv.get("disagg_clean_decode_p95_ms") is not None:
+        summary["disagg_decode_p95_ms"] = srv["disagg_clean_decode_p95_ms"]
     # Artifact POINTERS, not payloads: the full headline dicts ride the
     # composite line above; the tail line names where each number came
     # from so a consumer can open the file.

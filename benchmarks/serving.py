@@ -43,6 +43,12 @@ Two further arms ride the same alternating-pair methodology (ISSUE 7):
   into the engine vs the plain engine on the same zero-tail target;
   reports per-slot acceptance and the tokens/s ratio.
 
+``--disagg`` (ISSUE 14) runs the disaggregated prefill/decode arm: a
+prefill-role + decode-role engine pair over the in-process KV-migration
+plane vs a colocated engine under identical traffic — p95 clean-decode
+latency, the ``serve.mixed_ms`` mass shifted off the decode role (it
+must be zero there), and the migration cost envelope.
+
     python benchmarks/serving.py --out result/serving_tpu.json  # real chip
     JAX_PLATFORMS=cpu python benchmarks/serving.py --smoke      # plumbing
 """
@@ -151,6 +157,14 @@ def main():
                          "unsharded replicas).  Requires the einsum "
                          "decode path (forced for the router arm when "
                          "M > 1)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="also run the DISAGGREGATED prefill/decode arm "
+                         "(ISSUE 14): a prefill-role engine + a "
+                         "decode-role engine over the in-process "
+                         "migration plane vs a colocated engine under "
+                         "identical Poisson traffic; reports p95 "
+                         "clean-decode latency and the serve.mixed_ms "
+                         "mass shifted off the decode role")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--trace-out", default=None,
@@ -195,7 +209,7 @@ def main():
             new_min=4, new_max=64, layers=4, d_model=512, heads=8,
             d_ff=1024, vocab=4096, block_len=8, prefill_chunk=16,
             repeats=4, obs_pairs=12, prefix_reuse=4, spec_k=3,
-            draft_layers=1, replicas=2,
+            draft_layers=1, replicas=2, disagg=True,
         )
         for k, v in smoke_over.items():
             if getattr(args, k) == ap.get_default(k):
@@ -821,6 +835,157 @@ def main():
         }
         del rt_engines, rt_router
 
+    # ------------------------------------------------ disaggregated arm
+    # Prefill/decode role split over the in-process migration plane
+    # (ISSUE 14) vs a colocated engine on IDENTICAL Poisson traffic.
+    # The headline is latency attribution, not throughput: the colocated
+    # engine's decode iterations that absorb queued prefill dispatches
+    # book to serve.mixed_ms (the PR-6 tag); the decode ROLE runs clean
+    # decode steps only, so its mixed mass must be ZERO and its
+    # serve.slo token p95 is the clean-decode p95 the SLO monitor
+    # already computes.  Same alternating best-of-N discipline as the
+    # other arms (fewer passes — two full traffic simulations each).
+    disagg_payload = None
+    if args.disagg:
+        from chainermn_tpu.observability.metrics import MetricsRegistry
+        from chainermn_tpu.serving import (
+            DecodeRole,
+            LocalComm,
+            MigrationTransport,
+            PrefillRole,
+            serve_disaggregated,
+        )
+        from chainermn_tpu.serving.scheduler import _Clock
+
+        def mk_engine():
+            e = DecodeEngine(
+                model, params, capacity=args.batch,
+                num_blocks=num_blocks, block_len=args.block_len,
+                prefill_chunk=args.prefill_chunk,
+                max_blocks_per_slot=blocks_for(
+                    padded_longest, args.block_len
+                ),
+            )
+            warm_engine(e)
+            return e
+
+        co_eng, pf_eng, de_eng = mk_engine(), mk_engine(), mk_engine()
+        dz_reqs = [
+            Request(id=50_000 + i, prompt=prompts[i].tolist(),
+                    max_new_tokens=int(new_counts[i]),
+                    arrival=float(arrivals[i]))
+            for i in range(args.requests)
+        ]
+
+        def hist(reg, name):
+            inst = reg.peek(name)
+            if inst is None:
+                return {"count": 0, "sum": 0.0}
+            d = inst.to_dict()
+            return {"count": d["count"], "sum": round(d["sum"], 3)}
+
+        dz_repeats = max(1, min(2, repeats))
+        co_best = (float("inf"), None, None, None)
+        dz_best = (float("inf"), None, None, None, None)
+        for _ in range(dz_repeats):
+            co_eng.drop_prefix_cache()
+            reg_co = MetricsRegistry()
+            sched = Scheduler(co_eng, registry=reg_co)
+            cs = sched.run(dz_reqs)
+            span = (
+                max(c.finished_at for c in cs)
+                - min(c.arrival for c in cs)
+            )
+            if span < co_best[0]:
+                co_best = (span, reg_co, sched, cs)
+            pf_eng.drop_prefix_cache()
+            de_eng.drop_prefix_cache()
+            clock = _Clock()
+            comm = LocalComm(2)
+            reg_p, reg_d = MetricsRegistry(), MetricsRegistry()
+            pr = PrefillRole(
+                Scheduler(pf_eng, registry=reg_p, clock=clock),
+                MigrationTransport(comm.endpoint(0), registry=reg_p),
+                decode_ranks=[1],
+            )
+            dr = DecodeRole(
+                Scheduler(de_eng, registry=reg_d, clock=clock),
+                MigrationTransport(comm.endpoint(1), registry=reg_d),
+                prefill_ranks=[0],
+            )
+            cs2 = serve_disaggregated(pr, dr, dz_reqs)
+            span2 = (
+                max(c.finished_at for c in cs2)
+                - min(c.arrival for c in cs2)
+            )
+            if span2 < dz_best[0]:
+                dz_best = (span2, reg_p, reg_d, dr, cs2)
+        co_span, reg_co, co_sched, co_cs = co_best
+        dz_span, reg_p, reg_d, dr, dz_cs = dz_best
+
+        def slo_token_p95(sched):
+            rep = (sched.slo.last_report or {}).get("token", {})
+            v = rep.get("p95_ms")
+            return round(v, 3) if v is not None else None
+
+        co_tokens = {c.id: c.tokens for c in co_cs}
+        mism = []
+        for c in dz_cs:
+            want = co_tokens[c.id]
+            first = next(
+                (i for i, (a, b) in enumerate(zip(c.tokens, want))
+                 if a != b), None,
+            )
+            if first is None and len(c.tokens) != len(want):
+                # A truncated/overlong completion with an identical
+                # common prefix is still a divergence (zip is
+                # length-blind) — first difference is the shorter end.
+                first = min(len(c.tokens), len(want))
+            if first is not None:
+                mism.append(first)
+        mig_ms = reg_p.peek("serve.migration.migrate_ms").to_dict()
+        disagg_payload = {
+            "requests": args.requests,
+            "tokens_per_sec_disagg": round(useful_tokens / dz_span, 1),
+            "tokens_per_sec_colocated": round(useful_tokens / co_span, 1),
+            "speedup_vs_colocated": round(co_span / dz_span, 3),
+            # p95 of CLEAN decode iterations (the SLO monitor's token
+            # stream) — the acceptance headline.
+            "clean_decode_p95_ms": slo_token_p95(dr.sched),
+            "colocated_clean_decode_p95_ms": slo_token_p95(co_sched),
+            # The steal, measured: mixed-iteration mass per arm.  The
+            # decode role's must be zero — prefill interference now
+            # lives on the prefill rank.
+            "mixed_colocated": hist(reg_co, "serve.mixed_ms"),
+            "mixed_decode_role": hist(reg_d, "serve.mixed_ms"),
+            "decode_iterations_decode_role": hist(
+                reg_d, "serve.decode_ms"
+            )["count"],
+            "prefill_role_decode_iterations": hist(
+                reg_p, "serve.decode_ms"
+            )["count"],
+            "migration": {
+                "slots": reg_p.peek(
+                    "serve.migration.slots_migrated"
+                ).value,
+                "blocks": reg_p.peek(
+                    "serve.migration.blocks_moved"
+                ).value,
+                "bytes": reg_p.peek("serve.migration.bytes").value,
+                "migrate_ms_mean": round(
+                    mig_ms["sum"] / max(mig_ms["count"], 1), 4
+                ),
+                "failed": reg_p.peek("serve.migration.failed").value,
+            },
+            "decode_compiles_decode_role": de_eng.decode_compiles,
+            "greedy_agreement_vs_colocated": {
+                "requests_exact": len(dz_cs) - len(mism),
+                "requests": len(dz_cs),
+                "min_first_divergence": min(mism) if mism else None,
+            },
+        }
+        del co_eng, pf_eng, de_eng
+
     payload = {
         "metric": "serving_tokens_per_sec",
         "value": round(cont_tps, 1),
@@ -907,6 +1072,8 @@ def main():
         payload["speculative"] = spec_payload
     if router_payload is not None:
         payload["router"] = router_payload
+    if disagg_payload is not None:
+        payload["disagg"] = disagg_payload
     print(json.dumps(payload))
     if args.out:
         from chainermn_tpu.utils import atomic_json_dump

@@ -88,6 +88,7 @@ HEADLINE_SIGNALS = (
     "serve.slo.p95_drift", "serve.slo.ttft.p95_ms",
     "serve.slo.queue_wait.p95_ms", "serve.slo.token.p95_ms",
     "serve.queue_depth", "serve.slot_occupancy",
+    "serve.migration.failed",
     "fleet.straggler_rank", "fleet.straggler_stall_ms",
     "fleet.clock_rtt_ms",
     "compile.count", "compile.budget_exceeded",
@@ -244,6 +245,14 @@ def default_rules() -> List[Watch]:
                         "queue across consecutive evaluations — every "
                         "replica at its admission cap (fleet-wide "
                         "backpressure; the scale-out signal)",
+        ),
+        Watch(
+            "migration_failed", "serve.migration.failed", "> 0",
+            severity="critical",
+            description="a KV-block migration frame was dropped or torn "
+                        "on the p2p plane — the in-flight slots it "
+                        "carried are gone (disaggregated serving's "
+                        "request-loss signal)",
         ),
     ]
 

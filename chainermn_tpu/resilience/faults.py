@@ -30,12 +30,17 @@ Scoping env vars:
 Grammar: ``kind@site:arg`` where ``kind`` ∈ {crash, hang, slow, drop, nan,
 spike, flip, skew}, ``site`` is a hook-point name
 (``iter``/``barrier``/``send``/``recv``/``grad``/``loss``/``param``/``step``
-today; any identifier parses), and ``arg`` is a 1-based hit count for
+plus the serving fleet's ``serve_step`` — the scheduler's per-decode-
+iteration hook, so ``crash@serve_step:N`` kills a decode rank mid-stream
+— and ``migrate`` — the KV-migration transport, where ``drop@migrate:N``
+loses the Nth migration frame on the wire; any identifier parses), and
+``arg`` is a 1-based hit count for
 one-shot kinds (crash/hang/drop/nan/spike/flip), a duration
 (``200ms``/``1.5s``) for ``slow``, or ``N:duration`` for ``skew`` (from hit
 N on, every hit is stretched by the duration; a bare duration means
 ``1:duration``).  crash/hang/slow fire at any site; ``drop`` is
-message-shaped and honored at ``send``/``recv``; the fail-silent kinds are
+message-shaped and honored at ``send``/``recv``/``migrate``; the
+fail-silent kinds are
 value-shaped and honored by the trainer's :func:`poison_batch` (``nan``,
 ``spike``) and :func:`corrupt_params` (``flip``) helpers plus the ``step``
 hook (``skew``).

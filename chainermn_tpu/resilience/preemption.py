@@ -21,6 +21,15 @@ snapshot.  The :class:`PreemptionGuard` makes it cooperative:
 The exit travels as :class:`PreemptionInterrupt`, a ``SystemExit``
 subclass: unhandled, it exits the process with the preemption code and —
 being ``SystemExit`` — bypasses the global except hook's crash path.
+
+**Serving ranks** (ISSUE 14) convert SIGTERM into a *drain* instead of a
+checkpoint: :meth:`PreemptionGuard.attach_drain` registers a handler
+(typically :func:`chainermn_tpu.serving.disagg.drain_all` bound to a
+peer engine) and the serving loop polls
+:meth:`PreemptionGuard.poll_serving` once per tick — on preemption every
+live slot and queued entry migrates to the peer over the hostcomm p2p
+plane (zero in-flight requests lost, completions greedy-identical to an
+unpreempted run), then the rank exits 75 exactly like a trainer.
 """
 
 from __future__ import annotations
@@ -84,6 +93,7 @@ class PreemptionGuard:
         self._signal_time: Optional[float] = None
         self._prev_handlers = {}
         self._installed = False
+        self._drain = None
 
     # ------------------------------------------------------------- handlers
     def install(self) -> "PreemptionGuard":
@@ -150,24 +160,76 @@ class PreemptionGuard:
         ckpt = self.checkpointer or self._find_checkpointer(trainer)
         if ckpt is not None:
             ckpt.emergency_save(trainer)
+        self._exit_preempted(it, f"emergency checkpoint at iteration {it}")
+
+    def _exit_preempted(self, n: int, action: str) -> None:
+        """The ONE exit-75 protocol tail shared by :meth:`poll` and
+        :meth:`poll_serving` (signal-wait line, stderr notice, exit-75
+        flight record, :class:`PreemptionInterrupt`) — the action taken
+        before it (checkpoint vs drain) is the only variable part."""
         waited = (
             f" {time.monotonic() - self._signal_time:.2f}s after signal"
             if self._signal_time is not None
             else " (peer-initiated)"
         )
         sys.stderr.write(
-            f"[chainermn_tpu.resilience] preemption: emergency checkpoint "
-            f"at iteration {it}{waited}; exiting "
-            f"{PREEMPTION_EXIT_CODE}\n"
+            f"[chainermn_tpu.resilience] preemption: {action}{waited}; "
+            f"exiting {PREEMPTION_EXIT_CODE}\n"
         )
         sys.stderr.flush()
-        err = PreemptionInterrupt(it)
+        err = PreemptionInterrupt(n)
         # Exit-75 flight record BEFORE raising: a SystemExit bypasses the
         # except hook's crash snapshot (observability/flight.py).
         from chainermn_tpu.observability import flight as _oflight
 
         _oflight.snapshot_on_crash(err)
         raise err
+
+    # -------------------------------------------------------------- serving
+    def attach_drain(self, handler) -> None:
+        """Register the serving drain handler: a zero-arg callable
+        (typically :func:`chainermn_tpu.serving.disagg.drain_all` bound
+        to this rank's scheduler, transport and peer) run once, before
+        exit 75, when :meth:`poll_serving` observes the preemption.  Its
+        return value (a summary dict) lands on stderr and in the exit-75
+        flight record, so the post-mortem says what was saved."""
+        self._drain = handler
+
+    def poll_serving(self, tick: int) -> None:
+        """The serving loop's analog of :meth:`poll`: call once per
+        scheduler tick.  On preemption runs the attached drain handler
+        — live slots and queued entries migrate to the peer instead of
+        dying with this rank — then raises :class:`PreemptionInterrupt`
+        (exit 75, the launcher's always-restart-eligible code).
+
+        Serving guards should be built with ``comm=None`` (the default
+        vote is then just this rank's flag): preemption drains are
+        inherently per-rank — the scheduler SIGTERMs one host, and only
+        that host must hand its work off.  If a fleet-synchronized
+        drain is ever needed, attach a DEDICATED auxiliary comm, never
+        the migration plane's: hostcomm frames are an untagged
+        per-source FIFO, so vote traffic sharing the migration comm
+        would interleave with (and consume) migration frames, and
+        per-role tick counts are not aligned across ranks the way
+        trainer iterations are."""
+        if tick % self.check_every != 0:
+            return
+        if not self._vote():
+            return
+        action = f"serving drain at tick {tick}"
+        if self._drain is not None:
+            # Best-effort: a whole-pod preemption can take the drain
+            # peer down too — the migration (and its requests) is lost
+            # either way, but this rank's exit-code contract with the
+            # launcher (75 = preempt allowance, not a crash) must hold.
+            try:
+                action += f" — migrated {self._drain()}"
+            except Exception as e:
+                action += (
+                    f" FAILED ({type(e).__name__}: {e}) — exiting "
+                    "without migrating"
+                )
+        self._exit_preempted(tick, action)
 
     @staticmethod
     def _find_checkpointer(trainer):

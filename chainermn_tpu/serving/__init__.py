@@ -40,11 +40,29 @@ Six layers:
   least-loaded dispatch off each replica's live gauges, per-replica
   admission backpressure (zero requests lost), queued-work rebalance,
   ``serve.router.*`` metrics, and a merged fleet trace that shows one
-  request's life across replicas.
+  request's life across replicas.  Role-aware: a disaggregated fleet's
+  decode ranks take migrated slots only, never fresh admissions.
+* :mod:`~chainermn_tpu.serving.disagg` — disaggregated prefill/decode:
+  the KV-block migration primitive (live blocks + block table + carried
+  tokens shipped as framed ``send_obj`` payloads over the hostcomm p2p
+  plane, tables rewritten against the destination allocator —
+  byte-identical KV, sharing and hot prefixes survive the move), the
+  prefill/decode role loops on top of it, and preemption-aware draining
+  (SIGTERM → migrate every live slot to a peer instead of dropping
+  requests).
 
 See ``docs/serving.md`` and ``benchmarks/serving.py``.
 """
 
+from chainermn_tpu.serving.disagg import (
+    DecodeRole,
+    LocalComm,
+    MigrationError,
+    MigrationTransport,
+    PrefillRole,
+    drain_all,
+    serve_disaggregated,
+)
 from chainermn_tpu.serving.engine import DecodeEngine
 from chainermn_tpu.serving.kv_pool import (
     BlockAllocator,
@@ -68,9 +86,16 @@ __all__ = [
     "PrefixCache",
     "blocks_for",
     "DecodeEngine",
+    "DecodeRole",
+    "LocalComm",
+    "MigrationError",
+    "MigrationTransport",
+    "PrefillRole",
     "Completion",
     "Request",
     "Router",
     "Scheduler",
+    "drain_all",
+    "serve_disaggregated",
     "serving_mesh",
 ]

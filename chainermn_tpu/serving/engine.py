@@ -368,6 +368,35 @@ class DecodeEngine:
             n_accept = jnp.where(temp > 0.0, 0, n_accept)
             return pools, dpools, g, n_accept
 
+        # KV-block migration device half (serving/disagg.py): read ONE
+        # physical block's contents out of every layer of every pool
+        # (target + draft), and write one back.  Traced block index —
+        # one compiled variant each, ever, so migration churn can never
+        # threaten the decode step's one-compile contract.  The gather
+        # does NOT donate (the pools stay live for the next step); the
+        # put donates exactly like the cow copy.
+        def gather_impl(pools, dpools, idx):
+            def one(layer):
+                return {
+                    n: jax.lax.dynamic_index_in_dim(
+                        layer[n], idx, axis=1, keepdims=False
+                    )
+                    for n in layer
+                }
+
+            t = [one(p) for p in pools]
+            d = [one(p) for p in dpools] if draft_model is not None else None
+            return t, d
+
+        def put_impl(pools, dpools, idx, tdata, ddata):
+            def one(layer, data):
+                return {n: layer[n].at[:, idx].set(data[n]) for n in layer}
+
+            pools = [one(p, x) for p, x in zip(pools, tdata)]
+            if draft_model is not None:
+                dpools = [one(p, x) for p, x in zip(dpools, ddata)]
+            return pools, dpools
+
         # Copy-on-write: duplicate ONE physical block across every layer
         # of every pool (target + draft) so a borrower of a shared
         # partial block can diverge without scribbling the cached
@@ -414,6 +443,13 @@ class DecodeEngine:
         self._cow = _w.wrap(
             jax.jit(cow_impl, donate_argnums=(0, 1)),
             program="cow", budget=1,
+        )
+        self._gather = _w.wrap(
+            jax.jit(gather_impl), program="kv_gather", budget=1,
+        )
+        self._put = _w.wrap(
+            jax.jit(put_impl, donate_argnums=(0, 1)),
+            program="kv_put", budget=1,
         )
 
     # ----------------------------------------------------------- uploads
@@ -558,6 +594,51 @@ class DecodeEngine:
         allocator is back at its construction baseline afterwards."""
         return self.prefix.clear() if self.prefix is not None else 0
 
+    # ------------------------------------------------------- kv migration
+    def read_block(self, block: int) -> dict:
+        """One physical block's live KV contents as HOST numpy arrays:
+        ``{"target": [per-layer {name: (KH, block_len, Dh)}...],
+        "draft": same or None}`` — the serializable unit
+        :mod:`~chainermn_tpu.serving.disagg` ships over the hostcomm p2p
+        plane.  Pure read: the pools stay live for the next step."""
+        import jax
+
+        t, d = self._gather(
+            self.pools, self.draft_pools, np.int32(block)
+        )
+        return jax.tree_util.tree_map(np.asarray, {"target": t, "draft": d})
+
+    def write_block(self, block: int, data: dict) -> None:
+        """Install :meth:`read_block` data into physical ``block`` across
+        every layer of every pool — the destination half of a KV-block
+        migration.  Byte-preserving: the written block re-reads exactly
+        as the source's :meth:`read_block` bytes (same dtypes, same
+        layout).  A plain engine refuses draft data and vice versa —
+        migration requires role-homogeneous engine geometry."""
+        if (data.get("draft") is not None) != (self.draft_model is not None):
+            raise ValueError(
+                "migration payload draft pools do not match this engine "
+                f"(payload draft={data.get('draft') is not None}, engine "
+                f"draft={self.draft_model is not None}) — prefill and "
+                "decode roles must run the same engine construction"
+            )
+        self.pools, self.draft_pools = self._put(
+            self.pools, self.draft_pools, np.int32(block),
+            data["target"], data["draft"],
+        )
+
+    def sync(self) -> None:
+        """Block until every dispatched program against the KV pools has
+        retired (``kv_put`` installs included).  Migration installers
+        call this so the NEXT decode step's token readback cannot absorb
+        install work into its timed window — ``serve.decode_ms`` stays
+        pure decode."""
+        import jax
+
+        jax.block_until_ready(self.pools)
+        if self.draft_pools is not None:
+            jax.block_until_ready(self.draft_pools)
+
     # ------------------------------------------------------- introspection
     @property
     def hot_program(self):
@@ -589,6 +670,16 @@ class DecodeEngine:
     def cow_compiles(self) -> int:
         """Copy-on-write block-copy variants (must stay <= 1)."""
         return int(self._cow._cache_size())
+
+    @property
+    def gather_compiles(self) -> int:
+        """KV-block gather variants (migration export; must stay <= 1)."""
+        return int(self._gather._cache_size())
+
+    @property
+    def put_compiles(self) -> int:
+        """KV-block put variants (migration import; must stay <= 1)."""
+        return int(self._put._cache_size())
 
     @property
     def prefill_compiles(self) -> int:
@@ -625,7 +716,8 @@ class DecodeEngine:
         # per-program ledger + blame diffs.
         over = [
             getattr(p, "program", "?")
-            for p in (self._step, self._prefill, self._spec, self._cow)
+            for p in (self._step, self._prefill, self._spec, self._cow,
+                      self._gather, self._put)
             if p is not None and getattr(p, "over_budget", False)
         ]
         if over:

@@ -78,12 +78,22 @@ class Router:
         ``2 × capacity``.
       rebalance: steal queued work from a blocked replica for an idle
         one.  Default ``CMN_ROUTER_REBALANCE`` (on).
+      roles: optional per-replica role (``"mixed"`` | ``"prefill"`` |
+        ``"decode"``, default all mixed) — the disaggregated fleet's
+        dispatch rule (ISSUE 14): fresh requests go only to admitting
+        replicas (mixed/prefill), and rebalance steals only between
+        them; ``"decode"`` replicas take migrated slots through the
+        :mod:`~chainermn_tpu.serving.disagg` plane, never the router
+        queue.  Resolve a launch-wide spec with
+        :func:`~chainermn_tpu.serving.disagg.roles_from_env`
+        (``CMN_DISAGG_ROLES``).
     """
 
     def __init__(self, engines: Sequence, registry=None,
                  clock: Optional[_Clock] = None,
                  max_queue: Optional[int] = None,
-                 rebalance: Optional[bool] = None):
+                 rebalance: Optional[bool] = None,
+                 roles: Optional[Sequence[str]] = None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability.metrics import (
             DEFAULT_MS_EDGES,
@@ -97,6 +107,28 @@ class Router:
         engines = list(engines)
         if not engines:
             raise ValueError("router needs at least one engine")
+        if roles is None:
+            roles = ["mixed"] * len(engines)
+        roles = [str(r) for r in roles]
+        if len(roles) != len(engines):
+            raise ValueError(
+                f"roles ({len(roles)}) must match engines ({len(engines)})"
+            )
+        from chainermn_tpu.serving.disagg import ROLES as _ROLES
+
+        for r in roles:
+            if r not in _ROLES:
+                raise ValueError(f"unknown role {r!r} (one of {_ROLES})")
+        if all(r == "decode" for r in roles):
+            raise ValueError(
+                "every replica is decode-role — nobody can admit; a "
+                "disaggregated fleet needs >= 1 mixed/prefill replica"
+            )
+        self.roles = roles
+        #: replica indices fresh requests may be dispatched to.
+        self._admitting = [
+            i for i, r in enumerate(roles) if r != "decode"
+        ]
         self.clock = clock or _Clock()
         #: per-replica span rings: each replica is one "rank" in the
         #: merged fleet trace (the timeline mirrors every lifecycle
@@ -179,8 +211,8 @@ class Router:
 
     def submit(self, req: Request) -> None:
         """Accept a request into the router queue (validated against
-        replica 0's geometry — homogeneous replicas)."""
-        self.schedulers[0].check_fit(req)
+        one admitting replica's geometry — homogeneous replicas)."""
+        self.schedulers[self._admitting[0]].check_fit(req)
         self._queue.append(req)
 
     def _gauge(self, i: int, name: str):
@@ -209,10 +241,12 @@ class Router:
         return (occ * cap + qd) / cap + 0.1 * kv
 
     def _pick_replica(self) -> Optional[int]:
-        """Least-loaded replica with admission headroom, or ``None``
-        when every replica is at ``max_queue`` (backpressure)."""
+        """Least-loaded ADMITTING replica (decode-role replicas take
+        migrated slots, never fresh requests) with admission headroom,
+        or ``None`` when every one is at ``max_queue`` (backpressure)."""
         best, best_load = None, None
-        for i, s in enumerate(self.schedulers):
+        for i in self._admitting:
+            s = self.schedulers[i]
             # queue_depth is LIVE (submit appends immediately), so it
             # already counts this tick's dispatches — _since_gauge is
             # only for correcting the stale gauges in _load.
@@ -254,16 +288,22 @@ class Router:
         busy for a replica with a free slot and an empty queue."""
         if not self.rebalance:
             return False
+        # Role discipline holds under rebalance too: a decode replica's
+        # free slots belong to the migration plane, and its queue (if a
+        # drain ever filled one) is recompute work another decode
+        # replica could not prefill faster anyway.
         idle = [
-            i for i, s in enumerate(self.schedulers)
-            if s.has_free_slot and s.queue_depth == 0
+            i for i in self._admitting
+            if self.schedulers[i].has_free_slot
+            and self.schedulers[i].queue_depth == 0
         ]
         if not idle:
             return False
         donors = sorted(
             (
-                i for i, s in enumerate(self.schedulers)
-                if s.queue_depth > 0 and not s.has_free_slot
+                i for i in self._admitting
+                if self.schedulers[i].queue_depth > 0
+                and not self.schedulers[i].has_free_slot
             ),
             key=lambda i: -self.schedulers[i].queue_depth,
         )
@@ -362,6 +402,7 @@ class Router:
         for i, s in enumerate(self.schedulers):
             out.append({
                 "replica": i,
+                "role": self.roles[i],
                 "dispatched": sum(
                     1 for reps in self.assignments.values()
                     if reps and reps[0] == i

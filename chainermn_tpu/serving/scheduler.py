@@ -258,7 +258,7 @@ class Scheduler:
             self._m_prefill = self._m_decode = self._m_mixed = noop
             self._m_px_lookups = self._m_px_hit = self._m_px_rate = noop
             self._m_px_cached = self._m_px_cow = noop
-            self._m_px_evicted = noop
+            self._m_px_evicted = self._m_mig_install = noop
             self._m_spec_prop = self._m_spec_acc = noop
             self._m_spec_rate = noop
             reg = None
@@ -282,6 +282,9 @@ class Scheduler:
             self._m_px_cached = reg.gauge("serve.prefix.cached_blocks")
             self._m_px_cow = reg.counter("serve.prefix.cow_copies")
             self._m_px_evicted = reg.counter("serve.prefix.evicted_blocks")
+            self._m_mig_install = reg.histogram(
+                "serve.migration.install_ms", edges=DEFAULT_MS_EDGES
+            )
             self._m_spec_prop = reg.counter("serve.spec.proposed")
             self._m_spec_acc = reg.counter("serve.spec.accepted")
             self._m_spec_rate = reg.gauge("serve.spec.accept_rate")

@@ -1,0 +1,148 @@
+"""Worker for the disaggregated-serving preemption-drain acceptance test.
+
+Two real OS ranks over the native hostcomm mesh:
+
+* rank 0 serves a deterministic request stream through a colocated
+  scheduler with a :class:`PreemptionGuard` installed and a drain
+  handler attached (``drain_all`` → rank 1).  Mid-run it SIGTERMs
+  itself — the real signal through the real handler — so the guard's
+  next ``poll_serving`` migrates every live slot (KV) and queued entry
+  to rank 1 and exits 75.  Before exiting it writes its completions and
+  waits for rank 1's done-ack, so the launcher's teardown cannot kill
+  the peer mid-drain (the real fleet's grace window).
+* rank 1 runs a :class:`DecodeRole` loop until rank 0's eof and the last
+  migrated slot retires, then writes its completions PLUS the
+  greedy oracle (``lm_generate``) for every request id.
+
+The test unions both completion files: zero in-flight requests lost,
+every completion greedy-identical to the unpreempted oracle.
+
+A relaunch attempt (``CMN_LAUNCH_ATTEMPT > 0`` — the supervisor absorbs
+the preemption exit) has nothing left to serve and exits 0 immediately.
+"""
+
+import json
+import os
+import signal
+import sys
+
+TMP = os.environ["CMN_TEST_TMP"]
+ATTEMPT = os.environ.get("CMN_LAUNCH_ATTEMPT", "0")
+
+N_REQS = 8
+MAX_NEW = 8
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.serving import DecodeEngine
+
+    model = TransformerLM(
+        vocab=128, n_layers=2, d_model=64, n_heads=4, d_ff=128,
+        max_len=96, dtype=jnp.float32, n_kv_heads=2, pos_enc="rope",
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 12), jnp.int32)
+    )["params"]
+    eng = DecodeEngine(
+        model, params, capacity=3, num_blocks=48, block_len=8,
+        prefill_chunk=16,
+    )
+    rng = np.random.RandomState(5)
+    prompts = [
+        rng.randint(1, 128, size=int(n)).tolist()
+        for n in rng.randint(4, 20, size=N_REQS)
+    ]
+    return model, params, eng, prompts
+
+
+def main() -> None:
+    if ATTEMPT != "0":
+        # Relaunch after the absorbed preemption: the stream was fully
+        # drained to the peer on attempt 0 — nothing to do.
+        print(json.dumps({"attempt": ATTEMPT, "noop": True}))
+        return
+    from chainermn_tpu.hostcomm import HostComm
+    from chainermn_tpu.serving import (
+        DecodeRole,
+        MigrationTransport,
+        Request,
+        Scheduler,
+        drain_all,
+    )
+
+    rank = int(os.environ["CMN_TPU_RANK"])
+    comm = HostComm(timeout_ms=30000)
+    model, params, eng, prompts = _build()
+    transport = MigrationTransport(comm)
+
+    if rank == 0:
+        from chainermn_tpu.resilience.preemption import (
+            PreemptionGuard,
+            PreemptionInterrupt,
+        )
+
+        sched = Scheduler(eng)
+        for i, p in enumerate(prompts):
+            sched.submit(Request(id=i, prompt=p, max_new_tokens=MAX_NEW))
+        guard = PreemptionGuard().install()
+        guard.attach_drain(lambda: drain_all(sched, transport, dest=1))
+        ticks = 0
+        try:
+            while sched.pending:
+                ticks += 1
+                if ticks == 4:
+                    # The TPU scheduler's reclaim warning, self-inflicted
+                    # mid-stream: live slots AND a queue remain.
+                    os.kill(os.getpid(), signal.SIGTERM)
+                guard.poll_serving(ticks)
+                sched.tick()
+            raise RuntimeError("drained everything before the SIGTERM")
+        except PreemptionInterrupt:
+            with open(os.path.join(TMP, "verdict_0.json"), "w") as f:
+                json.dump({
+                    "preempt_tick": ticks,
+                    "completions": {
+                        str(c.id): c.tokens for c in sched.completions
+                    },
+                }, f)
+            # Grace window: hold exit 75 until the peer confirms the
+            # drained stream fully retired (launcher teardown follows
+            # our exit).
+            comm.recv_obj(1, timeout_ms=240000, op="drain_ack")
+            comm.close()
+            raise
+    else:
+        from chainermn_tpu.models import lm_generate
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        role = DecodeRole(
+            Scheduler(eng), transport, prefill_ranks=[0],
+        )
+        completions = role.run_loop(poll_ms=100)
+        oracle = {}
+        for i, p in enumerate(prompts):
+            pr = jnp.asarray(np.asarray(p, np.int32))[None]
+            oracle[str(i)] = np.asarray(
+                lm_generate(model, params, pr, MAX_NEW)
+            )[0].tolist()
+        with open(os.path.join(TMP, "verdict_1.json"), "w") as f:
+            json.dump({
+                "completions": {
+                    str(c.id): c.tokens for c in completions
+                },
+                "oracle": oracle,
+            }, f)
+        comm.send_obj("done", 0, op="drain_ack")
+        comm.close()
+        print(json.dumps({"rank": 1, "served": len(completions)}))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
