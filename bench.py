@@ -258,6 +258,18 @@ def _serving_headline() -> dict | None:
             "disagg_mixed_decode_role": rec.get(
                 "disagg", {}
             ).get("mixed_decode_role", {}).get("count"),
+            # Chaos arm (ISSUE 15), when the artifact carries it: the
+            # terminal-invariant verdict under the seeded fault
+            # schedule plus the failure plane's counter envelope.
+            "chaos_invariant_holds": rec.get(
+                "chaos", {}
+            ).get("invariant_holds"),
+            "chaos_recovered": rec.get("chaos", {}).get("recovered"),
+            "chaos_poisoned": rec.get("chaos", {}).get("poisoned"),
+            "chaos_shed": rec.get("chaos", {}).get("shed"),
+            "chaos_replica_dead": rec.get(
+                "chaos", {}
+            ).get("replica_dead"),
         }
 
     return _best_result("serving*.json", cands)
@@ -386,6 +398,16 @@ def _summary_line(payload: dict, lm=None, dec=None, srv=None,
     if srv is not None and \
             srv.get("disagg_clean_decode_p95_ms") is not None:
         summary["disagg_decode_p95_ms"] = srv["disagg_clean_decode_p95_ms"]
+    # Chaos-arm pointer (ISSUE 15): the failure plane's verdict +
+    # recovered/poisoned/shed counts, present only when the serving
+    # artifact carries the chaos arm.
+    if srv is not None and srv.get("chaos_invariant_holds") is not None:
+        summary["chaos"] = {
+            "invariant_holds": srv["chaos_invariant_holds"],
+            "recovered": srv.get("chaos_recovered"),
+            "poisoned": srv.get("chaos_poisoned"),
+            "shed": srv.get("chaos_shed"),
+        }
     # Artifact POINTERS, not payloads: the full headline dicts ride the
     # composite line above; the tail line names where each number came
     # from so a consumer can open the file.
@@ -449,7 +471,7 @@ def _fit_summary(summary: dict) -> dict:
         return summary
     if isinstance(summary.get("error"), str):
         summary["error"] = summary["error"][:80]
-    for k in ("incident_newest", "serving_tpu_probe",
+    for k in ("incident_newest", "serving_tpu_probe", "chaos",
               "router_tokens_per_sec", "cache_source_commit",
               "serving_artifact", "decode_artifact", "lm_artifact",
               "cache_age_hours", "incident_count", "perf_sentinel",

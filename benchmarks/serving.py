@@ -49,6 +49,14 @@ plane vs a colocated engine under identical traffic — p95 clean-decode
 latency, the ``serve.mixed_ms`` mass shifted off the decode role (it
 must be zero there), and the migration cost envelope.
 
+``--chaos`` (ISSUE 15) drives the failure plane: a 3-replica router
+under a seeded randomized fault schedule (``crash@serve_step`` replica
+deaths mid-stream, ``skew@serve_step`` fail-slow, ``drop@migrate``
+recovery-frame loss) with dead replicas revived behind the probation
+circuit breaker — reports the terminal-invariant verdict (every
+submitted request terminates exactly once) and the ``serve.health.*``
+counters (replica_dead / recovered / poisoned / shed).
+
     python benchmarks/serving.py --out result/serving_tpu.json  # real chip
     JAX_PLATFORMS=cpu python benchmarks/serving.py --smoke      # plumbing
 """
@@ -165,6 +173,13 @@ def main():
                          "identical Poisson traffic; reports p95 "
                          "clean-decode latency and the serve.mixed_ms "
                          "mass shifted off the decode role")
+    ap.add_argument("--chaos", action="store_true",
+                    help="also run the CHAOS arm (ISSUE 15): a "
+                         "3-replica router under a seeded fault "
+                         "schedule (crash/skew@serve_step + "
+                         "drop@migrate) with probation revivals; "
+                         "reports the terminal-invariant verdict and "
+                         "the serve.health.* counters")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--trace-out", default=None,
@@ -209,7 +224,7 @@ def main():
             new_min=4, new_max=64, layers=4, d_model=512, heads=8,
             d_ff=1024, vocab=4096, block_len=8, prefill_chunk=16,
             repeats=4, obs_pairs=12, prefix_reuse=4, spec_k=3,
-            draft_layers=1, replicas=2, disagg=True,
+            draft_layers=1, replicas=2, disagg=True, chaos=True,
         )
         for k, v in smoke_over.items():
             if getattr(args, k) == ap.get_default(k):
@@ -986,6 +1001,93 @@ def main():
         }
         del co_eng, pf_eng, de_eng
 
+    # ------------------------------------------------------- chaos arm
+    # The failure plane under fire (ISSUE 15): a 3-replica router
+    # driven by the seeded ChaosHarness — replicas crash mid-stream and
+    # run fail-slow per the schedule, recovery re-dispatch frames drop
+    # on the wire, dead replicas revive behind the probation circuit
+    # breaker, and load shedding is armed.  The headline is not
+    # throughput (replica deaths + revival recomputes make the makespan
+    # a function of the schedule): it is the terminal invariant —
+    # every submitted request terminates exactly once with a definite
+    # status — plus the serve.health.* counter envelope.
+    chaos_payload = None
+    if args.chaos:
+        from chainermn_tpu.observability.metrics import MetricsRegistry
+        from chainermn_tpu.serving import ChaosHarness
+
+        def chaos_engine():
+            e = DecodeEngine(
+                model, params, capacity=args.batch,
+                num_blocks=num_blocks, block_len=args.block_len,
+                prefill_chunk=args.prefill_chunk,
+                max_blocks_per_slot=blocks_for(
+                    padded_longest, args.block_len
+                ),
+            )
+            warm_engine(e)
+            return e
+
+        cz_reg = MetricsRegistry()
+        harness = ChaosHarness(
+            chaos_engine, replicas=3, seed=args.seed,
+            registry=cz_reg, revive_after=4, max_revives=2,
+            shed_depth=4 * args.batch,
+        )
+        cz_n = min(args.requests, 32)
+        cz_reqs = [
+            Request(id=60_000 + i, prompt=prompts[i].tolist(),
+                    max_new_tokens=int(new_counts[i]),
+                    arrival=float(arrivals[i]))
+            for i in range(cz_n)
+        ]
+        t0 = time.perf_counter()
+        report = harness.run(cz_reqs)
+        cz_wall = time.perf_counter() - t0
+
+        def cz_cnt(name):
+            inst = cz_reg.peek(name)
+            return inst.value if inst is not None else 0
+
+        router = harness.router
+        ok_tokens = sum(
+            len(c.tokens) for c in router.completions
+            if c.status == "ok"
+        )
+        chaos_payload = {
+            "replicas": 3,
+            "seed": args.seed,
+            "requests": cz_n,
+            "schedule": harness.schedule,
+            "invariant_holds": report["holds"],
+            "by_status": report["by_status"],
+            "lost": report["lost"],
+            "duplicated": report["duplicated"],
+            "replica_dead": cz_cnt("serve.health.replica_dead"),
+            "recovered": cz_cnt("serve.health.recovered"),
+            "retries": cz_cnt("serve.health.retries"),
+            "poisoned": cz_cnt("serve.health.poisoned"),
+            "shed": cz_cnt("serve.health.shed"),
+            "deadline_cancels": sum(
+                int(reg.peek("serve.health.deadline_cancels").value)
+                if reg.peek("serve.health.deadline_cancels") is not None
+                else 0
+                for reg in router.replica_registries
+            ),
+            "revived": report["revived"],
+            "health": report["health"],
+            "wall_s": round(cz_wall, 3),
+            "ok_tokens": ok_tokens,
+            # One-compile contract on every replica whose tick loop
+            # still runs and that actually decoded.
+            "decode_compiles_up_replicas": [
+                s.engine.decode_compiles
+                for i, s in enumerate(router.schedulers)
+                if router.health.is_up(i) and s._iterations
+            ],
+        }
+        del harness, router
+
     payload = {
         "metric": "serving_tokens_per_sec",
         "value": round(cont_tps, 1),
@@ -1074,6 +1176,8 @@ def main():
         payload["router"] = router_payload
     if disagg_payload is not None:
         payload["disagg"] = disagg_payload
+    if chaos_payload is not None:
+        payload["chaos"] = chaos_payload
     print(json.dumps(payload))
     if args.out:
         from chainermn_tpu.utils import atomic_json_dump

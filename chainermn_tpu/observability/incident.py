@@ -254,6 +254,22 @@ def default_rules() -> List[Watch]:
                         "carried are gone (disaggregated serving's "
                         "request-loss signal)",
         ),
+        Watch(
+            "replica_dead", "serve.health.replica_dead", "> 0",
+            severity="critical", key_by_value=True,
+            description="a serving replica's tick escaped the router's "
+                        "fault boundary — the fleet lost capacity and "
+                        "its work was harvested onto survivors "
+                        "(key_by_value: each additional death files)",
+        ),
+        Watch(
+            "poison_request", "serve.health.poisoned", "> 0",
+            severity="critical", key_by_value=True,
+            description="a request exhausted its retry budget killing "
+                        "replicas and was quarantined as a poisoned "
+                        "Completion instead of re-dispatched forever "
+                        "(key_by_value: each quarantine files)",
+        ),
     ]
 
 

@@ -42,6 +42,12 @@ Six layers:
   ``serve.router.*`` metrics, and a merged fleet trace that shows one
   request's life across replicas.  Role-aware: a disaggregated fleet's
   decode ranks take migrated slots only, never fresh admissions.
+* :mod:`~chainermn_tpu.serving.recovery` — the serving-fleet failure
+  plane: the router's per-replica fault boundary state (live /
+  probation / dead), retry budgets with poison quarantine, per-request
+  deadlines + router load shedding, the ``serve.health.*`` metric
+  family, and the seeded chaos harness that proves the terminal
+  invariant (every submitted request terminates exactly once).
 * :mod:`~chainermn_tpu.serving.disagg` — disaggregated prefill/decode:
   the KV-block migration primitive (live blocks + block table + carried
   tokens shipped as framed ``send_obj`` payloads over the hostcomm p2p
@@ -71,6 +77,12 @@ from chainermn_tpu.serving.kv_pool import (
     blocks_for,
 )
 from chainermn_tpu.serving.prefix_cache import PrefixCache
+from chainermn_tpu.serving.recovery import (
+    ChaosHarness,
+    FleetHealth,
+    chaos_schedule,
+    verify_terminal_invariant,
+)
 from chainermn_tpu.serving.router import Router
 from chainermn_tpu.serving.scheduler import (
     Completion,
@@ -91,11 +103,15 @@ __all__ = [
     "MigrationError",
     "MigrationTransport",
     "PrefillRole",
+    "ChaosHarness",
     "Completion",
+    "FleetHealth",
     "Request",
     "Router",
     "Scheduler",
+    "chaos_schedule",
     "drain_all",
     "serve_disaggregated",
     "serving_mesh",
+    "verify_terminal_invariant",
 ]

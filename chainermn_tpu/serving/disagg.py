@@ -167,6 +167,7 @@ def _pack_entry(entry: _QueueEntry) -> dict:
             "max_new_tokens": r.max_new_tokens,
             "temperature": r.temperature, "eos_token": r.eos_token,
             "arrival": r.arrival, "seed": r.seed,
+            "deadline_ms": r.deadline_ms,
         },
         "carried": list(entry.carried),
         "evictions": entry.evictions,
@@ -174,6 +175,7 @@ def _pack_entry(entry: _QueueEntry) -> dict:
         "prefix_hit_tokens": entry.prefix_hit_tokens,
         "spec_proposed": entry.spec_proposed,
         "spec_accepted": entry.spec_accepted,
+        "retries": entry.retries,
     }
 
 
@@ -186,6 +188,9 @@ def _unpack_entry(rec: dict) -> _QueueEntry:
         prefix_hit_tokens=rec["prefix_hit_tokens"],
         spec_proposed=rec["spec_proposed"],
         spec_accepted=rec["spec_accepted"],
+        # .get(): a cmn-kvmig-1 frame from a pre-ISSUE-15 sender still
+        # installs (additive schema change).
+        retries=rec.get("retries", 0),
     )
 
 

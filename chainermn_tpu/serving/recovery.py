@@ -1,0 +1,416 @@
+"""Serving-fleet failure plane: fault isolation, retry budgets, chaos.
+
+ChainerMN inherits MPI's fail-stop model — one dead rank kills the job —
+and the resilience package rebuilt the *training* side of that story
+(detector, guard, rollback, preemption).  This module is the *serving*
+side (ISSUE 15): a production fleet must survive replica death, runaway
+requests, and overload without dropping work on the floor.
+
+Four mechanisms, all host-side (no device state is ever trusted after a
+failure — recovery is recompute, the same discipline as eviction):
+
+* **Fault-isolated replicas** — the :class:`~chainermn_tpu.serving.
+  router.Router` wraps each replica's ``tick()`` in a fault boundary;
+  an escaping exception (real, or ``crash@serve_step``-injected) marks
+  the replica **dead** instead of aborting the fleet.  The router then
+  *harvests* the dead replica's queued entries and live slots into
+  recompute ``_QueueEntry`` s (carried + generated tokens preserved —
+  exactly the eviction-requeue discipline, so continuations are
+  greedy-identical) and re-dispatches them to survivors.  Nothing is
+  lost; survivors never recompile (``decode_compiles`` stays 1).
+
+* **Retry budgets + poison quarantine** — every harvested entry's
+  ``retries`` count increments with the replica it just killed.  A
+  request that has killed :data:`retry_budget` replicas
+  (``CMN_SERVE_RETRY_BUDGET``, default 2) is the likely *cause*, not a
+  victim: it is quarantined as a failed
+  :class:`~chainermn_tpu.serving.scheduler.Completion` with
+  ``status="poisoned"`` and the attributed error, instead of being
+  re-dispatched until it kills the whole fleet.  Quarantine files a
+  critical ``poison_request`` incident bundle.
+
+* **Probation (circuit breaker)** — :meth:`Router.revive_replica`
+  re-registers a replacement engine behind a circuit breaker: the
+  revived replica takes only *fresh* admissions at reduced dispatch
+  weight (never recovered work, never rebalance steals) until
+  ``CMN_SERVE_PROBATION_TICKS`` clean ticks pass, so a flapping replica
+  cannot thrash the fleet with repeated harvest storms.
+
+* **Graceful degradation** — per-request ``deadline_ms`` (the scheduler
+  cancels over-deadline slots and frees their blocks,
+  ``status="deadline"``) and router-level load shedding: when surviving
+  capacity leaves the holdback queue deeper than
+  ``CMN_ROUTER_SHED_DEPTH`` arrived requests, the newest are refused
+  with ``status="shed"`` — a bounded queue instead of unbounded latency
+  collapse.  Both are *terminal* outcomes: a degraded request still
+  terminates exactly once, with a definite status.
+
+Everything is observable as the ``serve.health.*`` metric family, and
+``replica_dead`` / ``poison_request`` ship as default incident rules
+(both critical — see :func:`chainermn_tpu.observability.incident.
+default_rules`).
+
+The **chaos harness** proves the plane: :class:`ChaosHarness` drives a
+multi-replica router under a seeded randomized fault schedule over the
+existing sites (``crash@serve_step``, ``skew@serve_step`` on replicas;
+``drop@migrate`` on the router's recovery re-dispatch path), revives
+dead replicas after a configurable cooldown, and checks the **terminal
+invariant** request by request with :func:`verify_terminal_invariant`:
+every submitted request terminates exactly once (completed, poisoned,
+shed, or deadline), zero lost, zero duplicated.  See
+``tests/serving_tests/test_chaos.py`` and ``benchmarks/serving.py
+--chaos``.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional, Sequence
+
+from chainermn_tpu.observability.metrics import (
+    NoopInstrument as _NoopInstrument,
+    _env_float,
+)
+
+#: Replica lifecycle states (see :class:`FleetHealth`).
+STATES = ("live", "probation", "dead")
+
+
+# ----------------------------------------------------------- env knobs
+def retry_budget_from_env() -> int:
+    """``CMN_SERVE_RETRY_BUDGET`` — how many replicas one request may
+    kill before it is quarantined as poisoned (default 2)."""
+    return max(1, int(_env_float("CMN_SERVE_RETRY_BUDGET", 2)))
+
+
+def probation_ticks_from_env() -> int:
+    """``CMN_SERVE_PROBATION_TICKS`` — clean ticks a revived replica
+    serves at reduced weight before rejoining at full trust
+    (default 32)."""
+    return max(1, int(_env_float("CMN_SERVE_PROBATION_TICKS", 32)))
+
+
+def shed_depth_from_env() -> int:
+    """``CMN_ROUTER_SHED_DEPTH`` — arrived requests the router holds
+    back before shedding the newest (0, the default, disables
+    shedding: the holdback queue is unbounded, the pre-ISSUE-15
+    behavior)."""
+    return max(0, int(_env_float("CMN_ROUTER_SHED_DEPTH", 0)))
+
+
+def deadline_ms_from_env() -> Optional[float]:
+    """``CMN_SERVE_DEADLINE_MS`` — fleet-wide default per-request
+    deadline applied to requests that carry none of their own (unset or
+    ``0`` = no default deadline)."""
+    v = _env_float("CMN_SERVE_DEADLINE_MS", 0.0)
+    return v if v > 0 else None
+
+
+# ---------------------------------------------------------- FleetHealth
+class FleetHealth:
+    """Per-replica state machine + the ``serve.health.*`` instruments.
+
+    Owned by the :class:`~chainermn_tpu.serving.router.Router`; the
+    scheduler-side member of the family (``serve.health.
+    deadline_cancels``) publishes from the scheduler because deadlines
+    are enforced there.
+
+    States: ``live`` → (tick raised) → ``dead`` → (revive) →
+    ``probation`` → (:data:`probation_ticks` clean ticks) → ``live``.
+    A probation replica that raises goes straight back to ``dead`` —
+    the circuit breaker re-opens.
+    """
+
+    def __init__(self, n: int, registry=None,
+                 retry_budget: Optional[int] = None,
+                 probation_ticks: Optional[int] = None):
+        self.retry_budget = (
+            retry_budget if retry_budget is not None
+            else retry_budget_from_env()
+        )
+        if self.retry_budget < 1:
+            raise ValueError(
+                f"retry_budget must be >= 1, got {self.retry_budget}"
+            )
+        self.probation_ticks = (
+            probation_ticks if probation_ticks is not None
+            else probation_ticks_from_env()
+        )
+        self._state = ["live"] * n
+        self._probation_left = [0] * n
+        #: last attributed error per replica (None while healthy).
+        self.errors: List[Optional[str]] = [None] * n
+        if registry is None:
+            noop = _NoopInstrument()
+            self.m_dead = self.m_recovered = self.m_retries = noop
+            self.m_poisoned = self.m_shed = self.m_probation = noop
+        else:
+            self.m_dead = registry.counter("serve.health.replica_dead")
+            self.m_recovered = registry.counter("serve.health.recovered")
+            self.m_retries = registry.counter("serve.health.retries")
+            self.m_poisoned = registry.counter("serve.health.poisoned")
+            self.m_shed = registry.counter("serve.health.shed")
+            self.m_probation = registry.gauge("serve.health.probation")
+
+    # ------------------------------------------------------------ state
+    def state(self, i: int) -> str:
+        return self._state[i]
+
+    def is_up(self, i: int) -> bool:
+        """Not dead: the replica's tick loop still runs."""
+        return self._state[i] != "dead"
+
+    def in_probation(self, i: int) -> bool:
+        return self._state[i] == "probation"
+
+    @property
+    def dead_replicas(self) -> List[int]:
+        return [i for i, s in enumerate(self._state) if s == "dead"]
+
+    # ------------------------------------------------------ transitions
+    def mark_dead(self, i: int, error: str) -> None:
+        self._state[i] = "dead"
+        self.errors[i] = error
+        self._probation_left[i] = 0
+        self.m_dead.inc()
+        self._gauge_probation()
+
+    def start_probation(self, i: int) -> None:
+        if self._state[i] != "dead":
+            raise ValueError(
+                f"replica {i} is {self._state[i]!r}, not dead — only a "
+                "dead replica can be revived into probation"
+            )
+        self._state[i] = "probation"
+        self._probation_left[i] = self.probation_ticks
+        self.errors[i] = None
+        self._gauge_probation()
+
+    def clean_tick(self, i: int) -> bool:
+        """One tick survived without an escaping exception.  Returns
+        True when this tick GRADUATED the replica out of probation."""
+        if self._state[i] != "probation":
+            return False
+        self._probation_left[i] -= 1
+        if self._probation_left[i] > 0:
+            return False
+        self._state[i] = "live"
+        self._gauge_probation()
+        return True
+
+    def _gauge_probation(self) -> None:
+        self.m_probation.set(
+            sum(1 for s in self._state if s == "probation")
+        )
+
+    def snapshot(self) -> List[dict]:
+        return [
+            {
+                "replica": i,
+                "state": s,
+                "probation_left": self._probation_left[i],
+                "error": self.errors[i],
+            }
+            for i, s in enumerate(self._state)
+        ]
+
+
+# -------------------------------------------------- terminal invariant
+def verify_terminal_invariant(requests: Sequence,
+                              completions: Sequence) -> dict:
+    """The chaos harness's oracle: every submitted request terminates
+    EXACTLY once with a definite status — zero lost, zero duplicated.
+
+    Returns a report dict; ``report["holds"]`` is the verdict and the
+    rest names the evidence (per-status counts, lost/duplicated ids).
+    """
+    want = {r.id for r in requests}
+    seen: dict = {}
+    for c in completions:
+        seen[c.id] = seen.get(c.id, 0) + 1
+    by_status: dict = {"ok": 0, "poisoned": 0, "shed": 0, "deadline": 0}
+    for c in completions:
+        by_status[c.status] = by_status.get(c.status, 0) + 1
+    lost = sorted(want - set(seen))
+    duplicated = sorted(i for i, n in seen.items() if n > 1)
+    unknown = sorted(set(seen) - want)
+    return {
+        "submitted": len(want),
+        "terminated": len(seen),
+        "by_status": by_status,
+        "lost": lost,
+        "duplicated": duplicated,
+        "unknown": unknown,
+        "holds": not lost and not duplicated and not unknown,
+    }
+
+
+# -------------------------------------------------------- chaos harness
+def chaos_schedule(seed: int, replicas: int, *,
+                   crash_iters: Sequence[int] = (3, 9, 17, 29),
+                   crash_p: float = 0.75, skew_p: float = 0.5,
+                   skew_ms: int = 5, drops: int = 1) -> dict:
+    """A seeded randomized fault schedule over the existing fault sites.
+
+    Per replica, independently: with probability ``crash_p`` a
+    ``crash@serve_step:N`` (N drawn from ``crash_iters`` — the replica
+    dies mid-stream at decode iteration N) and with probability
+    ``skew_p`` a ``skew@serve_step:N:ms`` (fail-slow from iteration N).
+    Router-level: ``drops`` one-shot ``drop@migrate`` specs — recovery
+    re-dispatch frames lost on the wire, detected immediately and
+    retried (see ``Router._redispatch``).
+
+    Same seed → same schedule: the chaos battery is reproducible.
+    Returns ``{"seed", "replica_faults": [spec-or-None per replica],
+    "router_faults": spec-or-None}`` — spec strings in the
+    ``CMN_FAULT`` grammar, buildable with
+    :func:`~chainermn_tpu.resilience.faults.parse_fault_spec`.
+    """
+    rng = random.Random(seed)
+    per_replica: List[Optional[str]] = []
+    for _ in range(replicas):
+        parts = []
+        if rng.random() < crash_p:
+            parts.append(f"crash@serve_step:{rng.choice(crash_iters)}")
+        if rng.random() < skew_p:
+            parts.append(
+                f"skew@serve_step:{rng.randint(1, 8)}:{skew_ms}ms"
+            )
+        per_replica.append(";".join(parts) or None)
+    if all(p is None or "crash" not in p for p in per_replica):
+        # A chaos run with zero crashes proves nothing — force one on a
+        # seeded replica (still deterministic per seed).
+        victim = rng.randrange(replicas)
+        extra = f"crash@serve_step:{rng.choice(crash_iters)}"
+        per_replica[victim] = (
+            extra if per_replica[victim] is None
+            else per_replica[victim] + ";" + extra
+        )
+    router_faults = ";".join(
+        f"drop@migrate:{rng.randint(1, 3) + 2 * k}"
+        for k in range(max(0, drops))
+    ) or None
+    return {
+        "seed": seed,
+        "replica_faults": per_replica,
+        "router_faults": router_faults,
+    }
+
+
+class ChaosHarness:
+    """Drive a multi-replica router through a seeded fault schedule and
+    check the terminal invariant.
+
+    ``engine_factory`` builds one fresh
+    :class:`~chainermn_tpu.serving.DecodeEngine` per call — the initial
+    fleet AND every revival replacement come from it (a dead replica's
+    device state is never reused; its engine is garbage).  Dead
+    replicas are revived ``revive_after`` ticks after death (behind the
+    probation circuit breaker), up to ``max_revives`` times fleet-wide,
+    so the run also exercises readmission; revived replicas run
+    fault-free (the schedule belongs to the first incarnation).
+
+    The harness is deliberately a thin loop over public Router seams —
+    everything it does (``tick``/``revive_replica``/``completions``) a
+    production supervisor could do the same way.
+    """
+
+    def __init__(self, engine_factory: Callable[[], object],
+                 replicas: int = 3, seed: int = 0, registry=None,
+                 revive_after: int = 4, max_revives: int = 8,
+                 schedule: Optional[dict] = None, **router_kw):
+        from chainermn_tpu.resilience.faults import (
+            FaultInjector,
+            parse_fault_spec,
+        )
+        from chainermn_tpu.serving.router import Router
+
+        self.engine_factory = engine_factory
+        self.schedule = (
+            schedule if schedule is not None
+            else chaos_schedule(seed, replicas)
+        )
+        faults = [
+            FaultInjector(parse_fault_spec(s)) if s else None
+            for s in self.schedule["replica_faults"]
+        ]
+        rf = self.schedule["router_faults"]
+        router_fault = (
+            FaultInjector(parse_fault_spec(rf)) if rf else None
+        )
+        self.router = Router(
+            [engine_factory() for _ in range(replicas)],
+            registry=registry, faults=faults, fault=router_fault,
+            **router_kw,
+        )
+        self.revive_after = max(1, revive_after)
+        self.max_revives = max_revives
+        self.revived = 0
+        #: ticks-until-revive countdown per currently-dead replica.
+        self._revive_in: dict = {}
+
+    def _poll_revivals(self) -> None:
+        health = self.router.health
+        for i in health.dead_replicas:
+            if i not in self._revive_in:
+                self._revive_in[i] = self.revive_after
+        for i in list(self._revive_in):
+            if not health.is_up(i):
+                self._revive_in[i] -= 1
+                if self._revive_in[i] <= 0 and \
+                        self.revived < self.max_revives:
+                    self.router.revive_replica(i, self.engine_factory())
+                    self.revived += 1
+                    del self._revive_in[i]
+            else:  # pragma: no cover - defensive (revived elsewhere)
+                del self._revive_in[i]
+
+    def run(self, requests: Sequence) -> dict:
+        """Submit ``requests``, drain the fleet under the schedule, and
+        return the invariant report (plus harness/run bookkeeping).
+        Raises if the fleet deadlocks — a chaos run must always
+        terminate."""
+        router = self.router
+        for r in requests:
+            router.submit(r)
+        stall = 0
+        while router.pending:
+            progressed = router.tick()
+            self._poll_revivals()
+            if progressed:
+                stall = 0
+                continue
+            now = router.clock.now()
+            nxt = [
+                t for t in (
+                    [r.arrival for r in router.queued_requests()[:1]]
+                    + [
+                        s.next_arrival()
+                        for i, s in enumerate(router.schedulers)
+                        if router.health.is_up(i)
+                    ]
+                )
+                if t is not None and t > now
+            ]
+            if nxt:
+                router.clock.skip_to(min(nxt))
+                stall = 0
+            elif self._revive_in and self.revived < self.max_revives:
+                # Everything that could serve the remaining work is
+                # dead and a revival countdown is running — idle ticks
+                # count it down (this IS progress toward recovery).
+                stall = 0
+            else:
+                stall += 1
+                if stall > 3:
+                    raise RuntimeError(
+                        "chaos fleet deadlocked: no progress, no "
+                        "arrivals, no revivals pending "
+                        f"(health={router.health.snapshot()})"
+                    )
+        router.finish()
+        report = verify_terminal_invariant(requests, router.completions)
+        report["schedule"] = self.schedule
+        report["revived"] = self.revived
+        report["health"] = router.health.snapshot()
+        return report

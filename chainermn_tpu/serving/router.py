@@ -49,11 +49,14 @@ from chainermn_tpu.observability.metrics import (
     MetricsRegistry,
     NoopInstrument as _NoopInstrument,
 )
+from chainermn_tpu.serving.kv_pool import PoolExhausted
 from chainermn_tpu.serving.scheduler import (
     Completion,
     Request,
     Scheduler,
     _Clock,
+    _QueueEntry,
+    terminal_completion,
 )
 
 
@@ -93,7 +96,12 @@ class Router:
                  clock: Optional[_Clock] = None,
                  max_queue: Optional[int] = None,
                  rebalance: Optional[bool] = None,
-                 roles: Optional[Sequence[str]] = None):
+                 roles: Optional[Sequence[str]] = None,
+                 faults: Optional[Sequence] = None,
+                 fault=None,
+                 retry_budget: Optional[int] = None,
+                 probation_ticks: Optional[int] = None,
+                 shed_depth: Optional[int] = None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability.metrics import (
             DEFAULT_MS_EDGES,
@@ -102,6 +110,11 @@ class Router:
         from chainermn_tpu.observability.tracing import (
             RequestTimeline,
             SpanRing,
+        )
+        from chainermn_tpu.resilience import faults as _faults
+        from chainermn_tpu.serving.recovery import (
+            FleetHealth,
+            shed_depth_from_env,
         )
 
         engines = list(engines)
@@ -135,13 +148,21 @@ class Router:
         #: event as a ``serve.<kind>`` span carrying ``req=<id>``).
         self.rings = [SpanRing(4096) for _ in engines]
         self.replica_registries = [MetricsRegistry() for _ in engines]
+        if faults is None:
+            faults = [None] * len(engines)
+        faults = list(faults)
+        if len(faults) != len(engines):
+            raise ValueError(
+                f"faults ({len(faults)}) must match engines "
+                f"({len(engines)})"
+            )
         self.schedulers: List[Scheduler] = [
             Scheduler(
                 eng, registry=reg, clock=self.clock,
-                timeline=RequestTimeline(ring=ring),
+                timeline=RequestTimeline(ring=ring), fault=fi,
             )
-            for eng, reg, ring in zip(
-                engines, self.replica_registries, self.rings
+            for eng, reg, ring, fi in zip(
+                engines, self.replica_registries, self.rings, faults
             )
         ]
         if max_queue is None:
@@ -180,6 +201,7 @@ class Router:
             noop = _NoopInstrument()
             self._m_disp = self._m_migr = self._m_bp = noop
             self._m_rq = self._m_spread = self._m_disp_ms = noop
+            health_reg = None
         else:
             reg = registry if registry is not None else global_registry()
             self._m_disp = reg.counter("serve.router.dispatched")
@@ -190,6 +212,32 @@ class Router:
             self._m_disp_ms = reg.histogram(
                 "serve.router.dispatch_ms", edges=DEFAULT_MS_EDGES
             )
+            health_reg = reg
+        #: The failure plane (ISSUE 15): per-replica live/probation/dead
+        #: state + the serve.health.* instruments; the fault boundary in
+        #: :meth:`tick` drives it.
+        self.health = FleetHealth(
+            len(engines), registry=health_reg,
+            retry_budget=retry_budget, probation_ticks=probation_ticks,
+        )
+        #: router-level fault hook — the recovery re-dispatch path is a
+        #: ``migrate`` fault site (``drop@migrate`` loses one re-dispatch
+        #: "frame"; the entry stays router-held, is detected immediately
+        #: and retried — the chaos harness's wire-loss arm).
+        self._fault = (
+            fault if fault is not None else _faults.process_injector()
+        )
+        self.shed_depth = (
+            shed_depth if shed_depth is not None else shed_depth_from_env()
+        )
+        #: terminal completions the ROUTER produced (poisoned requests
+        #: quarantined at the fault boundary, shed overflow) — replicas
+        #: never saw these finish, so they live here and merge in
+        #: :attr:`completions`.
+        self._router_completions: List[Completion] = []
+        #: harvested entries waiting for a survivor (only while NO live
+        #: full-trust replica can take them; drained first each tick).
+        self._recovered: List = []
         #: Incident plane: same resolution as the Scheduler — the
         #: process manager rides the ambient-registry publishing
         #: decision (an explicit registry's gauges live where the
@@ -210,10 +258,30 @@ class Router:
         return len(self.schedulers)
 
     def submit(self, req: Request) -> None:
-        """Accept a request into the router queue (validated against
-        one admitting replica's geometry — homogeneous replicas)."""
-        self.schedulers[self._admitting[0]].check_fit(req)
-        self._queue.append(req)
+        """Accept a request into the router queue.  Geometry-validated
+        per replica: a replica whose pool cannot EVER hold the request
+        (heterogeneous fleets — ``PoolExhausted`` from its
+        ``check_fit``) is that replica's problem, not grounds for
+        refusing a request another replica can serve; the submit
+        raises only when NO admitting replica fits it."""
+        err = None
+        for i in self._admitting:
+            try:
+                self.schedulers[i].check_fit(req)
+                self._queue.append(req)
+                return
+            except PoolExhausted as e:
+                err = e
+        raise err if err is not None else RuntimeError(
+            "router has no admitting replica"
+        )
+
+    def _fits(self, i: int, req: Request) -> bool:
+        try:
+            self.schedulers[i].check_fit(req)
+            return True
+        except PoolExhausted:
+            return False
 
     def _gauge(self, i: int, name: str):
         inst = self.replica_registries[i].peek(name)
@@ -240,48 +308,129 @@ class Router:
         kv = self._gauge(i, "mem.kv.occupancy") or 0.0
         return (occ * cap + qd) / cap + 0.1 * kv
 
-    def _pick_replica(self) -> Optional[int]:
-        """Least-loaded ADMITTING replica (decode-role replicas take
-        migrated slots, never fresh requests) with admission headroom,
-        or ``None`` when every one is at ``max_queue`` (backpressure)."""
-        best, best_load = None, None
-        for i in self._admitting:
+    def _admit_candidates(self) -> List[int]:
+        """Admitting-role replicas whose tick loop still runs (live or
+        probation — dead replicas take nothing)."""
+        return [i for i in self._admitting if self.health.is_up(i)]
+
+    def _ranked_replicas(self, probation_ok: bool = True) -> List[int]:
+        """Dispatch candidates (admitting, up, with admission headroom)
+        ranked least-loaded first.  Probation replicas carry a flat
+        load penalty — the reduced-weight half of the circuit breaker:
+        they receive fresh work only when every full-trust replica is
+        busier — and are excluded entirely for recovered work
+        (``probation_ok=False``)."""
+        ranked = []
+        for i in self._admit_candidates():
             s = self.schedulers[i]
+            probation = self.health.in_probation(i)
+            if probation and not probation_ok:
+                continue
             # queue_depth is LIVE (submit appends immediately), so it
             # already counts this tick's dispatches — _since_gauge is
             # only for correcting the stale gauges in _load.
             if s.queue_depth >= self.max_queue:
                 continue
-            load = self._load(i)
-            if best_load is None or load < best_load:
-                best, best_load = i, load
-        return best
+            ranked.append((self._load(i) + (1.0 if probation else 0.0), i))
+        ranked.sort()
+        return [i for _, i in ranked]
 
     def _dispatch(self) -> bool:
         """Move every ARRIVED router-queue request to the least-loaded
         replica, FIFO; stop at the first backpressure refusal (order
-        preservation) or future arrival."""
-        progressed = False
+        preservation) or future arrival.  A replica-side
+        ``PoolExhausted`` is that replica's problem: it is excluded for
+        this pick and the next candidate tried."""
+        progressed = self._drain_recovered()
         now = self.clock.now()
         while self._queue and self._queue[0].arrival <= now:
             t0 = time.perf_counter()
-            best = self._pick_replica()
-            if best is None:
+            ranked = self._ranked_replicas()
+            if not ranked:
                 # Fleet-wide backpressure: the request WAITS here (and
                 # is never lost) — count the deferral, surface depth.
                 self._m_bp.inc()
                 break
-            req = self._queue.pop(0)
-            self.schedulers[best].submit(req)
-            self.assignments.setdefault(req.id, []).append(best)
-            self._since_gauge[best] += 1
+            req = self._queue[0]
+            placed = None
+            misfit = None
+            for i in ranked:
+                try:
+                    self.schedulers[i].submit(req)
+                except PoolExhausted as e:
+                    misfit = e
+                    continue
+                placed = i
+                break
+            if placed is None:
+                # Every candidate's POOL GEOMETRY refuses this request
+                # (check_fit is occupancy-blind).  If a currently-
+                # saturated replica could fit it, wait for headroom
+                # (backpressure); if nobody up can EVER fit it, the
+                # request is terminal — quarantine, never a router
+                # abort and never an infinite holdback.
+                if any(
+                    self._fits(i, req)
+                    for i in self._admit_candidates() if i not in ranked
+                ):
+                    self._m_bp.inc()
+                    break
+                self._queue.pop(0)
+                self._terminal_request(
+                    req, "poisoned",
+                    error=f"PoolExhausted: {misfit}",
+                )
+                self.health.m_poisoned.inc()
+                if self.incidents is not None:
+                    self.incidents.evaluate()
+                progressed = True
+                continue
+            self._queue.pop(0)
+            self.assignments.setdefault(req.id, []).append(placed)
+            self._since_gauge[placed] += 1
             ms = (time.perf_counter() - t0) * 1e3
             self.dispatch_ms.append(ms)
             self._m_disp.inc()
             self._m_disp_ms.observe(ms)
             progressed = True
+        if self._shed_overflow(now):
+            progressed = True
         self._m_rq.set(len(self._queue))
         return progressed
+
+    def _shed_overflow(self, now: float) -> bool:
+        """Load shedding (``CMN_ROUTER_SHED_DEPTH``): when surviving
+        capacity leaves more than ``shed_depth`` ARRIVED requests in
+        the holdback queue, refuse the newest-arrived
+        (``status="shed"``) — bounded queues instead of unbounded
+        latency collapse.  0 (the default) disables shedding; future
+        arrivals never count (they are not waiting yet)."""
+        if not self.shed_depth:
+            return False
+        arrived = [r for r in self._queue if r.arrival <= now]
+        if len(arrived) <= self.shed_depth:
+            return False
+        victims = sorted(arrived, key=lambda r: r.arrival)[
+            self.shed_depth:
+        ]
+        shed_ids = {id(v) for v in victims}
+        self._queue = [r for r in self._queue if id(r) not in shed_ids]
+        for req in sorted(victims, key=lambda r: -r.arrival):
+            self._terminal_request(
+                req, "shed",
+                error=f"holdback depth > {self.shed_depth} with "
+                      "surviving capacity saturated",
+            )
+            self.health.m_shed.inc()
+        return True
+
+    def _terminal_request(self, req: Request, status: str,
+                          error: Optional[str] = None) -> None:
+        """A never-admitted router-queue request terminates here (shed,
+        or unservable-anywhere): one definite Completion."""
+        self._router_completions.append(terminal_completion(
+            _QueueEntry(req=req), status, self.clock.now(), error=error,
+        ))
 
     def _rebalance(self) -> bool:
         """Steal arrived queued work from a replica whose slots are all
@@ -291,10 +440,14 @@ class Router:
         # Role discipline holds under rebalance too: a decode replica's
         # free slots belong to the migration plane, and its queue (if a
         # drain ever filled one) is recompute work another decode
-        # replica could not prefill faster anyway.
+        # replica could not prefill faster anyway.  Health discipline:
+        # only full-trust LIVE replicas steal (a probation replica gets
+        # fresh admissions only — the circuit breaker), and dead
+        # replicas neither donate (harvested already) nor receive.
         idle = [
             i for i in self._admitting
-            if self.schedulers[i].has_free_slot
+            if self.health.state(i) == "live"
+            and self.schedulers[i].has_free_slot
             and self.schedulers[i].queue_depth == 0
         ]
         if not idle:
@@ -302,7 +455,8 @@ class Router:
         donors = sorted(
             (
                 i for i in self._admitting
-                if self.schedulers[i].queue_depth > 0
+                if self.health.is_up(i)
+                and self.schedulers[i].queue_depth > 0
                 and not self.schedulers[i].has_free_slot
             ),
             key=lambda i: -self.schedulers[i].queue_depth,
@@ -315,6 +469,14 @@ class Router:
                 entry = self.schedulers[src].steal_queued()
                 if entry is None:
                     continue
+                try:
+                    self.schedulers[dst].check_fit(entry.req)
+                except PoolExhausted:
+                    # The idle replica's pool cannot hold this entry
+                    # (heterogeneous fleet) — hand it straight back
+                    # (same queue end it was stolen from).
+                    self.schedulers[src].submit_entry(entry)
+                    continue
                 self.schedulers[dst].submit_entry(entry)
                 self.assignments.setdefault(
                     entry.req.id, []
@@ -324,21 +486,186 @@ class Router:
                 break
         return moved
 
+    # ---------------------------------------------------------- recovery
+    def _on_replica_death(self, i: int, exc: BaseException) -> None:
+        """The fault boundary (ISSUE 15): replica ``i``'s tick escaped.
+        Mark it dead, harvest its queued entries AND live slots into
+        recompute entries (carried + generated tokens preserved — the
+        eviction-requeue discipline, so survivor continuations are
+        greedy-identical), and re-dispatch each to a survivor — unless
+        the entry has now killed ``retry_budget`` replicas, in which
+        case it is the likely cause and is quarantined as a poisoned
+        Completion with the attributed error."""
+        err = f"{type(exc).__name__}: {exc}"
+        self.health.mark_dead(i, err)
+        try:
+            entries = self.schedulers[i].harvest_entries()
+        except Exception:  # pragma: no cover - defensive harvest
+            entries = []
+        # Requests this replica FINISHED before dying are history, not
+        # casualties — move them to the router's books so a revival
+        # (which replaces the scheduler) cannot lose them.
+        self._router_completions.extend(self.schedulers[i].completions)
+        self.schedulers[i].completions = []
+        for entry in entries:
+            entry.retries += 1
+            entry.last_error = err
+            self.health.m_retries.inc()
+            if entry.retries >= self.health.retry_budget:
+                self._quarantine(entry, err)
+            else:
+                self._redispatch(entry)
+        # Evaluate the incident rules NOW, while the breach is fresh:
+        # the critical `replica_dead` (and `poison_request`, when a
+        # quarantine happened) default rules capture their bundles at
+        # the moment the fleet lost the replica.
+        if self.incidents is not None:
+            self.incidents.evaluate()
+
+    def _quarantine(self, entry, err: str) -> None:
+        self._router_completions.append(terminal_completion(
+            entry, "poisoned", self.clock.now(), error=err,
+        ))
+        self.health.m_poisoned.inc()
+
+    def _redispatch(self, entry) -> bool:
+        """Re-dispatch one harvested entry to a surviving FULL-TRUST
+        replica (probation replicas take only fresh admissions).  This
+        is a ``migrate`` fault site: ``drop@migrate`` loses one
+        re-dispatch frame on the wire — detected immediately (the
+        entry never left the router) and retried on the next path.
+        With no survivor able to take it, the entry parks in
+        ``_recovered`` and re-tries every dispatch round — recovered
+        work is never dropped."""
+        candidates = [
+            i for i in self._ranked_replicas(probation_ok=False)
+            if self._fits(i, entry.req)
+        ] or [
+            # Every full-trust survivor is at its admission cap:
+            # recovered work outranks the cap (it already waited once),
+            # so fall back to the least-loaded fitting survivor.
+            i for i in sorted(
+                (j for j in self._admit_candidates()
+                 if not self.health.in_probation(j)),
+                key=self._load,
+            )
+            if self._fits(i, entry.req)
+        ]
+        for i in candidates:
+            if self._fault is not None and \
+                    self._fault.hook("migrate") == "drop":
+                self.health.m_retries.inc()
+                continue
+            self.schedulers[i].submit_entry(entry)
+            self.assignments.setdefault(entry.req.id, []).append(i)
+            self._since_gauge[i] += 1
+            self.health.m_recovered.inc()
+            return True
+        up = self._admit_candidates()
+        if up and not any(self._fits(i, entry.req) for i in up):
+            # Replicas are UP but none's POOL GEOMETRY can ever hold
+            # this entry (a heterogeneous fleet lost the only replica
+            # that could) — terminal, the same verdict the fresh-
+            # dispatch path reaches: never an infinite park.  With NO
+            # up replica at all the entry parks instead: a pending
+            # revival is the recovery path, and ``run()`` raises loudly
+            # if nobody ever drives one.
+            self._quarantine(
+                entry,
+                "PoolExhausted on every surviving replica"
+                + (f" (after {entry.last_error})"
+                   if entry.last_error else ""),
+            )
+            if self.incidents is not None:
+                self.incidents.evaluate()
+            return True
+        self._recovered.append(entry)
+        return False
+
+    def _drain_recovered(self) -> bool:
+        """Retry parked recovered entries (their survivor may have
+        appeared — a revival graduated, or capacity freed)."""
+        if not self._recovered:
+            return False
+        parked, self._recovered = self._recovered, []
+        progressed = False
+        for entry in parked:
+            if self._redispatch(entry):
+                progressed = True
+        return progressed
+
+    def revive_replica(self, i: int, engine, fault=None) -> None:
+        """Re-register a replacement engine for dead replica ``i``
+        behind the probation circuit breaker: fresh Scheduler, fresh
+        metrics registry, fresh span ring (the old incarnation's books
+        are closed — its harvest already moved every request it held).
+        The revived replica receives only fresh admissions at reduced
+        dispatch weight until ``CMN_SERVE_PROBATION_TICKS`` clean ticks
+        pass, so a flapping replica cannot thrash the fleet."""
+        from chainermn_tpu.observability.metrics import MetricsRegistry
+        from chainermn_tpu.observability.tracing import (
+            RequestTimeline,
+            SpanRing,
+        )
+
+        if self.health.state(i) != "dead":
+            raise ValueError(
+                f"replica {i} is {self.health.state(i)!r} — only a dead "
+                "replica can be revived"
+            )
+        ring = SpanRing(4096)
+        reg = MetricsRegistry()
+        self.rings[i] = ring
+        self.replica_registries[i] = reg
+        self.schedulers[i] = Scheduler(
+            engine, registry=reg, clock=self.clock,
+            timeline=RequestTimeline(ring=ring), fault=fault,
+        )
+        self._since_gauge[i] = 0
+        self.health.start_probation(i)
+
+    def queued_requests(self) -> List[Request]:
+        """The router holdback queue (oldest first) — chaos-harness /
+        dashboard introspection."""
+        return list(self._queue)
+
     # --------------------------------------------------------------- run
     def tick(self) -> bool:
         """One fleet iteration: dispatch arrived requests, tick every
-        replica, rebalance, refresh router gauges.  Returns whether
-        anything progressed anywhere."""
+        UP replica inside the fault boundary, rebalance, refresh router
+        gauges.  Returns whether anything progressed anywhere.
+
+        The fault boundary (ISSUE 15): an exception escaping a
+        replica's tick — a real defect or an injected
+        ``crash@serve_step`` — marks THAT replica dead and recovers its
+        work onto survivors (:meth:`_on_replica_death`) instead of
+        aborting the fleet.  A clean tick feeds the probation counter
+        of a revived replica."""
         progressed = self._dispatch()
-        for s in self.schedulers:
-            if s.tick():
+        for i, s in enumerate(self.schedulers):
+            if not self.health.is_up(i):
+                continue
+            try:
+                if s.tick():
+                    progressed = True
+            except Exception as exc:
+                self._on_replica_death(i, exc)
                 progressed = True
+            else:
+                was_probation = self.health.in_probation(i)
+                self.health.clean_tick(i)
+                if was_probation and self._recovered:
+                    # The countdown toward graduating this replica IS
+                    # progress toward serving the parked recovered work
+                    # (which only full-trust replicas may take) — an
+                    # otherwise-idle fleet must keep ticking it down
+                    # rather than declare deadlock.
+                    progressed = True
         if self._rebalance():
             progressed = True
         self._since_gauge = [0] * len(self.schedulers)
         occs = [
-            self._gauge(i, "serve.slot_occupancy")
-            for i in range(len(self.schedulers))
+            self._occupancy(i) for i in range(len(self.schedulers))
         ]
         self._m_spread.set(max(occs) - min(occs))
         for i, o in enumerate(occs):
@@ -350,10 +677,24 @@ class Router:
             self.incidents.evaluate()
         return progressed
 
+    def _occupancy(self, i: int) -> float:
+        """Replica occupancy off the live gauge, falling back to the
+        scheduler's host-side truth (a freshly revived replica's
+        registry has not published yet; a dead one's gauges are stale
+        — its harvested slots are empty, which is what the host truth
+        reads)."""
+        if not self.health.is_up(i):
+            return 0.0
+        o = self._gauge(i, "serve.slot_occupancy")
+        return o if o is not None else self.schedulers[i].slot_occupancy
+
     @property
     def pending(self) -> bool:
         return bool(
-            self._queue or any(s.pending for s in self.schedulers)
+            self._queue or self._recovered or any(
+                s.pending for i, s in enumerate(self.schedulers)
+                if self.health.is_up(i)
+            )
         )
 
     def run(self, requests: Optional[Sequence[Request]] = None
@@ -368,21 +709,29 @@ class Router:
                 nxt = [r.arrival for r in self._queue[:1]]
                 nxt += [
                     t for t in (
-                        s.next_arrival() for s in self.schedulers
+                        s.next_arrival()
+                        for i, s in enumerate(self.schedulers)
+                        if self.health.is_up(i)
                     ) if t is not None
                 ]
                 if not nxt:  # pragma: no cover - defensive
                     raise RuntimeError(
-                        "router made no progress with no future arrivals"
+                        "router made no progress with no future "
+                        "arrivals (dead replicas un-revived? drive the "
+                        "loop yourself — or via recovery.ChaosHarness "
+                        "— to revive mid-run)"
                     )
                 self.clock.skip_to(min(nxt))
         self.finish()
         return self.completions
 
     def finish(self) -> None:
-        """Close every replica's books + the router's own gauges."""
-        for s in self.schedulers:
-            s.finish()
+        """Close every UP replica's books + the router's own gauges
+        (a dead replica's books closed at harvest — its process would
+        be gone in a real fleet)."""
+        for i, s in enumerate(self.schedulers):
+            if self.health.is_up(i):
+                s.finish()
         self._m_rq.set(len(self._queue))
         self._m_spread.set(0.0)
         if self.incidents is not None:
@@ -391,7 +740,9 @@ class Router:
     # ------------------------------------------------------ introspection
     @property
     def completions(self) -> List[Completion]:
-        out: List[Completion] = []
+        """Every replica's completions plus the router's own terminal
+        verdicts (poisoned / shed), merged."""
+        out: List[Completion] = list(self._router_completions)
         for s in self.schedulers:
             out.extend(s.completions)
         return sorted(out, key=lambda c: (c.finished_at, c.id))
@@ -403,6 +754,7 @@ class Router:
             out.append({
                 "replica": i,
                 "role": self.roles[i],
+                "state": self.health.state(i),
                 "dispatched": sum(
                     1 for reps in self.assignments.values()
                     if reps and reps[0] == i

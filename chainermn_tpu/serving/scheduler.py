@@ -134,6 +134,13 @@ class Request:
     arrival: float = 0.0
     #: per-request RNG lane seed (sampling only).
     seed: int = 0
+    #: optional deadline, milliseconds after ``arrival``: a request
+    #: still unfinished past it is CANCELLED (slot freed, blocks
+    #: released, ``Completion.status == "deadline"``) — graceful
+    #: degradation under overload instead of unbounded latency.  None
+    #: defers to the fleet-wide ``CMN_SERVE_DEADLINE_MS`` default
+    #: (itself off unless set).
+    deadline_ms: Optional[float] = None
 
 
 @dataclass
@@ -154,7 +161,7 @@ class Completion:
 
     id: int
     tokens: List[int]
-    reason: str  # "eos" | "length"
+    reason: str  # "eos" | "length" | "poisoned" | "shed" | "deadline"
     prompt_len: int
     arrival: float
     admitted_at: float
@@ -164,6 +171,19 @@ class Completion:
     prefix_hit_tokens: int = 0
     spec_proposed: int = 0
     spec_accepted: int = 0
+    #: terminal outcome (ISSUE 15): ``"ok"`` is a normal completion;
+    #: ``"poisoned"`` exhausted its retry budget killing replicas,
+    #: ``"shed"`` was refused by router load shedding, ``"deadline"``
+    #: was cancelled past its deadline.  Every submitted request gets
+    #: exactly one Completion with a definite status — the chaos
+    #: harness's terminal invariant.
+    status: str = "ok"
+    #: attributed error for non-ok statuses (e.g. the replica-killing
+    #: exception a poisoned request carries).
+    error: Optional[str] = None
+    #: replica deaths this request was harvested from (recovery
+    #: re-dispatch count — see ``CMN_SERVE_RETRY_BUDGET``).
+    retries: int = 0
 
 
 @dataclass
@@ -178,6 +198,39 @@ class _QueueEntry:
     prefix_hit_tokens: int = 0
     spec_proposed: int = 0
     spec_accepted: int = 0
+    #: replica deaths this entry has been harvested from (the retry
+    #: budget's counter — incremented by the router's fault boundary).
+    retries: int = 0
+    #: the most recent replica-killing error, attributed to this entry
+    #: if it exhausts the budget and is quarantined.
+    last_error: Optional[str] = None
+
+
+def terminal_completion(entry: _QueueEntry, status: str, now: float,
+                        error: Optional[str] = None) -> Completion:
+    """The ONE terminal-Completion shape for requests that end without
+    serving to completion (poisoned / shed / deadline) — the scheduler
+    AND the router both build through here so the accounting can never
+    diverge between the three terminal paths (ISSUE 15)."""
+    return Completion(
+        id=entry.req.id,
+        tokens=list(entry.carried),
+        reason=status,
+        prompt_len=len(entry.req.prompt),
+        arrival=entry.req.arrival,
+        admitted_at=(
+            entry.first_admit if entry.first_admit is not None else now
+        ),
+        finished_at=now,
+        evictions=entry.evictions,
+        first_admitted_at=entry.first_admit or 0.0,
+        prefix_hit_tokens=entry.prefix_hit_tokens,
+        spec_proposed=entry.spec_proposed,
+        spec_accepted=entry.spec_accepted,
+        status=status,
+        error=error if error is not None else entry.last_error,
+        retries=entry.retries,
+    )
 
 
 class _Slot:
@@ -224,7 +277,8 @@ class Scheduler:
     :class:`~chainermn_tpu.serving.engine.DecodeEngine`."""
 
     def __init__(self, engine, registry=None, clock: Optional[_Clock] = None,
-                 slo=None, timeline=None, memory=None, incidents=None):
+                 slo=None, timeline=None, memory=None, incidents=None,
+                 fault=None, deadline_ms: Optional[float] = None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability import flight as _flight
         from chainermn_tpu.observability import tracing as _tracing
@@ -247,7 +301,21 @@ class Scheduler:
         #: device readback may still be draining — the next decode step's
         #: wall time would absorb them (the ``serve.mixed_ms`` tag).
         self._unsynced_prefill = False
-        self._fault = _faults.process_injector()
+        #: fault-injection seam: an explicit injector wins (the chaos
+        #: harness gives each replica its own seeded schedule); default
+        #: is the process-wide ``CMN_FAULT`` injector.
+        self._fault = (
+            fault if fault is not None else _faults.process_injector()
+        )
+        #: fleet-wide default deadline (ms past arrival) for requests
+        #: that carry none of their own; explicit arg wins over
+        #: ``CMN_SERVE_DEADLINE_MS`` (None there too = no deadline).
+        from chainermn_tpu.serving.recovery import deadline_ms_from_env
+
+        self._default_deadline_ms = (
+            deadline_ms if deadline_ms is not None
+            else deadline_ms_from_env()
+        )
         enabled = _obs.enabled()
         # An explicitly passed registry always publishes; the ambient
         # global registry rides the CMN_OBS master switch like every
@@ -260,7 +328,7 @@ class Scheduler:
             self._m_px_cached = self._m_px_cow = noop
             self._m_px_evicted = self._m_mig_install = noop
             self._m_spec_prop = self._m_spec_acc = noop
-            self._m_spec_rate = noop
+            self._m_spec_rate = self._m_deadline = noop
             reg = None
         else:
             reg = registry if registry is not None else global_registry()
@@ -288,6 +356,9 @@ class Scheduler:
             self._m_spec_prop = reg.counter("serve.spec.proposed")
             self._m_spec_acc = reg.counter("serve.spec.accepted")
             self._m_spec_rate = reg.gauge("serve.spec.accept_rate")
+            self._m_deadline = reg.counter(
+                "serve.health.deadline_cancels"
+            )
         #: lifetime host-side accounting (benchmarks read these directly;
         #: the gauges above mirror the derived rates).
         self.prefix_lookup_tokens = 0
@@ -505,6 +576,103 @@ class Scheduler:
                 "steal", t=self.clock.now(), req=entry.req.id,
             )
         return entry
+
+    def harvest_entries(self) -> List[_QueueEntry]:
+        """Strip EVERYTHING this replica holds — live slots and queued
+        entries — into recompute ``_QueueEntry`` s, for the router's
+        fault boundary after this replica's tick escaped (ISSUE 15).
+
+        Live slots fold their generated tokens into ``carried`` exactly
+        like an eviction (recompute-requeue: the re-admission prefills
+        ``prompt + carried`` on a survivor and the continuation is
+        greedy-identical), ordered oldest admission first so the
+        longest-served work re-dispatches ahead.  Block releases are
+        host-side allocator bookkeeping only (the dead engine's device
+        state is garbage anyway) and best-effort — a corrupted
+        allocator must not lose the harvest."""
+        out: List[_QueueEntry] = []
+        now = self.clock.now()
+        for slot in sorted(
+            (s for s in self._slots if s is not None),
+            key=lambda s: s.admit_seq,
+        ):
+            try:
+                self.engine.release_blocks(slot.blocks)
+            except Exception:
+                pass
+            slot.entry.carried = (
+                list(slot.entry.carried) + list(slot.generated)
+            )
+            slot.entry.evictions += 1
+            self._slots[slot.idx] = None
+            out.append(slot.entry)
+            if self.timeline is not None:
+                self.timeline.record(
+                    "evict", t=now, req=slot.entry.req.id,
+                    slot=slot.idx,
+                    info={"harvested": True,
+                          "carried": len(slot.entry.carried)},
+                )
+        out.extend(self._queue)
+        self._queue = []
+        return out
+
+    def complete_terminal(self, entry: _QueueEntry, status: str,
+                          error: Optional[str] = None) -> Completion:
+        """Terminate ``entry`` WITHOUT serving it (poisoned / shed /
+        deadline): one definite Completion carrying whatever tokens were
+        generated before the terminal verdict.  The entry must already
+        be off the queue and out of any slot."""
+        now = self.clock.now()
+        comp = terminal_completion(entry, status, now, error=error)
+        self.completions.append(comp)
+        if self.timeline is not None:
+            self.timeline.record(
+                "retire", t=now, req=entry.req.id,
+                info={"reason": status},
+            )
+        return comp
+
+    # ----------------------------------------------------------- deadline
+    def _deadline_s(self, req: Request) -> Optional[float]:
+        dl = (
+            req.deadline_ms if req.deadline_ms is not None
+            else self._default_deadline_ms
+        )
+        return dl / 1e3 if dl is not None and dl > 0 else None
+
+    def _cancel_deadlines(self) -> bool:
+        """Cancel every over-deadline request — live slots (blocks
+        freed, the graceful-degradation half of ISSUE 15) and queued
+        entries (they would only get staler waiting).  Terminal:
+        ``status="deadline"``, counted by
+        ``serve.health.deadline_cancels``."""
+        now = self.clock.now()
+        progressed = False
+        for slot in [s for s in self._slots if s is not None]:
+            dl = self._deadline_s(slot.entry.req)
+            if dl is None or now - slot.entry.req.arrival <= dl:
+                continue
+            self.engine.release_blocks(slot.blocks)
+            self._slots[slot.idx] = None
+            slot.entry.carried = (
+                list(slot.entry.carried) + list(slot.generated)
+            )
+            self.complete_terminal(slot.entry, "deadline")
+            self._m_deadline.inc()
+            progressed = True
+        kept = []
+        for entry in self._queue:
+            dl = self._deadline_s(entry.req)
+            if dl is not None and now - entry.req.arrival > dl:
+                self.complete_terminal(entry, "deadline")
+                self._m_deadline.inc()
+                progressed = True
+            else:
+                kept.append(entry)
+        if len(kept) != len(self._queue):
+            self._queue = kept
+        return progressed
 
     @property
     def pending(self) -> bool:
@@ -1027,6 +1195,7 @@ class Scheduler:
             prefix_hit_tokens=slot.entry.prefix_hit_tokens,
             spec_proposed=slot.entry.spec_proposed,
             spec_accepted=slot.entry.spec_accepted,
+            retries=slot.entry.retries,
         ))
         if self.timeline is not None:
             self.timeline.record(
@@ -1045,6 +1214,8 @@ class Scheduler:
         the :class:`~chainermn_tpu.serving.router.Router` interleaves
         ticks across replicas on a shared clock."""
         progressed = False
+        if self._cancel_deadlines():
+            progressed = True
         while self._try_admit():
             progressed = True
         if self._prefill_round():
@@ -1156,7 +1327,11 @@ class Scheduler:
                 "generated": len(s.generated),
                 "carried": len(s.entry.carried),
                 "blocks": len(s.blocks),
+                "retries": s.entry.retries,
             })
+        by_status: Dict[str, int] = {}
+        for c in self.completions:
+            by_status[c.status] = by_status.get(c.status, 0) + 1
         state = {
             "iterations": self._iterations,
             "queue_depth": len(self._queue),
@@ -1166,6 +1341,7 @@ class Scheduler:
             ],
             "slots": slots,
             "completions": len(self.completions),
+            "completions_by_status": by_status,
             "clock": round(self.clock.now(), 6),
             "engine": self.engine.stats(),
         }

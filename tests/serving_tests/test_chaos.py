@@ -1,0 +1,518 @@
+"""Chaos battery: the serving-fleet failure plane (ISSUE 15).
+
+The terminal invariant, checked request by request under a seeded
+randomized fault schedule over the existing sites (``crash@serve_step``
+killing replicas mid-stream, ``skew@serve_step`` fail-slow,
+``drop@migrate`` losing recovery re-dispatch frames): every submitted
+request terminates EXACTLY once with a definite status (ok / poisoned /
+shed / deadline) — zero lost, zero duplicated — while survivors'
+greedy outputs stay identical to the unfaulted twin and their decode
+step never recompiles.  Plus the plane's unit batteries: retry-budget
+exhaustion → poisoned quarantine, probation circuit breaker, deadline
+cancellation freeing blocks to the zero-leak baseline, router load
+shedding, env-knob parsing, and the two new default incident rules.
+"""
+
+import pytest
+
+from chainermn_tpu.observability.metrics import MetricsRegistry
+from chainermn_tpu.resilience.faults import (
+    FaultInjector,
+    parse_fault_spec,
+)
+from chainermn_tpu.serving import (
+    ChaosHarness,
+    DecodeEngine,
+    Request,
+    Router,
+    Scheduler,
+    chaos_schedule,
+    verify_terminal_invariant,
+)
+from chainermn_tpu.serving.recovery import FleetHealth
+
+pytestmark = [pytest.mark.tier1, pytest.mark.serving]
+
+
+def _mk_engine(make_model, tiny_params, capacity=2, num_blocks=24):
+    return DecodeEngine(
+        make_model(), tiny_params, capacity=capacity,
+        num_blocks=num_blocks, block_len=8, prefill_chunk=8,
+    )
+
+
+def _inj(spec):
+    return FaultInjector(parse_fault_spec(spec))
+
+
+def _reqs(prompts, n, max_new=5, **kw):
+    return [
+        Request(id=i, prompt=prompts[i % len(prompts)],
+                max_new_tokens=max_new, **kw)
+        for i in range(n)
+    ]
+
+
+# ------------------------------------------------------- chaos invariant
+def _chaos_drive(make_model, tiny_params, prompts, oracle, seed,
+                 schedule=None, n=8, max_new=5):
+    """One seeded chaos run + the full acceptance check: invariant
+    holds, ok-status survivors greedy-identical to the unfaulted twin,
+    decode_compiles==1 on every up replica, zero leaked blocks."""
+    reg = MetricsRegistry()
+    harness = ChaosHarness(
+        lambda: _mk_engine(make_model, tiny_params),
+        replicas=3, seed=seed, registry=reg, revive_after=2,
+        schedule=schedule,
+    )
+    reqs = _reqs(prompts, n, max_new=max_new)
+    report = harness.run(reqs)
+    assert report["holds"], report
+    assert report["by_status"]["ok"] + report["by_status"]["poisoned"] \
+        + report["by_status"]["shed"] + report["by_status"]["deadline"] \
+        == n
+    # Survivor continuations are greedy-identical to the unfaulted twin
+    # (recompute-requeue discipline) — for every request that completed.
+    eng0 = harness.router.schedulers[0].engine
+    for c in harness.router.completions:
+        if c.status == "ok":
+            assert c.tokens == oracle(
+                eng0.model, tiny_params,
+                prompts[c.id % len(prompts)], max_new,
+            ), (c.id, c.retries, c.evictions)
+    # One-compile contract on every replica whose tick loop still runs
+    # (0 only for a revived replica that never decoded), and the
+    # post-drain KV leak detector reads zero blocks.
+    router = harness.router
+    served = 0
+    for i, s in enumerate(router.schedulers):
+        if not router.health.is_up(i):
+            continue
+        assert s.engine.decode_compiles <= 1, (i, report)
+        if s._iterations:
+            assert s.engine.decode_compiles == 1, (i, report)
+            served += 1
+        assert s.memory.check_drained(s.engine) == 0, i
+    assert served > 0
+    return harness, report, reg
+
+
+def test_chaos_terminal_invariant_explicit_schedule(
+    make_model, tiny_params, prompts, oracle
+):
+    """All three fault sites in one run (the acceptance schedule):
+    two replicas crash mid-stream (one also fail-slow skewed), and the
+    first recovery re-dispatch frame drops on the wire."""
+    schedule = {
+        "seed": None,
+        "replica_faults": [
+            "crash@serve_step:4",
+            "skew@serve_step:2:5ms;crash@serve_step:8",
+            None,
+        ],
+        "router_faults": "drop@migrate:1",
+    }
+    harness, report, reg = _chaos_drive(
+        make_model, tiny_params, prompts, oracle, seed=0,
+        schedule=schedule,
+    )
+    assert reg.peek("serve.health.replica_dead").value == 2
+    # The dropped re-dispatch frame was detected and retried — counted,
+    # never lost (retries > harvested-entry increments alone would be).
+    assert reg.peek("serve.health.retries").value > 0
+    assert report["revived"] >= 1
+    # Every harvested entry either landed on a survivor or terminated.
+    assert not harness.router._recovered
+
+
+def test_chaos_seeded_schedule_battery(make_model, tiny_params, prompts,
+                                       oracle):
+    """The randomized arm, tier-1-sized: one seed through the full
+    invariant check (the slow variant sweeps several)."""
+    _chaos_drive(make_model, tiny_params, prompts, oracle, seed=3, n=6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 5, 8])
+def test_chaos_seed_sweep(make_model, tiny_params, prompts, oracle, seed):
+    """Long randomized variant (full CI): more seeds, more traffic."""
+    _chaos_drive(make_model, tiny_params, prompts, oracle, seed=seed,
+                 n=12, max_new=7)
+
+
+def test_chaos_schedule_seeded_and_deterministic():
+    a = chaos_schedule(7, 4)
+    b = chaos_schedule(7, 4)
+    assert a == b
+    assert len(a["replica_faults"]) == 4
+    # At least one crash is forced — a chaos run with zero crashes
+    # proves nothing.
+    assert any(
+        s and "crash@serve_step" in s for s in a["replica_faults"]
+    )
+    # Every spec parses under the CMN_FAULT grammar.
+    for s in a["replica_faults"] + [a["router_faults"]]:
+        if s:
+            parse_fault_spec(s)
+
+
+def test_verify_terminal_invariant_catches_loss_and_dup():
+    from chainermn_tpu.serving.scheduler import Completion
+
+    def comp(i, status="ok"):
+        return Completion(
+            id=i, tokens=[], reason=status, prompt_len=1, arrival=0.0,
+            admitted_at=0.0, finished_at=0.0, status=status,
+        )
+
+    reqs = _reqs([[1, 2]], 3)
+    ok = verify_terminal_invariant(reqs, [comp(0), comp(1), comp(2)])
+    assert ok["holds"] and ok["by_status"]["ok"] == 3
+    lost = verify_terminal_invariant(reqs, [comp(0), comp(1)])
+    assert not lost["holds"] and lost["lost"] == [2]
+    dup = verify_terminal_invariant(
+        reqs, [comp(0), comp(1), comp(2), comp(2)]
+    )
+    assert not dup["holds"] and dup["duplicated"] == [2]
+
+
+# ------------------------------------------------ retry budget / poison
+def test_retry_budget_exhaustion_poisons(make_model, tiny_params,
+                                         prompts):
+    """A request that kills CMN_SERVE_RETRY_BUDGET (here 2) replicas is
+    quarantined as a poisoned Completion with the attributed error —
+    never re-dispatched forever."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg,
+        faults=[_inj("crash@serve_step:1"), _inj("crash@serve_step:1")],
+        retry_budget=2,
+    )
+    comps = router.run([Request(id=0, prompt=prompts[0],
+                                max_new_tokens=6)])
+    assert len(comps) == 1
+    c = comps[0]
+    assert c.status == "poisoned" and c.reason == "poisoned"
+    assert c.retries == 2
+    assert "InjectedFault" in c.error
+    assert reg.peek("serve.health.poisoned").value == 1
+    assert reg.peek("serve.health.replica_dead").value == 2
+    assert router.health.dead_replicas == [0, 1]
+
+
+def test_sub_budget_crash_recovers_not_poisons(make_model, tiny_params,
+                                               prompts, oracle):
+    """One death (< budget) re-dispatches: the request completes on the
+    survivor, carrying its retry count into the Completion."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg,
+        faults=[_inj("crash@serve_step:2"), None],
+    )
+    comps = router.run([Request(id=0, prompt=prompts[1],
+                                max_new_tokens=6)])
+    [c] = comps
+    assert c.status == "ok" and c.retries == 1
+    assert c.tokens == oracle(
+        router.schedulers[1].engine.model, tiny_params, prompts[1], 6
+    )
+    assert reg.peek("serve.health.recovered").value == 1
+
+
+# ------------------------------------------------ probation / breaker
+def test_probation_circuit_breaker(make_model, tiny_params, prompts,
+                                   oracle):
+    """Revival runs behind the breaker: a revived replica takes no
+    RECOVERED work while on probation (fresh admissions only), and
+    graduates to full trust after the configured clean ticks."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg,
+        faults=[_inj("crash@serve_step:2"), _inj("crash@serve_step:3")],
+        probation_ticks=3, retry_budget=4,
+    )
+    router.submit(Request(id=0, prompt=prompts[0], max_new_tokens=8))
+    # Drive until replica 0 dies; its work lands on replica 1.
+    while not router.health.dead_replicas:
+        router.tick()
+    assert router.health.state(0) == "dead"
+    with pytest.raises(ValueError):
+        router.revive_replica(1, None)  # only DEAD replicas revive
+    router.revive_replica(0, _mk_engine(make_model, tiny_params,
+                                        capacity=1))
+    assert router.health.state(0) == "probation"
+    assert reg.peek("serve.health.probation").value == 1
+    # Now replica 1 dies too: the harvested entry must NOT land on the
+    # probation replica — it parks until somebody graduates.
+    while len(router.health.dead_replicas) < 1 or \
+            router.health.is_up(1):
+        if not router.tick():
+            break
+    assert not router.health.is_up(1)
+    assert router._recovered, "recovered work went to a probation replica"
+    assert all(
+        reps[-1] != 0 or len(reps) == 1
+        for reps in router.assignments.values()
+    )
+    # Clean ticks graduate the breaker; the parked entry then drains to
+    # the (now live) replica 0 and completes.
+    comps = router.run()
+    assert router.health.state(0) == "live"
+    assert reg.peek("serve.health.probation").value == 0
+    [c] = comps
+    assert c.status == "ok"
+    assert c.tokens == oracle(
+        router.schedulers[0].engine.model, tiny_params, prompts[0], 8
+    )
+
+
+def test_probation_reduced_weight_for_fresh_admissions(
+    make_model, tiny_params, prompts
+):
+    """A probation replica CAN take fresh admissions — but only at
+    reduced weight: with an equally-idle live replica it always ranks
+    behind."""
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=MetricsRegistry(),
+        faults=[_inj("crash@serve_step:1"), None],
+        probation_ticks=50,
+    )
+    router.run([Request(id=0, prompt=prompts[0], max_new_tokens=4)])
+    assert router.health.state(0) == "dead"
+    router.revive_replica(0, _mk_engine(make_model, tiny_params,
+                                        capacity=1))
+    ranked = router._ranked_replicas()
+    assert ranked and ranked[0] == 1, ranked  # live replica first
+    assert 0 in ranked                        # but probation is eligible
+    assert router._ranked_replicas(probation_ok=False) == [1]
+
+
+# ------------------------------------------------------------ deadline
+def test_deadline_cancels_slot_frees_blocks(make_model, tiny_params,
+                                            prompts):
+    """An over-deadline request is cancelled mid-stream: slot freed,
+    blocks released (drain leak check still zero), terminal
+    Completion(status="deadline") carrying the tokens generated before
+    the cut."""
+    eng = _mk_engine(make_model, tiny_params, capacity=2)
+    reg = MetricsRegistry()
+    sched = Scheduler(eng, registry=reg)
+    sched.submit(Request(id=0, prompt=prompts[0], max_new_tokens=64,
+                         deadline_ms=6e4))
+    sched.submit(Request(id=1, prompt=prompts[1], max_new_tokens=4))
+    # Serve a few iterations inside the (generous) deadline, then blow
+    # past it with the injectable clock.
+    for _ in range(6):
+        sched.tick()
+    assert any(s is not None for s in sched._slots)
+    sched.clock.skip_to(sched.clock.now() + 3600.0)
+    comps = sched.run()
+    by_id = {c.id: c for c in comps}
+    assert by_id[0].status == "deadline" and by_id[0].reason == "deadline"
+    assert 0 < len(by_id[0].tokens) < 64  # partial work preserved
+    assert by_id[1].status == "ok"
+    assert reg.peek("serve.health.deadline_cancels").value == 1
+    assert sched.memory.check_drained(eng) == 0
+
+
+def test_deadline_cancels_queued_entry(make_model, tiny_params, prompts):
+    """A queued (never-admitted) request past its deadline terminates
+    from the queue — it would only get staler waiting."""
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    sched.submit(Request(id=0, prompt=prompts[0], max_new_tokens=24))
+    sched.submit(Request(id=1, prompt=prompts[1], max_new_tokens=8,
+                         deadline_ms=0.01))
+    comps = sched.run()
+    by_id = {c.id: c for c in comps}
+    assert by_id[0].status == "ok"
+    assert by_id[1].status == "deadline" and by_id[1].tokens == []
+
+
+def test_deadline_env_default(make_model, tiny_params, prompts,
+                              monkeypatch):
+    """CMN_SERVE_DEADLINE_MS supplies the fleet-wide default for
+    requests that carry no deadline of their own."""
+    monkeypatch.setenv("CMN_SERVE_DEADLINE_MS", "0.01")
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    assert sched._default_deadline_ms == 0.01
+    sched.submit(Request(id=0, prompt=prompts[0], max_new_tokens=8))
+    sched.clock.skip_to(sched.clock.now() + 1.0)
+    [c] = sched.run()
+    assert c.status == "deadline"
+    monkeypatch.setenv("CMN_SERVE_DEADLINE_MS", "0")
+    sched2 = Scheduler(eng, registry=MetricsRegistry())
+    assert sched2._default_deadline_ms is None
+
+
+# ------------------------------------------------------- load shedding
+def test_shed_depth_bounds_holdback(make_model, tiny_params, prompts):
+    """CMN_ROUTER_SHED_DEPTH bounds the ARRIVED holdback queue: the
+    newest overflow requests terminate as shed (newest first), the
+    bounded rest all complete — exactly once each."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)],
+        registry=reg, max_queue=1, shed_depth=2,
+    )
+    n = 8
+    comps = router.run(_reqs(prompts, n, max_new=4))
+    report = verify_terminal_invariant(_reqs(prompts, n), comps)
+    assert report["holds"], report
+    assert report["by_status"]["shed"] == 5
+    assert report["by_status"]["ok"] == 3
+    # Newest first: the shed ids are the last-submitted ones.
+    shed_ids = sorted(c.id for c in comps if c.status == "shed")
+    assert shed_ids == [3, 4, 5, 6, 7]
+    assert reg.peek("serve.health.shed").value == 5
+    # Completed ones really ran; shed ones carry the refusal.
+    assert all(c.tokens for c in comps if c.status == "ok")
+    assert all("holdback" in c.error for c in comps
+               if c.status == "shed")
+
+
+def test_shed_disabled_by_default(make_model, tiny_params, prompts,
+                                  monkeypatch):
+    monkeypatch.delenv("CMN_ROUTER_SHED_DEPTH", raising=False)
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)],
+        registry=MetricsRegistry(), max_queue=1,
+    )
+    assert router.shed_depth == 0
+    comps = router.run(_reqs(prompts, 6, max_new=4))
+    assert all(c.status == "ok" for c in comps) and len(comps) == 6
+
+
+# ------------------------------------------------------- env / health
+def test_env_knob_parsing(monkeypatch):
+    from chainermn_tpu.serving import recovery
+
+    monkeypatch.setenv("CMN_SERVE_RETRY_BUDGET", "5")
+    monkeypatch.setenv("CMN_SERVE_PROBATION_TICKS", "9")
+    monkeypatch.setenv("CMN_ROUTER_SHED_DEPTH", "7")
+    assert recovery.retry_budget_from_env() == 5
+    assert recovery.probation_ticks_from_env() == 9
+    assert recovery.shed_depth_from_env() == 7
+    monkeypatch.setenv("CMN_SERVE_RETRY_BUDGET", "junk")
+    assert recovery.retry_budget_from_env() == 2  # default
+    monkeypatch.delenv("CMN_SERVE_RETRY_BUDGET")
+    monkeypatch.delenv("CMN_SERVE_PROBATION_TICKS")
+    monkeypatch.delenv("CMN_ROUTER_SHED_DEPTH")
+    h = FleetHealth(2)
+    assert h.retry_budget == 2 and h.probation_ticks == 32
+
+
+def test_fleet_health_state_machine():
+    reg = MetricsRegistry()
+    h = FleetHealth(2, registry=reg, probation_ticks=2)
+    assert h.state(0) == "live" and h.is_up(0)
+    h.mark_dead(0, "boom")
+    assert not h.is_up(0) and h.dead_replicas == [0]
+    assert h.errors[0] == "boom"
+    assert reg.peek("serve.health.replica_dead").value == 1
+    with pytest.raises(ValueError):
+        h.start_probation(1)  # live replica cannot enter probation
+    h.start_probation(0)
+    assert h.in_probation(0) and h.is_up(0)
+    assert not h.clean_tick(0)          # 1 of 2
+    assert h.clean_tick(0)              # graduated
+    assert h.state(0) == "live"
+    assert reg.peek("serve.health.probation").value == 0
+
+
+# ------------------------------------------------ default incident rules
+@pytest.mark.parametrize("rule_name,metric", [
+    ("replica_dead", "serve.health.replica_dead"),
+    ("poison_request", "serve.health.poisoned"),
+])
+def test_failure_plane_default_incident_rules(tmp_path, rule_name,
+                                              metric):
+    """CI/tooling satellite pin (like ``router_backlog``): the shipped
+    rule set watches the failure plane's counters as CRITICAL
+    key_by_value rules, and a breach files a bundle naming the rule."""
+    from chainermn_tpu.observability.incident import (
+        IncidentManager,
+        default_rules,
+    )
+
+    rules = [r for r in default_rules() if r.name == rule_name]
+    assert rules and rules[0].metric == metric
+    assert rules[0].severity == "critical"
+    assert rules[0].key_by_value  # each additional death/quarantine files
+    reg = MetricsRegistry()
+    mgr = IncidentManager(
+        registry=reg, rules=rules, directory=str(tmp_path),
+        cooldown_s=0.0,
+    )
+    assert mgr.evaluate() == []  # healthy: counter never incremented
+    reg.counter(metric).inc()
+    fired = mgr.evaluate()
+    assert len(fired) == 1 and fired[0]["rule"]["name"] == rule_name
+    assert mgr.evaluate() == []  # latched
+    reg.counter(metric).inc()    # a SECOND death is a new incident
+    assert len(mgr.evaluate()) == 1
+
+
+def test_replica_death_files_incident_bundle(make_model, tiny_params,
+                                             prompts, tmp_path):
+    """End-to-end: the router's fault boundary evaluates the incident
+    plane at the moment of death — the critical ``replica_dead`` rule
+    captures exactly one bundle for the one death."""
+    from chainermn_tpu.observability.incident import (
+        IncidentManager,
+        default_rules,
+    )
+
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg,
+        faults=[_inj("crash@serve_step:2"), None],
+    )
+    router.incidents = IncidentManager(
+        registry=reg,
+        rules=[r for r in default_rules()
+               if r.name in ("replica_dead", "poison_request")],
+        directory=str(tmp_path), cooldown_s=0.0,
+    )
+    comps = router.run(_reqs(prompts, 3, max_new=4))
+    assert len(comps) == 3 and all(c.status == "ok" for c in comps)
+    bundles = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert len(bundles) == 1 and "replica_dead" in bundles[0], bundles
+
+
+# --------------------------------------------------- drop@migrate wire
+def test_drop_migrate_redispatch_detected_and_retried(
+    make_model, tiny_params, prompts, oracle
+):
+    """A recovery re-dispatch frame lost on the wire (drop@migrate) is
+    detected immediately — the entry never left the router — and
+    retried: the request still completes, the retry is counted."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg,
+        faults=[_inj("crash@serve_step:2"), None],
+        fault=_inj("drop@migrate:1"),
+    )
+    comps = router.run([Request(id=0, prompt=prompts[2],
+                                max_new_tokens=6)])
+    [c] = comps
+    assert c.status == "ok"
+    assert c.tokens == oracle(
+        router.schedulers[1].engine.model, tiny_params, prompts[2], 6
+    )
+    # 1 harvest increment + 1 dropped-frame retry.
+    assert reg.peek("serve.health.retries").value == 2
+    assert reg.peek("serve.health.recovered").value == 1

@@ -184,6 +184,130 @@ def test_router_backlog_default_incident_rule(tmp_path):
     assert mgr.evaluate() == []   # clean evaluation re-arms quietly
 
 
+def test_crash_mid_stream_recovers_on_survivor(make_model, tiny_params,
+                                               prompts, oracle):
+    """Crash-mid-stream recovery oracle (ISSUE 15): replica 0 dies at
+    its 3rd decode iteration; its queued entries AND live slots are
+    harvested and every request still completes — recovered
+    continuations greedy-identical to the unfaulted twin — while the
+    survivor's decode step never recompiles."""
+    from chainermn_tpu.resilience.faults import (
+        FaultInjector,
+        parse_fault_spec,
+    )
+
+    router, reg = _mk_router(
+        make_model, tiny_params, capacity=2,
+        faults=[
+            FaultInjector(parse_fault_spec("crash@serve_step:3")), None,
+        ],
+    )
+    n = 4
+    comps = router.run(_reqs(prompts, n, max_new=6))
+    assert sorted(c.id for c in comps) == list(range(n))
+    assert all(c.status == "ok" for c in comps)
+    assert router.health.state(0) == "dead"
+    assert router.health.state(1) == "live"
+    # The fault boundary harvested real mid-stream work: at least one
+    # completion rode a recovery re-dispatch (retries stamped through).
+    assert any(c.retries == 1 for c in comps), [
+        (c.id, c.retries) for c in comps
+    ]
+    assert reg.peek("serve.health.replica_dead").value == 1
+    assert reg.peek("serve.health.recovered").value >= 1
+    # Greedy-identical to the unfaulted twin — recompute-requeue
+    # discipline — and the one-compile contract holds on the survivor.
+    survivor = router.schedulers[1]
+    for c in comps:
+        assert c.tokens == oracle(
+            survivor.engine.model, tiny_params,
+            prompts[c.id % len(prompts)], 6,
+        ), (c.id, c.retries)
+    assert survivor.engine.decode_compiles == 1
+
+
+def test_dispatch_pool_exhausted_is_replicas_problem(make_model,
+                                                     tiny_params):
+    """Satellite fix (ISSUE 15): a replica-side ``PoolExhausted`` at
+    dispatch is THAT replica's problem — it is excluded for the pick
+    and the next candidate tried, instead of the exception propagating
+    and killing the router loop.  One tiny-pool replica + one normal
+    replica: the oversized request lands on the big one."""
+    from chainermn_tpu.serving import PoolExhausted
+
+    tiny = DecodeEngine(
+        make_model(), tiny_params, capacity=1, num_blocks=6,
+        block_len=8, prefill_chunk=8,
+    )
+    big = DecodeEngine(
+        make_model(), tiny_params, capacity=1, num_blocks=24,
+        block_len=8, prefill_chunk=8,
+    )
+    # Tiny is replica 0: both idle, the load tie breaks by index, so
+    # dispatch genuinely TRIES the tiny replica first and must recover
+    # from its refusal.
+    router = Router([tiny, big], registry=MetricsRegistry())
+    req = Request(
+        id=0, prompt=[i % 127 + 1 for i in range(40)],
+        max_new_tokens=16,
+    )
+    with pytest.raises(PoolExhausted):
+        router.schedulers[0].check_fit(req)  # really cannot hold it
+    router.schedulers[1].check_fit(req)      # really can
+    [c] = router.run([req])
+    assert c.status == "ok" and len(c.tokens) == 16
+    assert router.assignments[0] == [1], router.assignments
+    # Exclusion, not death: the misfit replica stays live and serves
+    # work it CAN hold.
+    assert router.health.state(0) == "live"
+    comps = router.run([Request(id=1, prompt=[5, 6, 7],
+                                max_new_tokens=4)])
+    [c2] = [c for c in comps if c.id == 1]
+    assert c2.status == "ok"
+    assert router.assignments[1] == [0]
+
+
+def test_harvested_entry_unfit_anywhere_terminates_poisoned(
+    make_model, tiny_params
+):
+    """Terminal-invariant hole (review fix): a harvested entry that NO
+    surviving replica's pool geometry can ever hold must terminate as
+    poisoned — the same verdict the fresh-dispatch path reaches —
+    instead of parking in ``_recovered`` forever and deadlocking
+    ``run()``.  Heterogeneous fleet: the only replica big enough for
+    the request crashes mid-stream."""
+    from chainermn_tpu.resilience.faults import (
+        FaultInjector,
+        parse_fault_spec,
+    )
+
+    tiny = DecodeEngine(
+        make_model(), tiny_params, capacity=1, num_blocks=6,
+        block_len=8, prefill_chunk=8,
+    )
+    big = DecodeEngine(
+        make_model(), tiny_params, capacity=1, num_blocks=24,
+        block_len=8, prefill_chunk=8,
+    )
+    reg = MetricsRegistry()
+    router = Router(
+        [tiny, big], registry=reg,
+        faults=[
+            None, FaultInjector(parse_fault_spec("crash@serve_step:2")),
+        ],
+    )
+    req = Request(
+        id=0, prompt=[i % 127 + 1 for i in range(40)],
+        max_new_tokens=16,
+    )
+    [c] = router.run([req])
+    assert c.status == "poisoned" and c.retries == 1
+    assert "PoolExhausted on every surviving replica" in c.error
+    assert not router._recovered
+    assert router.health.state(1) == "dead"
+    assert reg.peek("serve.health.poisoned").value == 1
+
+
 def test_scheduler_tick_refactor_equivalence(make_model, tiny_params,
                                              prompts, oracle):
     """run() is now a tick() loop: driving the SAME scheduler manually

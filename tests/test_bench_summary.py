@@ -83,6 +83,29 @@ assert "incident_newest" not in fit
 assert fit["metric"] == "m" and fit["value"] == 1.0
 assert fit["perf_sentinel"] == {"verdict": "green", "series": 3}
 assert fit["incident_count"] == 2
+
+# Chaos pointer (ISSUE 15): present only when the serving headline
+# carries the chaos arm — compact verdict + recovered/poisoned/shed
+# counts — and it rides the _fit_summary droppable list (shed under
+# byte pressure before the verdict scalars).
+srv = {"tokens_per_sec": 9.9, "speedup_vs_static": 1.6,
+       "chaos_invariant_holds": True, "chaos_recovered": 3,
+       "chaos_poisoned": 1, "chaos_shed": 2,
+       "artifact": "result/serving_tpu.json", **blob}
+ok3 = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv, None,
+)
+assert len(json.dumps(ok3)) <= bench.SUMMARY_MAX_BYTES
+assert ok3["chaos"] == {"invariant_holds": True, "recovered": 3,
+                        "poisoned": 1, "shed": 2}, ok3
+fat2 = dict(fat)
+fat2["chaos"] = {"invariant_holds": True,
+                 "note": "y" * 1500}  # oversized: must shed
+fit2 = bench._fit_summary(fat2)
+assert len(json.dumps(fit2)) <= bench.SUMMARY_MAX_BYTES
+assert "chaos" not in fit2
+assert fit2["metric"] == "m" and fit2["value"] == 1.0
 print("SUMMARY-OK", len(line), len(line2))
 """
 
