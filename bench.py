@@ -270,6 +270,17 @@ def _serving_headline() -> dict | None:
             "chaos_replica_dead": rec.get(
                 "chaos", {}
             ).get("replica_dead"),
+            # Multi-tenant metering arm (ISSUE 16), when the artifact
+            # carries it: the top consumer's share of fleet
+            # block-seconds and the usage ledger's exact-conservation
+            # verdict.
+            "tenant_top_share": rec.get(
+                "tenants", {}
+            ).get("tenant_top_share"),
+            "tenant_conservation_holds": rec.get(
+                "tenants", {}
+            ).get("conservation_holds"),
+            "tenant_count": rec.get("tenants", {}).get("tenants"),
         }
 
     return _best_result("serving*.json", cands)
@@ -408,6 +419,12 @@ def _summary_line(payload: dict, lm=None, dec=None, srv=None,
             "poisoned": srv.get("chaos_poisoned"),
             "shed": srv.get("chaos_shed"),
         }
+    # Tenant-arm pointer (ISSUE 16): the top consumer's block-second
+    # share, present only when the serving artifact carries the
+    # multi-tenant metering arm (the conservation verdict and per-tenant
+    # table ride the composite line's serving_headline).
+    if srv is not None and srv.get("tenant_top_share") is not None:
+        summary["tenant_top_share"] = srv["tenant_top_share"]
     # Artifact POINTERS, not payloads: the full headline dicts ride the
     # composite line above; the tail line names where each number came
     # from so a consumer can open the file.
@@ -472,6 +489,7 @@ def _fit_summary(summary: dict) -> dict:
     if isinstance(summary.get("error"), str):
         summary["error"] = summary["error"][:80]
     for k in ("incident_newest", "serving_tpu_probe", "chaos",
+              "tenant_top_share",
               "router_tokens_per_sec", "cache_source_commit",
               "serving_artifact", "decode_artifact", "lm_artifact",
               "cache_age_hours", "incident_count", "perf_sentinel",

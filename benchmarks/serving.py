@@ -57,6 +57,12 @@ circuit breaker — reports the terminal-invariant verdict (every
 submitted request terminates exactly once) and the ``serve.health.*``
 counters (replica_dead / recovered / poisoned / shed).
 
+``--tenants N`` (ISSUE 16) runs the multi-tenant metering arm: the same
+traffic labeled across N tenants with Zipf-distributed popularity
+through a router whose usage ledger is on — per-tenant tokens/s and
+block-second shares, the top-consumer share, and the exact-conservation
+verdict.
+
     python benchmarks/serving.py --out result/serving_tpu.json  # real chip
     JAX_PLATFORMS=cpu python benchmarks/serving.py --smoke      # plumbing
 """
@@ -180,6 +186,16 @@ def main():
                          "drop@migrate) with probation revivals; "
                          "reports the terminal-invariant verdict and "
                          "the serve.health.* counters")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="also run the MULTI-TENANT metering arm "
+                         "(ISSUE 16): the same traffic shape with "
+                         "requests labeled across N tenants "
+                         "(Zipf(--zipf-a) popularity — a few tenants "
+                         "dominate, the realistic skew) through a "
+                         "router with the usage ledger on; reports "
+                         "per-tenant tokens/s and block-second shares, "
+                         "the top-consumer share, and the conservation "
+                         "verdict (0 = skip)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--trace-out", default=None,
@@ -225,6 +241,7 @@ def main():
             d_ff=1024, vocab=4096, block_len=8, prefill_chunk=16,
             repeats=4, obs_pairs=12, prefix_reuse=4, spec_k=3,
             draft_layers=1, replicas=2, disagg=True, chaos=True,
+            tenants=3,
         )
         for k, v in smoke_over.items():
             if getattr(args, k) == ap.get_default(k):
@@ -1088,6 +1105,73 @@ def main():
         }
         del harness, router
 
+    # ------------------------------------------------------ tenants arm
+    # Multi-tenant metering (ISSUE 16): the same traffic labeled across
+    # N tenants with Zipf-distributed popularity (a couple of tenants
+    # dominate — the skew a quota system must survive) through a router
+    # whose fleet-wide usage ledger is ON.  Reuses the warmed continuous
+    # engine: the arm's subject is attribution, not throughput.  The
+    # headline is ``tenant_top_share`` (the top consumer's fraction of
+    # fleet block-seconds — the scarce resource) plus the conservation
+    # verdict: per-tenant sums equal fleet totals EXACTLY, every request
+    # finalized exactly once.
+    tenant_payload = None
+    if args.tenants:
+        from chainermn_tpu.observability.metrics import MetricsRegistry
+        from chainermn_tpu.serving import Router
+
+        n_t = args.tenants
+        t_ranks = np.arange(1, n_t + 1, dtype=np.float64)
+        pt = t_ranks ** -args.zipf_a
+        pt /= pt.sum()
+        tn_n = min(args.requests, 32)
+        assign = rng.choice(n_t, size=tn_n, p=pt)
+        eng.drop_prefix_cache()
+        tn_reg = MetricsRegistry()
+        tn_router = Router([eng], registry=tn_reg)
+        tn_reqs = [
+            Request(id=70_000 + i, prompt=prompts[i].tolist(),
+                    max_new_tokens=min(int(new_counts[i]), 24),
+                    arrival=float(arrivals[i]),
+                    tenant=f"tenant{int(assign[i])}")
+            for i in range(tn_n)
+        ]
+        tn_cs = tn_router.run(tn_reqs)
+        tn_span = (
+            max(c.finished_at for c in tn_cs)
+            - min(c.arrival for c in tn_cs)
+        )
+        led = tn_router.ledger
+        cons = led.verify_conservation(requests=tn_reqs)
+        t_agg = led.aggregate()
+        fleet_block_us = max(led.totals["block_us"], 1)
+        tenant_payload = {
+            "tenants": n_t,
+            "zipf_a": args.zipf_a,
+            "requests": tn_n,
+            "conservation_holds": cons["holds"],
+            # Top consumer's share of fleet block-seconds — also
+            # published live as the serve.tenant.top_share gauge.
+            "tenant_top_share": round(
+                max(t["block_us"] for t in t_agg.values())
+                / fleet_block_us, 4,
+            ),
+            "top": led.top(3),
+            "per_tenant": {
+                name: {
+                    "requests": t["requests"],
+                    "tokens": t["tokens"],
+                    "tokens_per_sec": round(t["tokens"] / tn_span, 1),
+                    "block_seconds": round(t["block_us"] / 1e6, 4),
+                    "block_second_share": round(
+                        t["block_us"] / fleet_block_us, 4
+                    ),
+                }
+                for name, t in sorted(t_agg.items())
+            },
+        }
+        del tn_router
+
     payload = {
         "metric": "serving_tokens_per_sec",
         "value": round(cont_tps, 1),
@@ -1178,6 +1262,8 @@ def main():
         payload["disagg"] = disagg_payload
     if chaos_payload is not None:
         payload["chaos"] = chaos_payload
+    if tenant_payload is not None:
+        payload["tenants"] = tenant_payload
     print(json.dumps(payload))
     if args.out:
         from chainermn_tpu.utils import atomic_json_dump

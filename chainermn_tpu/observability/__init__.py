@@ -63,6 +63,12 @@ Four cooperating pieces, all default-on and all bounded:
   per-run cap, cross-plane debug bundles captured at fire time
   (``incident.*``; ``CMN_OBS_INCIDENT_*``), and the offline postmortem
   analyzer ``python -m chainermn_tpu.observability.incident report``.
+* :mod:`~chainermn_tpu.observability.ledger` — the usage ledger
+  (ISSUE 16): per-request :class:`~chainermn_tpu.observability.ledger.
+  UsageRecord` cost attribution + per-tenant metering with an exact
+  conservation invariant (``serve.tenant.*``; ``CMN_OBS_LEDGER*``);
+  :mod:`~chainermn_tpu.observability.usage` is its offline analyzer
+  (``python -m chainermn_tpu.observability.usage report``).
 
 Env knobs (see ``docs/observability.md`` for the full table):
 
@@ -165,6 +171,12 @@ from chainermn_tpu.observability.device import (  # noqa: E402
     signature_diff,
     watch,
 )
+from chainermn_tpu.observability.ledger import (  # noqa: E402
+    USAGE_SCHEMA,
+    CostLedger,
+    UsageRecord,
+    ledger_enabled,
+)
 
 __all__ = [
     "enabled",
@@ -212,4 +224,8 @@ __all__ = [
     "roofline",
     "signature_diff",
     "watch",
+    "USAGE_SCHEMA",
+    "CostLedger",
+    "UsageRecord",
+    "ledger_enabled",
 ]

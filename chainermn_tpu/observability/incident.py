@@ -88,7 +88,7 @@ HEADLINE_SIGNALS = (
     "serve.slo.p95_drift", "serve.slo.ttft.p95_ms",
     "serve.slo.queue_wait.p95_ms", "serve.slo.token.p95_ms",
     "serve.queue_depth", "serve.slot_occupancy",
-    "serve.migration.failed",
+    "serve.migration.failed", "serve.tenant.top_share",
     "fleet.straggler_rank", "fleet.straggler_stall_ms",
     "fleet.clock_rtt_ms",
     "compile.count", "compile.budget_exceeded",

@@ -168,6 +168,10 @@ def _pack_entry(entry: _QueueEntry) -> dict:
             "temperature": r.temperature, "eos_token": r.eos_token,
             "arrival": r.arrival, "seed": r.seed,
             "deadline_ms": r.deadline_ms,
+            # Additive (like deadline_ms / retries were): a frame from
+            # a pre-ISSUE-16 sender simply lacks the key and Request's
+            # dataclass default fills "default" at unpack.
+            "tenant": r.tenant,
         },
         "carried": list(entry.carried),
         "evictions": entry.evictions,
@@ -213,6 +217,16 @@ def pack_slots(sched: Scheduler, slots: Sequence[_Slot]) -> dict:
         for b in slot.blocks:
             if b not in blocks:
                 blocks[b] = eng.read_block(b)
+        if sched.ledger is not None:
+            # Booked at pack (the send side — once per migration): each
+            # slot pays for ITS blocks' bytes, shared blocks charged to
+            # every referencing slot (pinner-pays, same stance as
+            # block-seconds) — so the ledger total can exceed the
+            # deduped wire bytes ``serve.migration.bytes`` counts.
+            sched.ledger.book(
+                slot.entry.req.id, "migration_bytes",
+                sum(_block_nbytes(blocks[b]) for b in slot.blocks),
+            )
         recs.append({
             **_pack_entry(slot.entry),
             "generated": list(slot.generated),
@@ -223,19 +237,23 @@ def pack_slots(sched: Scheduler, slots: Sequence[_Slot]) -> dict:
     return {"slots": recs, "entries": [], "blocks": blocks}
 
 
+def _block_nbytes(data: dict) -> int:
+    """KV bytes one packed block carries (target + draft pools)."""
+    total = 0
+    for pool in ("target", "draft"):
+        if data.get(pool) is None:
+            continue
+        for layer in data[pool]:
+            for arr in layer.values():
+                total += arr.nbytes
+    return total
+
+
 def payload_bytes(body: dict) -> int:
     """KV bytes a migration body moves (the ``serve.migration.bytes``
     feed) — block array bytes only; the host-side slot records are
     noise next to them."""
-    total = 0
-    for data in body["blocks"].values():
-        for pool in ("target", "draft"):
-            if data.get(pool) is None:
-                continue
-            for layer in data[pool]:
-                for arr in layer.values():
-                    total += arr.nbytes
-    return total
+    return sum(_block_nbytes(d) for d in body["blocks"].values())
 
 
 def _crc(body: dict) -> int:
@@ -264,6 +282,13 @@ def detach_slots(sched: Scheduler, slots: Sequence[_Slot]) -> None:
             continue
         sched.engine.release_blocks(slot.blocks)
         sched._slots[slot.idx] = None
+        if sched.ledger is not None:
+            # Settle source-side occupancy; the install restarts the
+            # integral at the destination (a fleet-shared ledger sees a
+            # clean handoff; role-split ledgers each stay consistent).
+            sched.ledger.set_blocks(
+                slot.entry.req.id, 0, sched.clock.now()
+            )
         if sched.timeline is not None:
             sched.timeline.record(
                 "migrate_out", t=sched.clock.now(),
@@ -351,6 +376,15 @@ def install_payload(sched: Scheduler, body: dict, defer: bool = False
         slot.last_token = int(rec["last_token"])
         slot.prefilling = False
         sched._slots[free[0]] = slot
+        if sched.ledger is not None:
+            # begin() is idempotent: on a fleet-shared ledger the record
+            # exists; a role-split destination with its own ledger opens
+            # one here (tenant rides the codec).  Occupancy integration
+            # restarts at the installed block count.
+            sched.ledger.begin(entry.req, now)
+            sched.ledger.set_blocks(
+                entry.req.id, len(slot.blocks), now
+            )
         eng.seed_slot(free[0], entry.req.seed, entry.req.temperature)
         if eng.prefix is not None:
             # Positions [0, pos) are written — same insertable span as
@@ -615,6 +649,12 @@ def drain_all(sched: Scheduler, transport: MigrationTransport,
         if slot is not None:
             sched.engine.release_blocks(slot.blocks)
             sched._slots[i] = None
+            if sched.ledger is not None:
+                # Still-prefilling slots drained as recompute entries:
+                # settle their occupancy at release like any eviction.
+                sched.ledger.set_blocks(
+                    slot.entry.req.id, 0, sched.clock.now()
+                )
     if eof:
         for d in dict.fromkeys([dest, *eof_ranks]):
             transport.send_eof(d)

@@ -101,7 +101,8 @@ class Router:
                  fault=None,
                  retry_budget: Optional[int] = None,
                  probation_ticks: Optional[int] = None,
-                 shed_depth: Optional[int] = None):
+                 shed_depth: Optional[int] = None,
+                 ledger=None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability.metrics import (
             DEFAULT_MS_EDGES,
@@ -156,10 +157,32 @@ class Router:
                 f"faults ({len(faults)}) must match engines "
                 f"({len(engines)})"
             )
+        #: Usage ledger (ISSUE 16): ONE fleet ledger shared by every
+        #: replica (revivals included), so a request migrated or
+        #: harvested across replicas keeps one record and per-tenant
+        #: sums stay fleet-coherent.  Explicit wins; otherwise
+        #: construction follows the router's own publishing latch
+        #: (explicit registry always, ``None`` rides the ``CMN_OBS``
+        #: master switch) gated by ``CMN_OBS_LEDGER``.  The resolved
+        #: decision is FORCED onto every replica (``False`` = off) —
+        #: a replica must never self-build a private ledger the fleet
+        #: books would then miss.
+        from chainermn_tpu.observability import ledger as _oledger
+
+        if ledger is not None:
+            self.ledger = ledger
+        elif (registry is not None or _obs.enabled()) \
+                and _oledger.ledger_enabled():
+            self.ledger = _oledger.CostLedger(registry=registry)
+        else:
+            self.ledger = None
         self.schedulers: List[Scheduler] = [
             Scheduler(
                 eng, registry=reg, clock=self.clock,
                 timeline=RequestTimeline(ring=ring), fault=fi,
+                ledger=(
+                    self.ledger if self.ledger is not None else False
+                ),
             )
             for eng, reg, ring, fi in zip(
                 engines, self.replica_registries, self.rings, faults
@@ -269,6 +292,11 @@ class Router:
             try:
                 self.schedulers[i].check_fit(req)
                 self._queue.append(req)
+                if self.ledger is not None:
+                    # The record opens when the fleet ACCEPTS the
+                    # request — a later shed/poison terminal still
+                    # finalizes it (conservation counts holdback too).
+                    self.ledger.begin(req, self.clock.now())
                 return
             except PoolExhausted as e:
                 err = e
@@ -428,9 +456,13 @@ class Router:
                           error: Optional[str] = None) -> None:
         """A never-admitted router-queue request terminates here (shed,
         or unservable-anywhere): one definite Completion."""
-        self._router_completions.append(terminal_completion(
-            _QueueEntry(req=req), status, self.clock.now(), error=error,
-        ))
+        now = self.clock.now()
+        comp = terminal_completion(
+            _QueueEntry(req=req), status, now, error=error,
+        )
+        if self.ledger is not None:
+            comp.usage = self.ledger.finalize(req.id, status, now)
+        self._router_completions.append(comp)
 
     def _rebalance(self) -> bool:
         """Steal arrived queued work from a replica whose slots are all
@@ -511,6 +543,10 @@ class Router:
             entry.retries += 1
             entry.last_error = err
             self.health.m_retries.inc()
+            if self.ledger is not None:
+                # The harvest already settled block occupancy and booked
+                # the eviction; the DEATH itself books here.
+                self.ledger.book(entry.req.id, "retries", 1)
             if entry.retries >= self.health.retry_budget:
                 self._quarantine(entry, err)
             else:
@@ -523,9 +559,13 @@ class Router:
             self.incidents.evaluate()
 
     def _quarantine(self, entry, err: str) -> None:
-        self._router_completions.append(terminal_completion(
-            entry, "poisoned", self.clock.now(), error=err,
-        ))
+        now = self.clock.now()
+        comp = terminal_completion(entry, "poisoned", now, error=err)
+        if self.ledger is not None:
+            comp.usage = self.ledger.finalize(
+                entry.req.id, "poisoned", now
+            )
+        self._router_completions.append(comp)
         self.health.m_poisoned.inc()
 
     def _redispatch(self, entry) -> bool:
@@ -620,6 +660,7 @@ class Router:
         self.schedulers[i] = Scheduler(
             engine, registry=reg, clock=self.clock,
             timeline=RequestTimeline(ring=ring), fault=fault,
+            ledger=self.ledger if self.ledger is not None else False,
         )
         self._since_gauge[i] = 0
         self.health.start_probation(i)

@@ -141,6 +141,11 @@ class Request:
     #: defers to the fleet-wide ``CMN_SERVE_DEADLINE_MS`` default
     #: (itself off unless set).
     deadline_ms: Optional[float] = None
+    #: tenant label for cost attribution (ISSUE 16): the usage ledger
+    #: aggregates per-tenant totals under it (``serve.tenant.*``).
+    #: Additive like ``deadline_ms`` — old callers and pre-ISSUE-16
+    #: ``cmn-kvmig-1`` frames default to ``"default"``.
+    tenant: str = "default"
 
 
 @dataclass
@@ -184,6 +189,13 @@ class Completion:
     #: replica deaths this request was harvested from (recovery
     #: re-dispatch count — see ``CMN_SERVE_RETRY_BUDGET``).
     retries: int = 0
+    #: the finalized :class:`~chainermn_tpu.observability.ledger.
+    #: UsageRecord` for this request (ISSUE 16) — per-tenant cost
+    #: attribution (prefill/decode/block-seconds/migration/retries).
+    #: ``None`` when the ledger is off (``CMN_OBS_LEDGER=0`` or
+    #: observability disabled); additive, so every existing constructor
+    #: and the disagg/recovery paths stay green.
+    usage: Optional[object] = None
 
 
 @dataclass
@@ -278,7 +290,8 @@ class Scheduler:
 
     def __init__(self, engine, registry=None, clock: Optional[_Clock] = None,
                  slo=None, timeline=None, memory=None, incidents=None,
-                 fault=None, deadline_ms: Optional[float] = None):
+                 fault=None, deadline_ms: Optional[float] = None,
+                 ledger=None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability import flight as _flight
         from chainermn_tpu.observability import tracing as _tracing
@@ -365,6 +378,26 @@ class Scheduler:
         self.prefix_hit_tokens = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
+        #: Usage ledger (ISSUE 16): an explicit ledger always wins — the
+        #: router passes ONE fleet ledger into every replica (revivals
+        #: included) so a request migrated or harvested across replicas
+        #: keeps one record — and ``ledger=False`` forces OFF (the
+        #: router's obs-off/CMN_OBS_LEDGER=0 decision must not be
+        #: overridden by a replica self-building against its private
+        #: registry); otherwise cost attribution follows the scheduler's
+        #: publishing decision, gated by ``CMN_OBS_LEDGER``.  Pure
+        #: host-side dict arithmetic — never a device sync, so the
+        #: one-compile contract and the obs overhead budget hold.
+        from chainermn_tpu.observability import ledger as _oledger
+
+        if ledger is False:
+            self.ledger = None
+        elif ledger is not None:
+            self.ledger = ledger
+        elif reg is not None and _oledger.ledger_enabled():
+            self.ledger = _oledger.CostLedger(registry=reg)
+        else:
+            self.ledger = None
         #: SLO monitor: an explicit one always wins; otherwise it shares
         #: the scheduler's publishing decision (same registry, no-op
         #: when the master switch turned metrics off).
@@ -419,6 +452,18 @@ class Scheduler:
                     else {"released": True}
                 ),
             )
+            # Usage snapshot (ISSUE 16): a bundle names who was hogging
+            # — per-tenant totals + top consumers — at fire time.
+            if self.ledger is not None:
+                self.incidents.register_source(
+                    "usage",
+                    lambda: (
+                        s.ledger.usage_state()
+                        if (s := _iref()) is not None
+                        and s.ledger is not None
+                        else {"released": True}
+                    ),
+                )
         #: Device-plane roofline gauges (PR 11): on the same cadence as
         #: the memory sample, publish achieved TFLOP/s / MFU / arithmetic
         #: intensity for the engine's HOT program (decode step or
@@ -475,6 +520,8 @@ class Scheduler:
         never fit the pool/slot geometry even running alone."""
         self.check_fit(req)
         self._queue.append(_QueueEntry(req))
+        if self.ledger is not None:
+            self.ledger.begin(req, self.clock.now())
         if self.timeline is not None:
             # Stamped at the request's logical availability (its arrival
             # on the scheduler clock) — the same origin the queue-wait
@@ -550,6 +597,11 @@ class Scheduler:
         validated at the original :meth:`submit` (homogeneous
         replicas)."""
         self._queue.append(entry)
+        if self.ledger is not None:
+            # Idempotent by id: on the fleet-shared ledger the record
+            # already exists; a role-split destination with its own
+            # ledger opens one here (tenant rides the codec).
+            self.ledger.begin(entry.req, self.clock.now())
         if self.timeline is not None:
             self.timeline.record(
                 "submit", t=self.clock.now(), req=entry.req.id,
@@ -604,6 +656,12 @@ class Scheduler:
                 list(slot.entry.carried) + list(slot.generated)
             )
             slot.entry.evictions += 1
+            if self.ledger is not None:
+                # The dead engine's blocks are garbage, but their
+                # occupancy UNTIL NOW was real — settle the integral,
+                # book the recompute-requeue.
+                self.ledger.set_blocks(slot.entry.req.id, 0, now)
+                self.ledger.book(slot.entry.req.id, "evictions", 1)
             self._slots[slot.idx] = None
             out.append(slot.entry)
             if self.timeline is not None:
@@ -625,6 +683,8 @@ class Scheduler:
         be off the queue and out of any slot."""
         now = self.clock.now()
         comp = terminal_completion(entry, status, now, error=error)
+        if self.ledger is not None:
+            comp.usage = self.ledger.finalize(entry.req.id, status, now)
         self.completions.append(comp)
         if self.timeline is not None:
             self.timeline.record(
@@ -761,6 +821,11 @@ class Scheduler:
                 self.slo.observe(
                     "queue_wait", (now - entry.req.arrival) * 1e3
                 )
+            if self.ledger is not None:
+                # First admission FLEET-WIDE: first_admit rides the
+                # migration codec, so re-admissions (eviction, harvest,
+                # disagg install) never re-book queue wait.
+                self.ledger.admitted(entry.req.id, now)
         slot = _Slot(free[0], entry, eng.max_blocks, now,
                      self._admit_seq)
         self._admit_seq += 1
@@ -785,6 +850,14 @@ class Scheduler:
                     slot.cow_idx = matched // BL
                 entry.prefix_hit_tokens += matched
                 self._m_px_hit.inc(matched)
+                if self.ledger is not None:
+                    # Credit/charge split: the SAVED tokens credit the
+                    # hitting request; the mapped blocks' pool pressure
+                    # charges it too (set_blocks below counts borrowed
+                    # references — the pinner pays for occupancy).
+                    self.ledger.book(
+                        entry.req.id, "prefix_hit_tokens", matched
+                    )
             self._m_px_lookups.inc()
             self.prefix_lookup_tokens += len(text)
             self.prefix_hit_tokens += matched
@@ -793,6 +866,13 @@ class Scheduler:
                 / max(self.prefix_lookup_tokens, 1)
             )
             self._m_px_cached.set(eng.prefix.cached_blocks)
+        if self.ledger is not None:
+            # Occupancy integration starts at admission — shared prefix
+            # blocks included (each referencing slot pays full freight;
+            # sharing saves COMPUTE, the pool pressure is real).
+            self.ledger.set_blocks(
+                entry.req.id, len(slot.blocks), now
+            )
         self.engine.seed_slot(free[0], entry.req.seed,
                               entry.req.temperature)
         if self.timeline is not None:
@@ -879,6 +959,13 @@ class Scheduler:
         victim.entry.evictions += 1
         self._queue.insert(0, victim.entry)
         self._slots[victim.idx] = None
+        if self.ledger is not None:
+            # Settle the occupancy integral at release; the re-admission
+            # restarts it (recompute cost books as fresh prefill tokens).
+            self.ledger.set_blocks(
+                victim.entry.req.id, 0, self.clock.now()
+            )
+            self.ledger.book(victim.entry.req.id, "evictions", 1)
         if self.timeline is not None:
             self.timeline.record(
                 "evict", t=self.clock.now(), req=victim.entry.req.id,
@@ -922,6 +1009,7 @@ class Scheduler:
 
     def _alloc_for(self, slot: _Slot, n_needed: int) -> None:
         """Grow ``slot`` to ``n_needed`` blocks, evicting under pressure."""
+        grew = False
         while len(slot.blocks) < n_needed:
             got = self._alloc_blocks(slot, n_needed - len(slot.blocks))
             if got is None:
@@ -929,6 +1017,13 @@ class Scheduler:
             for b in got:
                 slot.table[len(slot.blocks)] = b
                 slot.blocks.append(b)
+            grew = True
+        if grew and self.ledger is not None:
+            # New occupancy level from here on (piecewise-constant
+            # integration: the old level was settled up to now).
+            self.ledger.set_blocks(
+                slot.entry.req.id, len(slot.blocks), self.clock.now()
+            )
 
     def _resolve_cow(self, slot: _Slot) -> None:
         """Copy-on-write the slot's borrowed PARTIAL prefix block before
@@ -948,6 +1043,8 @@ class Scheduler:
         self.engine.release_blocks([src])
         slot.cow_idx = None
         self._m_px_cow.inc()
+        if self.ledger is not None:
+            self.ledger.book(slot.entry.req.id, "cow_copies", 1)
 
     # ------------------------------------------------------------ prefill
     def _prefill_round(self) -> bool:
@@ -1000,6 +1097,13 @@ class Scheduler:
         )
         dur_ms = (time.perf_counter() - t0) * 1e3
         self._m_prefill.observe(dur_ms)
+        if self.ledger is not None:
+            # Tokens actually COMPUTED this chunk (pad positions are
+            # geometry, not work anyone is billed for).  Eviction-
+            # recompute naturally re-books here — recompute is real cost.
+            self.ledger.book(
+                slot.entry.req.id, "prefill_tokens", end - p0
+            )
         # A final chunk's first-token readback drains every dispatch
         # queued before it; a non-final chunk is dispatch-only and its
         # compute drains into the NEXT synced op (the mixed-iteration
@@ -1116,6 +1220,14 @@ class Scheduler:
             # backend compile and belongs at drain, never mid-traffic.
             self._publish_device(capture=False)
         for s in live:
+            if self.ledger is not None:
+                # Booked AFTER the step completed: a replica crash at
+                # serve_step raised before reaching here, so a harvested
+                # request is never billed for an iteration that produced
+                # nothing (the harvest books the eviction instead).
+                self.ledger.book(
+                    s.entry.req.id, "decode_iterations", 1
+                )
             if k:
                 # One speculative round: emit the accepted drafts plus
                 # the target's correction/bonus, token by token — EOS or
@@ -1145,6 +1257,13 @@ class Scheduler:
                     self.spec_accepted += acc
                     self._m_spec_prop.inc(prop)
                     self._m_spec_acc.inc(acc)
+                    if self.ledger is not None:
+                        self.ledger.book(
+                            entry.req.id, "spec_proposed", prop
+                        )
+                        self.ledger.book(
+                            entry.req.id, "spec_accepted", acc
+                        )
                     self._m_spec_rate.set(
                         self.spec_accepted / max(self.spec_proposed, 1)
                     )
@@ -1159,6 +1278,8 @@ class Scheduler:
         slot.generated.append(tok)
         slot.last_token = tok
         req = slot.entry.req
+        if self.ledger is not None:
+            self.ledger.book(req.id, "tokens", 1)
         reason = None
         if req.eos_token is not None and tok == req.eos_token:
             reason = "eos"
@@ -1182,6 +1303,10 @@ class Scheduler:
         eng.release_blocks(slot.blocks)
         self._slots[slot.idx] = None
         now = self.clock.now()
+        usage = (
+            self.ledger.finalize(req.id, "ok", now)
+            if self.ledger is not None else None
+        )
         self.completions.append(Completion(
             id=req.id,
             tokens=list(slot.entry.carried) + list(slot.generated),
@@ -1196,6 +1321,7 @@ class Scheduler:
             spec_proposed=slot.entry.spec_proposed,
             spec_accepted=slot.entry.spec_accepted,
             retries=slot.entry.retries,
+            usage=usage,
         ))
         if self.timeline is not None:
             self.timeline.record(
@@ -1358,6 +1484,8 @@ class Scheduler:
             }
         if self.slo is not None and self.slo.last_report:
             state["slo"] = self.slo.last_report
+        if self.ledger is not None:
+            state["usage"] = self.ledger.usage_state()
         if self.timeline is not None:
             state["timeline_events"] = len(self.timeline)
             state["timeline_dropped"] = self.timeline.dropped

@@ -1,0 +1,460 @@
+"""Usage ledger (ISSUE 16): conservation under chaos, attribution unit
+batteries, terminal records, and the offline analyzer round trip.
+
+The headline invariant is **conservation** — the accounting mirror of
+PR 15's terminal invariant: with eviction pressure, replica crashes,
+fail-slow skew, and a dropped re-dispatch frame all firing in one run,
+every submitted request ends with exactly ONE finalized
+:class:`UsageRecord`, and per-tenant sums equal fleet totals *exactly*
+(integer dimensions, zero slack).  Plus the unit batteries: piecewise
+block-second integration across evict/readmit, the prefix
+credit (saved tokens) / charge (pool pressure) split, migration-byte
+attribution through the ``cmn-kvmig-1`` codec with the additive
+``tenant`` field, terminal records for poisoned / shed / deadline, the
+``"usage"`` incident-bundle source naming the top consumer, and
+``python -m chainermn_tpu.observability.usage report`` on a live dump.
+"""
+
+import json
+
+import pytest
+
+from chainermn_tpu.observability.ledger import (
+    DIMENSIONS,
+    CostLedger,
+    UsageRecord,
+)
+from chainermn_tpu.observability.metrics import MetricsRegistry
+from chainermn_tpu.resilience.faults import (
+    FaultInjector,
+    parse_fault_spec,
+)
+from chainermn_tpu.serving import (
+    ChaosHarness,
+    DecodeEngine,
+    Request,
+    Router,
+    Scheduler,
+)
+
+pytestmark = [pytest.mark.tier1, pytest.mark.serving]
+
+TENANTS = ("acme", "bluesky", "carol")
+
+
+def _mk_engine(make_model, tiny_params, capacity=2, num_blocks=24):
+    return DecodeEngine(
+        make_model(), tiny_params, capacity=capacity,
+        num_blocks=num_blocks, block_len=8, prefill_chunk=8,
+    )
+
+
+def _inj(spec):
+    return FaultInjector(parse_fault_spec(spec))
+
+
+def _reqs(prompts, n, max_new=5, **kw):
+    return [
+        Request(id=i, prompt=prompts[i % len(prompts)],
+                max_new_tokens=max_new,
+                tenant=TENANTS[i % len(TENANTS)], **kw)
+        for i in range(n)
+    ]
+
+
+def _assert_conserved(led, reqs=None):
+    """The full cross-check: the ledger's own oracle holds AND an
+    independent per-dimension recount (records -> tenant sums -> fleet
+    totals) agrees exactly."""
+    report = led.verify_conservation(requests=reqs)
+    assert report["holds"], report
+    agg = led.aggregate()
+    for dim in DIMENSIONS:
+        assert sum(t[dim] for t in agg.values()) == led.totals[dim], dim
+    return report
+
+
+# --------------------------------------------------- chaos conservation
+def test_chaos_conservation_exact(make_model, tiny_params, prompts):
+    """The acceptance run: all three fault sites (two crashes, one
+    fail-slow skew, one dropped re-dispatch frame) under eviction
+    pressure (small pool), three tenants round-robin — the terminal
+    invariant holds AND the cost books balance bit-exactly."""
+    schedule = {
+        "seed": None,
+        "replica_faults": [
+            "crash@serve_step:4",
+            "skew@serve_step:2:5ms;crash@serve_step:8",
+            None,
+        ],
+        "router_faults": "drop@migrate:1",
+    }
+    reg = MetricsRegistry()
+    harness = ChaosHarness(
+        lambda: _mk_engine(make_model, tiny_params, num_blocks=10),
+        replicas=3, seed=0, registry=reg, revive_after=2,
+        schedule=schedule,
+    )
+    reqs = _reqs(prompts, 9, max_new=6)
+    report = harness.run(reqs)
+    assert report["holds"], report
+
+    led = harness.router.ledger
+    assert led is not None  # explicit registry -> the fleet ledger is on
+    cons = _assert_conserved(led, reqs)
+    assert cons["requests"] == len(reqs)
+    assert cons["tenants"] == len(TENANTS)
+
+    # Every submitted request: exactly one finalized record whose status
+    # and tenant match its Completion, and the Completion carries it.
+    comps = {c.id: c for c in harness.router.completions}
+    assert sorted(comps) == [r.id for r in reqs]
+    for r in reqs:
+        rec = led.record(r.id)
+        assert rec is not None and rec.finalized
+        assert rec.status == comps[r.id].status
+        assert rec.tenant == r.tenant
+        assert comps[r.id].usage is rec
+        assert rec.block_us >= 0 and rec.queue_wait_us >= 0
+
+    # The chaos actually billed the failure plane: the two crashes
+    # harvested live work (eviction-requeue recompute events) and the
+    # router re-dispatched it (retries) — real costs, attributed.
+    assert led.totals["evictions"] > 0
+    assert led.totals["retries"] > 0
+    assert led.totals["prefill_tokens"] > 0
+    assert led.totals["tokens"] > 0
+    assert led.totals["block_us"] > 0
+
+    # serve.tenant.* gauges published from the explicit registry agree
+    # with the books; top_share is a valid fraction of the fleet.
+    agg = led.aggregate()
+    for t in TENANTS:
+        assert reg.peek(f"serve.tenant.{t}.tokens").value \
+            == agg[t]["tokens"]
+        assert reg.peek(f"serve.tenant.{t}.requests").value \
+            == agg[t]["requests"]
+    share = reg.peek("serve.tenant.top_share").value
+    assert 0 < share <= 1.0
+    assert share == pytest.approx(
+        max(t["block_us"] for t in agg.values()) / led.totals["block_us"]
+    )
+
+
+# ------------------------------------------- block-second unit battery
+def test_block_second_integration_evict_readmit():
+    """Piecewise integration in exact integer block-microseconds: hold,
+    evict (settle to zero), readmit at a different width, finalize —
+    the record reads precisely blocks x microseconds per interval."""
+    led = CostLedger(registry=MetricsRegistry())
+    req = Request(id=7, prompt=[1, 2, 3], max_new_tokens=4,
+                  tenant="acme")
+    led.begin(req, 0.0)
+    led.admitted(7, 0.25)
+    led.set_blocks(7, 4, 1.0)     # hold 4 blocks...
+    led.set_blocks(7, 0, 1.5)     # ...for 0.5 s -> evicted
+    led.book(7, "evictions", 1)
+    led.set_blocks(7, 2, 2.0)     # readmitted at 2 blocks...
+    rec = led.finalize(7, "ok", 3.0)  # ...for 1.0 s
+    assert rec.block_us == 4 * 500_000 + 2 * 1_000_000
+    assert rec.queue_wait_us == 250_000
+    assert rec.evictions == 1
+    assert rec.block_seconds == pytest.approx(4.0)
+    _assert_conserved(led, [req])
+    # Queue wait books once fleet-wide: a re-admission never re-books.
+    led2 = CostLedger(registry=None)
+    led2.begin(req, 0.0)
+    led2.admitted(7, 1.0)
+    led2.admitted(7, 9.0)
+    assert led2.record(7).queue_wait_us == 1_000_000
+
+
+def test_ledger_evidence_and_unknown_ids():
+    """A double finalize is recorded as evidence (the oracle fails); an
+    unknown id is dropped WHOLE — never half-booked into a total."""
+    led = CostLedger(registry=None)
+    req = Request(id=1, prompt=[1], max_new_tokens=1)
+    led.begin(req, 0.0)
+    led.book(99, "tokens", 5)       # no record -> no totals move
+    led.admitted(99, 1.0)
+    led.set_blocks(99, 3, 0.0)      # opens state for an unknown id...
+    led.set_blocks(99, 0, 1.0)      # ...but settling books nothing
+    assert led.totals["tokens"] == 0 and led.totals["block_us"] == 0
+    led.finalize(1, "ok", 1.0)
+    assert led.verify_conservation()["holds"]
+    led.finalize(1, "shed", 2.0)    # second terminal: evidence
+    rep = led.verify_conservation()
+    assert not rep["holds"] and rep["double_finalized"] == [1]
+    assert led.record(1).status == "ok"  # first terminal wins
+
+
+# ------------------------------------------------- prefix credit/charge
+def test_prefix_credit_charge_split(make_model, tiny_params, prompts):
+    """Prefix sharing: the SECOND request over the same prompt is
+    credited the saved tokens (``prefix_hit_tokens``) and computes a
+    shorter prefill — but its mapped blocks (shared included) still
+    charge ITS block-seconds (pool pressure bills the pinner)."""
+    eng = _mk_engine(make_model, tiny_params)
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    p = prompts[4]  # longest fixture prompt (two full blocks to share)
+    [a] = sched.run([Request(id=0, prompt=p, max_new_tokens=4,
+                             tenant="acme")])
+    b = {c.id: c for c in sched.run([Request(id=1, prompt=p,
+                                             max_new_tokens=4,
+                                             tenant="bluesky")])}[1]
+    assert a.status == b.status == "ok" and a.tokens == b.tokens
+    led = sched.ledger
+    ra, rb = led.record(0), led.record(1)
+    assert ra.prefix_hit_tokens == 0
+    assert rb.prefix_hit_tokens >= 8          # whole blocks only
+    assert rb.prefill_tokens < ra.prefill_tokens
+    assert rb.prefill_tokens + rb.prefix_hit_tokens >= len(p) - 1
+    assert rb.block_us > 0                    # shared blocks still bill
+    _assert_conserved(led)
+
+
+# ------------------------------------------------------ terminal records
+def test_poisoned_terminal_record(make_model, tiny_params, prompts):
+    """Retry-budget exhaustion: the quarantined Completion carries a
+    finalized poisoned record billing both doomed prefill attempts."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg,
+        faults=[_inj("crash@serve_step:1"), _inj("crash@serve_step:1")],
+        retry_budget=2,
+    )
+    req = Request(id=0, prompt=prompts[0], max_new_tokens=6,
+                  tenant="mallory")
+    [c] = router.run([req])
+    assert c.status == "poisoned"
+    rec = router.ledger.record(0)
+    assert c.usage is rec and rec.finalized
+    assert rec.status == "poisoned" and rec.tenant == "mallory"
+    assert rec.retries == 2
+    # Both doomed attempts are REAL cost — prefill computed (twice:
+    # eviction-recompute on harvest), blocks held — booked even though
+    # the request never completed.
+    assert rec.prefill_tokens > len(prompts[0])
+    assert rec.evictions == 2 and rec.block_us > 0
+    assert rec.tokens < 6           # died mid-stream, never finished
+    _assert_conserved(router.ledger, [req])
+
+
+def test_shed_and_deadline_terminal_records(make_model, tiny_params,
+                                            prompts):
+    """Shed overflow and a queued deadline miss: refused requests still
+    get exactly one finalized record — zero compute billed, their whole
+    life booked as queue wait."""
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)],
+        registry=MetricsRegistry(), max_queue=1, shed_depth=2,
+    )
+    reqs = _reqs(prompts, 8, max_new=4)
+    comps = router.run(reqs)
+    led = router.ledger
+    _assert_conserved(led, reqs)
+    shed = [c for c in comps if c.status == "shed"]
+    assert len(shed) == 5
+    for c in shed:
+        rec = led.record(c.id)
+        assert c.usage is rec and rec.status == "shed"
+        for dim in DIMENSIONS:
+            if dim != "queue_wait_us":
+                assert getattr(rec, dim) == 0, (c.id, dim)
+
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    sched = Scheduler(eng, registry=MetricsRegistry())
+    sched.submit(Request(id=0, prompt=prompts[0], max_new_tokens=24))
+    sched.submit(Request(id=1, prompt=prompts[1], max_new_tokens=8,
+                         deadline_ms=0.01, tenant="carol"))
+    comps = {c.id: c for c in sched.run()}
+    assert comps[1].status == "deadline"
+    rec = sched.ledger.record(1)
+    assert comps[1].usage is rec and rec.status == "deadline"
+    assert rec.tenant == "carol" and rec.tokens == 0
+    _assert_conserved(sched.ledger)
+
+
+# -------------------------------------------------- ledger off / default
+def test_ledger_off_builds_nothing(make_model, tiny_params, prompts,
+                                   monkeypatch):
+    """CMN_OBS_LEDGER=0: scheduler and router construct NO ledger — and
+    the router forces that decision onto every replica (the per-replica
+    registries must not grow private, incoherent books) — while
+    Completion.usage stays at its additive default."""
+    monkeypatch.setenv("CMN_OBS_LEDGER", "0")
+    sched = Scheduler(_mk_engine(make_model, tiny_params),
+                      registry=MetricsRegistry())
+    assert sched.ledger is None
+    [c] = sched.run([Request(id=0, prompt=prompts[0],
+                             max_new_tokens=4)])
+    assert c.status == "ok" and c.usage is None
+    router = Router(
+        [_mk_engine(make_model, tiny_params)],
+        registry=MetricsRegistry(),
+    )
+    assert router.ledger is None
+    assert all(s.ledger is None for s in router.schedulers)
+
+
+def test_router_fleet_ledger_is_shared(make_model, tiny_params):
+    """Default-on with a registry: ONE fleet ledger, every replica
+    scheduler holds the same object (a migrated / harvested request
+    keeps one record)."""
+    router = Router(
+        [_mk_engine(make_model, tiny_params) for _ in range(2)],
+        registry=MetricsRegistry(),
+    )
+    assert isinstance(router.ledger, CostLedger)
+    assert all(s.ledger is router.ledger for s in router.schedulers)
+
+
+# ----------------------------------------------------- disagg migration
+def test_disagg_migration_bytes_and_tenant_codec(make_model, tiny_params,
+                                                 prompts):
+    """Role-split serving on ONE shared fleet ledger: the migrated
+    request keeps a single record spanning prefill and decode ranks,
+    its migration bytes are booked at pack (pinner-pays), and the
+    additive ``tenant`` codec field survives the wire."""
+    from chainermn_tpu.serving import (
+        DecodeRole,
+        LocalComm,
+        MigrationTransport,
+        PrefillRole,
+        serve_disaggregated,
+    )
+    from chainermn_tpu.serving.disagg import _pack_entry, _unpack_entry
+    from chainermn_tpu.serving.scheduler import _Clock, _QueueEntry
+
+    comm = LocalComm(2)
+    clock = _Clock()
+    reg = MetricsRegistry()
+    led = CostLedger(registry=reg)
+    pr = PrefillRole(
+        Scheduler(_mk_engine(make_model, tiny_params, capacity=3,
+                             num_blocks=48),
+                  registry=reg, clock=clock, ledger=led),
+        MigrationTransport(comm.endpoint(0), registry=reg),
+        decode_ranks=[1],
+    )
+    dr = DecodeRole(
+        Scheduler(_mk_engine(make_model, tiny_params, capacity=3,
+                             num_blocks=48),
+                  registry=reg, clock=clock, ledger=led),
+        MigrationTransport(comm.endpoint(1), registry=reg),
+        prefill_ranks=[0],
+    )
+    reqs = _reqs(prompts, 3, max_new=6)
+    comps = {c.id: c for c in serve_disaggregated(pr, dr, reqs)}
+    assert all(comps[r.id].status == "ok" for r in reqs)
+    _assert_conserved(led, reqs)
+    for r in reqs:
+        rec = led.record(r.id)
+        assert rec.tenant == r.tenant
+        assert rec.migration_bytes > 0      # every stream crossed ranks
+        assert rec.prefill_tokens > 0 and rec.tokens > 0
+        assert comps[r.id].usage is rec
+    # Ledger bytes >= the deduped wire counter (shared blocks bill every
+    # pinning slot; the wire ships them once).
+    assert led.totals["migration_bytes"] \
+        >= reg.peek("serve.migration.bytes").value > 0
+
+    # Codec compat both ways: tenant rides cmn-kvmig-1, and a frame
+    # from a pre-ISSUE-16 sender (no "tenant" key) unpacks to the
+    # dataclass default.
+    entry = _QueueEntry(req=reqs[0])
+    frame = _pack_entry(entry)
+    assert frame["req"]["tenant"] == reqs[0].tenant
+    assert _unpack_entry(frame).req.tenant == reqs[0].tenant
+    del frame["req"]["tenant"]
+    assert _unpack_entry(frame).req.tenant == "default"
+
+
+# --------------------------------------------------- incident / flight
+def test_incident_bundle_names_top_consumer(make_model, tiny_params,
+                                            prompts, tmp_path):
+    """The scheduler registers the keyed ``"usage"`` source: any bundle
+    filed after traffic names the top consumer in ``signals.json``."""
+    from chainermn_tpu.observability.incident import IncidentManager
+
+    reg = MetricsRegistry()
+    mgr = IncidentManager(registry=reg, rules=[],
+                          directory=str(tmp_path), cooldown_s=0.0)
+    sched = Scheduler(_mk_engine(make_model, tiny_params),
+                      registry=reg, incidents=mgr)
+    sched.run([
+        Request(id=0, prompt=prompts[4], max_new_tokens=12,
+                tenant="whale"),
+        Request(id=1, prompt=prompts[3], max_new_tokens=2,
+                tenant="shrimp"),
+    ])
+    fired = mgr.file_incident("usage-probe", severity="info")
+    with open(fired["bundle"] + "/signals.json") as fh:
+        signals = json.load(fh)
+    usage = signals["usage"]
+    assert usage["schema"] == "cmn-usage-1"
+    assert usage["requests"] == 2 and usage["finalized"] == 2
+    assert usage["top_tenant"] == "whale"
+    assert {t["tenant"] for t in usage["top"]} == {"whale", "shrimp"}
+    # The manifest's headline snapshot carries the top-share gauge.
+    with open(fired["bundle"] + "/manifest.json") as fh:
+        manifest = json.load(fh)
+    assert 0 < manifest["signals"]["serve.tenant.top_share"] <= 1.0
+
+
+# -------------------------------------------------- analyzer round trip
+def test_usage_report_roundtrip_live_run(make_model, tiny_params,
+                                         prompts, tmp_path, capsys):
+    """A live fleet's dump renders through the offline analyzer, and
+    ``--json`` round-trips the aggregation losslessly."""
+    from chainermn_tpu.observability import usage as usage_mod
+
+    router = Router(
+        [_mk_engine(make_model, tiny_params) for _ in range(2)],
+        registry=MetricsRegistry(),
+    )
+    reqs = _reqs(prompts, 6, max_new=4)
+    comps = router.run(reqs)
+    assert all(c.status == "ok" for c in comps)
+    led = router.ledger
+    _assert_conserved(led, reqs)
+    path = str(tmp_path / "usage.json")
+    led.dump(path)
+
+    assert usage_mod.main(["report", path]) == 0
+    human = capsys.readouterr().out
+    assert "conservation" in human and "acme" in human
+
+    assert usage_mod.main(["report", path, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["schema"] == "cmn-usage-1"
+    assert report["conservation"]["holds"] is True
+    agg = led.aggregate()
+    shares = 0.0
+    for t in TENANTS:
+        row = report["tenants"][t]
+        assert row["tokens"] == agg[t]["tokens"]
+        assert row["block_seconds"] == pytest.approx(
+            agg[t]["block_us"] / 1e6, abs=1e-6
+        )
+        shares += row["block_second_share"]
+    assert shares == pytest.approx(1.0, abs=1e-5)
+    assert report["totals"]["tokens"] == led.totals["tokens"]
+    assert report["top"][0]["tenant"] == led.top()[0]["tenant"]
+    # Schema gate: a non-ledger artifact is refused, not misread.
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": "something-else"}))
+    assert usage_mod.main(["report", str(bad)]) == 2
+
+
+def test_usage_record_dataclass_defaults():
+    """Additive-schema discipline: a bare record zeroes every dimension
+    and is unfinalized (constructors/codecs stay green)."""
+    rec = UsageRecord(id=3)
+    assert not rec.finalized and rec.tenant == "default"
+    assert all(getattr(rec, d) == 0 for d in DIMENSIONS)
+    d = rec.to_dict()
+    assert d["id"] == 3 and d["status"] is None
+    assert set(DIMENSIONS) <= set(d)

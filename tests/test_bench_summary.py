@@ -106,6 +106,34 @@ fit2 = bench._fit_summary(fat2)
 assert len(json.dumps(fit2)) <= bench.SUMMARY_MAX_BYTES
 assert "chaos" not in fit2
 assert fit2["metric"] == "m" and fit2["value"] == 1.0
+
+# Tenant pointer (ISSUE 16): present only when the serving headline
+# carries the multi-tenant metering arm — the top consumer's
+# block-second share — and it rides the _fit_summary droppable list.
+srv4 = {"tokens_per_sec": 9.9, "speedup_vs_static": 1.6,
+        "tenant_top_share": 0.62, "tenant_conservation_holds": True,
+        "artifact": "result/serving_tpu.json", **blob}
+ok4 = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv4, None,
+)
+assert len(json.dumps(ok4)) <= bench.SUMMARY_MAX_BYTES
+assert ok4["tenant_top_share"] == 0.62, ok4
+assert "tenant_top_share" not in bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv, None,
+)  # absent arm -> absent pointer
+fat3 = {
+    "bench_summary": True, "metric": "m", "value": 1.0,
+    "tenant_top_share": 0.62,
+    # Oversized mass in a field dropped AFTER the tenant pointer, so
+    # the shrink loop must shed tenant_top_share on its way down.
+    "perf_sentinel": {"verdict": "green", "note": "y" * 1500},
+}
+fit3 = bench._fit_summary(fat3)
+assert len(json.dumps(fit3)) <= bench.SUMMARY_MAX_BYTES
+assert "tenant_top_share" not in fit3
+assert fit3["metric"] == "m" and fit3["value"] == 1.0
 print("SUMMARY-OK", len(line), len(line2))
 """
 

@@ -229,6 +229,8 @@ _ANALYZERS = (
      ["--result-dir", "result"]),
     ("chainermn_tpu.observability.incident",
      ["report", os.path.join("result", "sample_incident_bundle")]),
+    ("chainermn_tpu.observability.usage",
+     ["report", os.path.join("result", "sample_usage_ledger.json")]),
 )
 
 
