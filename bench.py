@@ -270,6 +270,20 @@ def _serving_headline() -> dict | None:
             "chaos_replica_dead": rec.get(
                 "chaos", {}
             ).get("replica_dead"),
+            # Elastic-fleet arm (ISSUE 17), when the artifact carries
+            # it: replica-seconds saved by closed-loop autoscaling at
+            # held p95 (flaps must be 0), and the rolling-deploy
+            # sub-arm's zero-loss verdict.
+            "elastic_replica_seconds_saved_pct": rec.get(
+                "elastic", {}
+            ).get("replica_seconds_saved_pct"),
+            "elastic_p95_held": rec.get("elastic", {}).get("p95_held"),
+            "elastic_flaps": rec.get(
+                "elastic", {}
+            ).get("elastic", {}).get("flaps"),
+            "rollout_zero_loss": rec.get(
+                "elastic", {}
+            ).get("rollout", {}).get("zero_loss"),
             # Multi-tenant metering arm (ISSUE 16), when the artifact
             # carries it: the top consumer's share of fleet
             # block-seconds and the usage ledger's exact-conservation
@@ -425,6 +439,16 @@ def _summary_line(payload: dict, lm=None, dec=None, srv=None,
     # table ride the composite line's serving_headline).
     if srv is not None and srv.get("tenant_top_share") is not None:
         summary["tenant_top_share"] = srv["tenant_top_share"]
+    # Elastic-arm pointers (ISSUE 17): replica-seconds the autoscaler
+    # saved at held p95, and the rolling deploy's zero-loss verdict —
+    # present only when the serving artifact carries the elastic arm.
+    if srv is not None and \
+            srv.get("elastic_replica_seconds_saved_pct") is not None:
+        summary["elastic_replica_seconds_saved_pct"] = srv[
+            "elastic_replica_seconds_saved_pct"
+        ]
+    if srv is not None and srv.get("rollout_zero_loss") is not None:
+        summary["rollout_zero_loss"] = srv["rollout_zero_loss"]
     # Artifact POINTERS, not payloads: the full headline dicts ride the
     # composite line above; the tail line names where each number came
     # from so a consumer can open the file.
@@ -489,7 +513,8 @@ def _fit_summary(summary: dict) -> dict:
     if isinstance(summary.get("error"), str):
         summary["error"] = summary["error"][:80]
     for k in ("incident_newest", "serving_tpu_probe", "chaos",
-              "tenant_top_share",
+              "tenant_top_share", "elastic_replica_seconds_saved_pct",
+              "rollout_zero_loss",
               "router_tokens_per_sec", "cache_source_commit",
               "serving_artifact", "decode_artifact", "lm_artifact",
               "cache_age_hours", "incident_count", "perf_sentinel",

@@ -89,6 +89,7 @@ HEADLINE_SIGNALS = (
     "serve.slo.queue_wait.p95_ms", "serve.slo.token.p95_ms",
     "serve.queue_depth", "serve.slot_occupancy",
     "serve.migration.failed", "serve.tenant.top_share",
+    "serve.autoscale.replicas", "serve.rollout.in_progress",
     "fleet.straggler_rank", "fleet.straggler_stall_ms",
     "fleet.clock_rtt_ms",
     "compile.count", "compile.budget_exceeded",
@@ -269,6 +270,25 @@ def default_rules() -> List[Watch]:
                         "replicas and was quarantined as a poisoned "
                         "Completion instead of re-dispatched forever "
                         "(key_by_value: each quarantine files)",
+        ),
+        Watch(
+            "scale_flap", "serve.autoscale.flap", "> 0",
+            severity="critical", key_by_value=True,
+            description="the autoscaler wanted to reverse direction "
+                        "inside its own cooldown — thresholds and "
+                        "hysteresis are mis-tuned for this load shape "
+                        "and the fleet would thrash "
+                        "(key_by_value: each suppressed flap files)",
+        ),
+        Watch(
+            "rollout_stalled", "serve.rollout.stalled", "> 0",
+            severity="critical", key_by_value=True,
+            description="one rolling-deploy step (drain + probation "
+                        "graduation) exceeded "
+                        "CMN_SERVE_ROLLOUT_TIMEOUT_TICKS — the "
+                        "replacement replica is not graduating and the "
+                        "rollout is wedged "
+                        "(key_by_value: each stalled step files)",
         ),
     ]
 

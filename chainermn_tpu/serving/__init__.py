@@ -48,6 +48,14 @@ Six layers:
   deadlines + router load shedding, the ``serve.health.*`` metric
   family, and the seeded chaos harness that proves the terminal
   invariant (every submitted request terminates exactly once).
+* :mod:`~chainermn_tpu.serving.elastic` — the elastic fleet: a
+  closed-loop :class:`~chainermn_tpu.serving.elastic.Autoscaler`
+  (watch-rule signals → scale-up behind probation / scale-down via
+  zero-loss drain, hysteresis + cooldown against flapping) and a
+  :class:`~chainermn_tpu.serving.elastic.RollingDeploy` controller
+  (fence → drain → revive, one replica at a time, health-gated on
+  probation graduation; a mid-rollout death pauses and files a
+  critical incident).
 * :mod:`~chainermn_tpu.serving.disagg` — disaggregated prefill/decode:
   the KV-block migration primitive (live blocks + block table + carried
   tokens shipped as framed ``send_obj`` payloads over the hostcomm p2p
@@ -69,6 +77,7 @@ from chainermn_tpu.serving.disagg import (
     drain_all,
     serve_disaggregated,
 )
+from chainermn_tpu.serving.elastic import Autoscaler, RollingDeploy
 from chainermn_tpu.serving.engine import DecodeEngine
 from chainermn_tpu.serving.kv_pool import (
     BlockAllocator,
@@ -103,7 +112,9 @@ __all__ = [
     "MigrationError",
     "MigrationTransport",
     "PrefillRole",
+    "Autoscaler",
     "ChaosHarness",
+    "RollingDeploy",
     "Completion",
     "FleetHealth",
     "Request",

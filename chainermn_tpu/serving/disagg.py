@@ -592,6 +592,30 @@ class MigrationTransport:
 
 
 # ------------------------------------------------------ migration verbs
+def handoff_slots(src: Scheduler, dst: Scheduler,
+                  slots: Optional[Sequence[_Slot]] = None
+                  ) -> Tuple[int, int]:
+    """In-process scale-down / rolling-deploy handoff (ISSUE 17): pack
+    ``slots`` (default: every decode-ready slot) from ``src`` and
+    install them straight into ``dst`` — the same cmn-kvmig-1 body the
+    framed transport ships, minus the wire, so the destination's
+    one-variant ``kv_put``/``kv_gather`` programs do the move and the
+    survivor never recompiles.  Slots detach from ``src`` only AFTER
+    the install returns: an exception mid-install leaves the source
+    intact (over-held beats lost; the caller's fault boundary decides
+    what to do with the husk).  Returns ``(slots_installed,
+    entries_queued)`` — ``entries_queued`` counts slots the destination
+    could not place live (no free slot / pool blocks) that fell back to
+    recompute entries on its queue, carried tokens preserved."""
+    slots = src.ready_slots() if slots is None else list(slots)
+    if not slots:
+        return 0, 0
+    body = pack_slots(src, slots)
+    installed, queued, _ = install_payload(dst, body)
+    detach_slots(src, slots)
+    return installed, queued
+
+
 def migrate_slots(sched: Scheduler, transport: MigrationTransport,
                   dest: int, slots: Sequence[_Slot]) -> int:
     """Move live decode-ready ``slots`` to peer ``dest``: pack → framed
@@ -631,9 +655,7 @@ def drain_all(sched: Scheduler, transport: MigrationTransport,
     for b in deferred:
         transport.send(b, dest)
         fwd_slots += len(b["slots"]) + len(b["entries"])
-    ready = [
-        s for s in sched._slots if s is not None and not s.prefilling
-    ]
+    ready = sched.ready_slots()
     body = pack_slots(sched, ready)
     for slot in sched._slots:
         if slot is None or not slot.prefilling:

@@ -72,8 +72,13 @@ from chainermn_tpu.observability.metrics import (
     _env_float,
 )
 
-#: Replica lifecycle states (see :class:`FleetHealth`).
-STATES = ("live", "probation", "dead")
+#: Replica lifecycle states (see :class:`FleetHealth`).  ``draining``
+#: and ``removed`` are the elastic-fleet states (ISSUE 17): a draining
+#: replica still ticks (its in-flight work progresses) but is fenced
+#: from fresh admissions AND rebalance steals; a removed replica was
+#: deregistered after a scale-down drain — its row is a tombstone so
+#: historical replica indices stay stable.
+STATES = ("live", "probation", "draining", "dead", "removed")
 
 
 # ----------------------------------------------------------- env knobs
@@ -119,6 +124,14 @@ class FleetHealth:
     ``probation`` → (:data:`probation_ticks` clean ticks) → ``live``.
     A probation replica that raises goes straight back to ``dead`` —
     the circuit breaker re-opens.
+
+    The elastic extensions (ISSUE 17): ``live``/``probation`` →
+    (:meth:`start_draining`) → ``draining`` → either
+    (:meth:`mark_retired` — rolling deploy) → ``dead`` → (revive) →
+    ``probation``, or (:meth:`remove_replica` — scale-down) →
+    ``removed``, a terminal tombstone.  Rows are dynamic:
+    :meth:`add_replica` appends one for a scale-up (the router
+    registers the newcomer behind probation).
     """
 
     def __init__(self, n: int, registry=None,
@@ -144,6 +157,7 @@ class FleetHealth:
             noop = _NoopInstrument()
             self.m_dead = self.m_recovered = self.m_retries = noop
             self.m_poisoned = self.m_shed = self.m_probation = noop
+            self.m_draining = noop
         else:
             self.m_dead = registry.counter("serve.health.replica_dead")
             self.m_recovered = registry.counter("serve.health.recovered")
@@ -151,17 +165,33 @@ class FleetHealth:
             self.m_poisoned = registry.counter("serve.health.poisoned")
             self.m_shed = registry.counter("serve.health.shed")
             self.m_probation = registry.gauge("serve.health.probation")
+            self.m_draining = registry.gauge("serve.health.draining")
 
     # ------------------------------------------------------------ state
+    @property
+    def replicas(self) -> int:
+        return len(self._state)
+
     def state(self, i: int) -> str:
         return self._state[i]
 
     def is_up(self, i: int) -> bool:
-        """Not dead: the replica's tick loop still runs."""
-        return self._state[i] != "dead"
+        """Not dead / removed: the replica's tick loop still runs (a
+        DRAINING replica keeps ticking — its in-flight work must finish
+        or hand off — it is merely fenced from NEW work)."""
+        return self._state[i] not in ("dead", "removed")
+
+    def can_admit(self, i: int) -> bool:
+        """May take FRESH work: live or probation only — draining, dead
+        and removed replicas are all fenced from admissions (and from
+        rebalance steals; the router enforces both on this seam)."""
+        return self._state[i] in ("live", "probation")
 
     def in_probation(self, i: int) -> bool:
         return self._state[i] == "probation"
+
+    def is_draining(self, i: int) -> bool:
+        return self._state[i] == "draining"
 
     @property
     def dead_replicas(self) -> List[int]:
@@ -174,6 +204,7 @@ class FleetHealth:
         self._probation_left[i] = 0
         self.m_dead.inc()
         self._gauge_probation()
+        self._gauge_draining()
 
     def start_probation(self, i: int) -> None:
         if self._state[i] != "dead":
@@ -198,9 +229,71 @@ class FleetHealth:
         self._gauge_probation()
         return True
 
+    # ------------------------------------- elastic transitions (ISSUE 17)
+    def start_draining(self, i: int) -> None:
+        """Fence replica ``i`` for a scale-down / rolling-deploy drain:
+        it keeps ticking (in-flight work progresses) but takes no fresh
+        admissions and no rebalance steals.  Only a live or probation
+        replica can start draining (a dead one's work was already
+        harvested; a removed one is gone)."""
+        if self._state[i] not in ("live", "probation"):
+            raise ValueError(
+                f"replica {i} is {self._state[i]!r} — only a live or "
+                "probation replica can start draining"
+            )
+        self._state[i] = "draining"
+        self._probation_left[i] = 0
+        self._gauge_probation()
+        self._gauge_draining()
+
+    def mark_retired(self, i: int) -> None:
+        """A DRAINED replica steps aside for a rolling deploy: state
+        goes ``dead`` so :meth:`start_probation` (via the router's
+        ``revive_replica``) can register its replacement — but this is
+        an ORDERLY exit, so ``serve.health.replica_dead`` does not
+        count it as a failure."""
+        if self._state[i] != "draining":
+            raise ValueError(
+                f"replica {i} is {self._state[i]!r} — only a draining "
+                "replica can retire (drain it first)"
+            )
+        self._state[i] = "dead"
+        self.errors[i] = "retired (rolling deploy)"
+        self._gauge_draining()
+
+    def add_replica(self) -> int:
+        """Scale-up: append one row (born ``dead`` — the router revives
+        it straight into probation, so a newcomer earns full trust the
+        same way a replacement does).  Returns the new index."""
+        self._state.append("dead")
+        self._probation_left.append(0)
+        self.errors.append(None)
+        return len(self._state) - 1
+
+    def remove_replica(self, i: int) -> None:
+        """Scale-down tombstone: a drained (or crashed-mid-drain, hence
+        dead) replica leaves the fleet.  The row stays — historical
+        replica indices in assignments/snapshots remain valid — but the
+        state is terminal: never up, never revivable, never counted in
+        the probation/draining gauges."""
+        if self._state[i] not in ("draining", "dead"):
+            raise ValueError(
+                f"replica {i} is {self._state[i]!r} — only a draining "
+                "or dead replica can be removed (drain it first)"
+            )
+        self._state[i] = "removed"
+        self._probation_left[i] = 0
+        self._gauge_probation()
+        self._gauge_draining()
+
     def _gauge_probation(self) -> None:
         self.m_probation.set(
             sum(1 for s in self._state if s == "probation")
+        )
+
+    def _gauge_draining(self) -> None:
+        self.m_draining.set(
+            sum(1 for s in self._state if s == "draining")
         )
 
     def snapshot(self) -> List[dict]:
@@ -249,7 +342,10 @@ def verify_terminal_invariant(requests: Sequence,
 def chaos_schedule(seed: int, replicas: int, *,
                    crash_iters: Sequence[int] = (3, 9, 17, 29),
                    crash_p: float = 0.75, skew_p: float = 0.5,
-                   skew_ms: int = 5, drops: int = 1) -> dict:
+                   skew_ms: int = 5, drops: int = 1,
+                   scale_ups: int = 0, scale_downs: int = 0,
+                   rollout_at: Optional[int] = None,
+                   elastic_ticks: Sequence[int] = (2, 24)) -> dict:
     """A seeded randomized fault schedule over the existing fault sites.
 
     Per replica, independently: with probability ``crash_p`` a
@@ -265,6 +361,14 @@ def chaos_schedule(seed: int, replicas: int, *,
     "router_faults": spec-or-None}`` — spec strings in the
     ``CMN_FAULT`` grammar, buildable with
     :func:`~chainermn_tpu.resilience.faults.parse_fault_spec`.
+
+    Elastic events (ISSUE 17): ``scale_ups`` / ``scale_downs`` draw
+    that many fleet-size changes at seeded ticks in ``elastic_ticks``,
+    and ``rollout_at`` pins a mid-traffic rolling deploy; they land
+    under an ``"elastic"`` key ([{"tick", "event"}] sorted by tick)
+    the harness fires between router ticks — so drains, handoffs and
+    probation graduations interleave with the crash/skew/drop faults
+    above.
     """
     rng = random.Random(seed)
     per_replica: List[Optional[str]] = []
@@ -290,11 +394,23 @@ def chaos_schedule(seed: int, replicas: int, *,
         f"drop@migrate:{rng.randint(1, 3) + 2 * k}"
         for k in range(max(0, drops))
     ) or None
-    return {
+    out = {
         "seed": seed,
         "replica_faults": per_replica,
         "router_faults": router_faults,
     }
+    events = [
+        {"tick": rng.randint(*elastic_ticks), "event": "scale_up"}
+        for _ in range(max(0, scale_ups))
+    ] + [
+        {"tick": rng.randint(*elastic_ticks), "event": "scale_down"}
+        for _ in range(max(0, scale_downs))
+    ]
+    if rollout_at is not None:
+        events.append({"tick": int(rollout_at), "event": "rollout"})
+    if events:
+        out["elastic"] = sorted(events, key=lambda e: e["tick"])
+    return out
 
 
 class ChaosHarness:
@@ -313,6 +429,16 @@ class ChaosHarness:
     The harness is deliberately a thin loop over public Router seams —
     everything it does (``tick``/``revive_replica``/``completions``) a
     production supervisor could do the same way.
+
+    Elastic events (ISSUE 17): a schedule carrying an ``"elastic"``
+    list fires scale-ups (``Router.add_replica`` behind probation),
+    scale-downs (fence → drain over the cmn-kvmig-1 path → deregister
+    the coldest live replica — skipped when the fleet is at one
+    admitting replica), and a mid-traffic rolling deploy
+    (:class:`~chainermn_tpu.serving.elastic.RollingDeploy`, driven a
+    tick at a time) between router ticks, so the crash/skew/drop
+    faults land DURING drains and rollouts and the terminal invariant
+    is checked across every elastic transition.
     """
 
     def __init__(self, engine_factory: Callable[[], object],
@@ -326,6 +452,7 @@ class ChaosHarness:
         from chainermn_tpu.serving.router import Router
 
         self.engine_factory = engine_factory
+        self.registry = registry
         self.schedule = (
             schedule if schedule is not None
             else chaos_schedule(seed, replicas)
@@ -348,6 +475,16 @@ class ChaosHarness:
         self.revived = 0
         #: ticks-until-revive countdown per currently-dead replica.
         self._revive_in: dict = {}
+        #: pending elastic events, sorted by tick (ISSUE 17).
+        self._elastic = sorted(
+            self.schedule.get("elastic") or (),
+            key=lambda e: e["tick"],
+        )
+        #: what actually fired (replica picked, skips) — the report's
+        #: ``elastic`` evidence.
+        self.elastic_log: List[dict] = []
+        self.rollout = None
+        self._tick_no = 0
 
     def _poll_revivals(self) -> None:
         health = self.router.health
@@ -355,15 +492,64 @@ class ChaosHarness:
             if i not in self._revive_in:
                 self._revive_in[i] = self.revive_after
         for i in list(self._revive_in):
-            if not health.is_up(i):
+            if health.state(i) == "dead":
                 self._revive_in[i] -= 1
                 if self._revive_in[i] <= 0 and \
                         self.revived < self.max_revives:
                     self.router.revive_replica(i, self.engine_factory())
                     self.revived += 1
                     del self._revive_in[i]
-            else:  # pragma: no cover - defensive (revived elsewhere)
+            else:
+                # Revived elsewhere, or deregistered (scale-down of a
+                # replica that crashed mid-drain) — stop counting.
                 del self._revive_in[i]
+
+    # ------------------------------------------- elastic events (ISSUE 17)
+    def _coldest_live(self) -> Optional[int]:
+        """The scale-down victim: the least-loaded full-trust live
+        admitting replica — but never the last one that can admit (a
+        fleet of zero admitting replicas deadlocks by construction)."""
+        router = self.router
+        admitting = [
+            i for i in router._admitting if router.health.can_admit(i)
+        ]
+        cands = [
+            i for i in admitting if router.health.state(i) == "live"
+        ]
+        if not cands or len(admitting) <= 1:
+            return None
+        return min(cands, key=router._load)
+
+    def _fire_elastic(self) -> None:
+        from chainermn_tpu.serving.elastic import RollingDeploy
+
+        while self._elastic and self._elastic[0]["tick"] <= self._tick_no:
+            ev = dict(self._elastic.pop(0))
+            if ev["event"] == "scale_up":
+                ev["replica"] = self.router.add_replica(
+                    self.engine_factory()
+                )
+            elif ev["event"] == "scale_down":
+                victim = self._coldest_live()
+                if victim is None:
+                    ev["skipped"] = "fleet at minimum"
+                else:
+                    ev["replica"] = victim
+                    ev["drain"] = self.router.drain_replica(victim)
+                    self.router.deregister_replica(victim)
+                    self._revive_in.pop(victim, None)
+            elif ev["event"] == "rollout":
+                if self.rollout is None:
+                    self.rollout = RollingDeploy(
+                        self.router, self.engine_factory,
+                        registry=self.registry,
+                    )
+                    ev["replicas"] = list(self.rollout.pending)
+                else:  # pragma: no cover - one rollout per schedule
+                    ev["skipped"] = "rollout already running"
+            self.elastic_log.append(ev)
+        if self.rollout is not None:
+            self.rollout.tick()
 
     def run(self, requests: Sequence) -> dict:
         """Submit ``requests``, drain the fleet under the schedule, and
@@ -376,6 +562,8 @@ class ChaosHarness:
         stall = 0
         while router.pending:
             progressed = router.tick()
+            self._tick_no += 1
+            self._fire_elastic()
             self._poll_revivals()
             if progressed:
                 stall = 0
@@ -387,7 +575,7 @@ class ChaosHarness:
                     + [
                         s.next_arrival()
                         for i, s in enumerate(router.schedulers)
-                        if router.health.is_up(i)
+                        if s is not None and router.health.is_up(i)
                     ]
                 )
                 if t is not None and t > now
@@ -400,6 +588,14 @@ class ChaosHarness:
                 # dead and a revival countdown is running — idle ticks
                 # count it down (this IS progress toward recovery).
                 stall = 0
+            elif self._elastic or (
+                self.rollout is not None
+                and not self.rollout.done and not self.rollout.paused
+            ):
+                # A pending elastic event (a scale-up may be the only
+                # path to capacity) or an in-flight rollout (probation
+                # graduation rides clean ticks) — idle ticks progress it.
+                stall = 0
             else:
                 stall += 1
                 if stall > 3:
@@ -408,9 +604,33 @@ class ChaosHarness:
                         "arrivals, no revivals pending "
                         f"(health={router.health.snapshot()})"
                     )
+        # Let an in-flight rollout finish on an idle fleet (probation
+        # graduation needs clean ticks; bounded by the rollout's own
+        # stall watchdog + this guard).
+        guard = 0
+        while self.rollout is not None and not self.rollout.done \
+                and not self.rollout.paused:
+            router.tick()
+            self._tick_no += 1
+            self._fire_elastic()
+            guard += 1
+            if guard > 4 * router.health.probation_ticks * max(
+                    1, router.health.replicas):
+                raise RuntimeError(
+                    "rollout failed to converge on an idle fleet "
+                    f"(state={router.health.snapshot()})"
+                )
         router.finish()
         report = verify_terminal_invariant(requests, router.completions)
         report["schedule"] = self.schedule
         report["revived"] = self.revived
         report["health"] = router.health.snapshot()
+        if self.elastic_log:
+            report["elastic"] = self.elastic_log
+        if self.rollout is not None:
+            report["rollout"] = {
+                "replaced": list(self.rollout.replaced),
+                "paused": self.rollout.paused,
+                "done": self.rollout.done,
+            }
         return report

@@ -337,9 +337,11 @@ class Router:
         return (occ * cap + qd) / cap + 0.1 * kv
 
     def _admit_candidates(self) -> List[int]:
-        """Admitting-role replicas whose tick loop still runs (live or
-        probation — dead replicas take nothing)."""
-        return [i for i in self._admitting if self.health.is_up(i)]
+        """Admitting-role replicas that may take FRESH work: live or
+        probation only — dead replicas take nothing, and a DRAINING
+        replica (mid-scale-down / mid-rollout) is fenced even though
+        its tick loop still runs (ISSUE 17)."""
+        return [i for i in self._admitting if self.health.can_admit(i)]
 
     def _ranked_replicas(self, probation_ok: bool = True) -> List[int]:
         """Dispatch candidates (admitting, up, with admission headroom)
@@ -474,8 +476,10 @@ class Router:
         # drain ever filled one) is recompute work another decode
         # replica could not prefill faster anyway.  Health discipline:
         # only full-trust LIVE replicas steal (a probation replica gets
-        # fresh admissions only — the circuit breaker), and dead
-        # replicas neither donate (harvested already) nor receive.
+        # fresh admissions only — the circuit breaker), dead replicas
+        # neither donate (harvested already) nor receive, and a
+        # DRAINING replica sits out both sides — the drain is the one
+        # mover of its work (ISSUE 17).
         idle = [
             i for i in self._admitting
             if self.health.state(i) == "live"
@@ -487,7 +491,7 @@ class Router:
         donors = sorted(
             (
                 i for i in self._admitting
-                if self.health.is_up(i)
+                if self.health.can_admit(i)
                 and self.schedulers[i].queue_depth > 0
                 and not self.schedulers[i].has_free_slot
             ),
@@ -665,6 +669,159 @@ class Router:
         self._since_gauge[i] = 0
         self.health.start_probation(i)
 
+    # ------------------------------------------------- elastic (ISSUE 17)
+    def add_replica(self, engine, role: str = "mixed",
+                    fault=None) -> int:
+        """Scale-up: register a NEW replica behind the probation
+        circuit breaker — fresh Scheduler, registry and span ring,
+        sharing the fleet clock and ledger, earning full trust through
+        ``CMN_SERVE_PROBATION_TICKS`` clean ticks exactly like a
+        revival replacement (a cold newcomer must not immediately soak
+        up recovered work or rebalance steals).  Returns the new
+        replica index."""
+        from chainermn_tpu.observability.metrics import MetricsRegistry
+        from chainermn_tpu.observability.tracing import (
+            RequestTimeline,
+            SpanRing,
+        )
+        from chainermn_tpu.serving.disagg import ROLES as _ROLES
+
+        if role not in _ROLES:
+            raise ValueError(f"unknown role {role!r} (one of {_ROLES})")
+        i = len(self.schedulers)
+        ring = SpanRing(4096)
+        reg = MetricsRegistry()
+        self.rings.append(ring)
+        self.replica_registries.append(reg)
+        self.roles.append(role)
+        self.schedulers.append(Scheduler(
+            engine, registry=reg, clock=self.clock,
+            timeline=RequestTimeline(ring=ring), fault=fault,
+            ledger=self.ledger if self.ledger is not None else False,
+        ))
+        self._since_gauge.append(0)
+        self._occ_sum.append(0.0)
+        self.health.add_replica()
+        self.health.start_probation(i)
+        if role != "decode":
+            self._admitting.append(i)
+        return i
+
+    def drain_replica(self, i: int) -> dict:
+        """Scale-down / rolling-deploy drain: fence replica ``i``
+        (DRAINING — no fresh admissions, no rebalance steals), hand its
+        decode-ready slots to the least-loaded full-trust survivor over
+        the cmn-kvmig-1 path (``pack_slots``/``install_payload`` — live
+        KV moves through the one-variant programs, the survivor never
+        recompiles), and re-dispatch its still-prefilling slots and
+        queued entries as recompute entries (carried tokens ride
+        along, the eviction-requeue discipline).  Nothing is lost; the
+        replica ends empty and fenced, ready for
+        :meth:`deregister_replica` (scale-down) or
+        :meth:`retire_replica` + :meth:`revive_replica` (rollout).
+
+        The slot handoff is a ``migrate`` fault site: ``drop@migrate``
+        loses the frame BEFORE any detach, so the slots stay
+        source-held and fall back to the recompute path — detected
+        immediately, zero loss.  A replica that crashes mid-drain
+        downgrades to the fault boundary (:meth:`_on_replica_death`):
+        marked dead, work harvested — the terminal invariant holds
+        either way."""
+        from chainermn_tpu.serving import disagg as _disagg
+
+        if not self.health.is_draining(i):
+            self.health.start_draining(i)
+        s = self.schedulers[i]
+        summary = {
+            "replica": i, "slots_migrated": 0, "entries_requeued": 0,
+            "dropped_frames": 0,
+        }
+        try:
+            ready = s.ready_slots()
+            survivors = [
+                j for j in self._admitting
+                if j != i and self.health.state(j) == "live"
+            ]
+            if ready and survivors:
+                if self._fault is not None and \
+                        self._fault.hook("migrate") == "drop":
+                    # Handoff frame lost on the wire — detected here
+                    # (nothing detached yet); the slots fall back to
+                    # the recompute path below.
+                    summary["dropped_frames"] += 1
+                    self.health.m_retries.inc()
+                else:
+                    dest = min(survivors, key=self._load)
+                    installed, queued = _disagg.handoff_slots(
+                        s, self.schedulers[dest], ready
+                    )
+                    for slot in ready:
+                        self.assignments.setdefault(
+                            slot.entry.req.id, []
+                        ).append(dest)
+                        self._m_migr.inc()
+                    self._since_gauge[dest] += installed
+                    summary["slots_migrated"] = installed
+                    summary["entries_requeued"] += queued
+                    summary["dest"] = dest
+        except Exception as exc:
+            self._on_replica_death(i, exc)
+            summary["crashed"] = f"{type(exc).__name__}: {exc}"
+            return summary
+        for entry in s.harvest_entries():
+            summary["entries_requeued"] += 1
+            self._redispatch(entry)
+        s.finish()
+        return summary
+
+    def retire_replica(self, i: int) -> None:
+        """Rolling-deploy seam: a DRAINED replica steps aside (state
+        ``dead``, orderly — not a counted failure) so
+        :meth:`revive_replica` can register the new-version engine
+        behind probation.  Its finished completions move to the
+        router's books first — ``revive_replica`` replaces the
+        Scheduler wholesale, and the old incarnation's terminals must
+        survive that."""
+        s = self.schedulers[i]
+        if s is not None and s.pending:
+            raise ValueError(
+                f"replica {i} still holds work — drain it first"
+            )
+        if s is not None:
+            self._router_completions.extend(s.completions)
+            s.completions = []
+        self.health.mark_retired(i)
+
+    def deregister_replica(self, i: int) -> None:
+        """Scale-down final step: remove a DRAINED (or crashed
+        mid-drain, hence dead-and-harvested) replica and fully release
+        its state — scheduler (whose weakref'd flight/incident
+        providers die with it), span ring, metrics registry, and the
+        FleetHealth row (tombstoned ``removed`` so historical indices
+        stay stable).  Its finished completions move to the router's
+        books first, so :attr:`completions` and the fleet ledger's
+        conservation hold across the removal (ISSUE 17 satellite: a
+        long-lived fleet that scales down must not leak)."""
+        st = self.health.state(i)
+        if st not in ("draining", "dead"):
+            raise ValueError(
+                f"replica {i} is {st!r} — only a draining or dead "
+                "replica can be deregistered (drain it first)"
+            )
+        s = self.schedulers[i]
+        if s is not None and s.pending:
+            raise ValueError(
+                f"replica {i} still holds work — drain it first"
+            )
+        if s is not None:
+            self._router_completions.extend(s.completions)
+            s.completions = []
+        self.health.remove_replica(i)
+        self.schedulers[i] = None
+        self.rings[i] = None
+        self.replica_registries[i] = None
+        self._admitting = [j for j in self._admitting if j != i]
+
     def queued_requests(self) -> List[Request]:
         """The router holdback queue (oldest first) — chaos-harness /
         dashboard introspection."""
@@ -684,7 +841,7 @@ class Router:
         of a revived replica."""
         progressed = self._dispatch()
         for i, s in enumerate(self.schedulers):
-            if not self.health.is_up(i):
+            if s is None or not self.health.is_up(i):
                 continue
             try:
                 if s.tick():
@@ -724,7 +881,7 @@ class Router:
         registry has not published yet; a dead one's gauges are stale
         — its harvested slots are empty, which is what the host truth
         reads)."""
-        if not self.health.is_up(i):
+        if self.schedulers[i] is None or not self.health.is_up(i):
             return 0.0
         o = self._gauge(i, "serve.slot_occupancy")
         return o if o is not None else self.schedulers[i].slot_occupancy
@@ -734,7 +891,7 @@ class Router:
         return bool(
             self._queue or self._recovered or any(
                 s.pending for i, s in enumerate(self.schedulers)
-                if self.health.is_up(i)
+                if s is not None and self.health.is_up(i)
             )
         )
 
@@ -752,7 +909,7 @@ class Router:
                     t for t in (
                         s.next_arrival()
                         for i, s in enumerate(self.schedulers)
-                        if self.health.is_up(i)
+                        if s is not None and self.health.is_up(i)
                     ) if t is not None
                 ]
                 if not nxt:  # pragma: no cover - defensive
@@ -771,7 +928,7 @@ class Router:
         (a dead replica's books closed at harvest — its process would
         be gone in a real fleet)."""
         for i, s in enumerate(self.schedulers):
-            if self.health.is_up(i):
+            if s is not None and self.health.is_up(i):
                 s.finish()
         self._m_rq.set(len(self._queue))
         self._m_spread.set(0.0)
@@ -785,11 +942,14 @@ class Router:
         verdicts (poisoned / shed), merged."""
         out: List[Completion] = list(self._router_completions)
         for s in self.schedulers:
-            out.extend(s.completions)
+            if s is not None:
+                out.extend(s.completions)
         return sorted(out, key=lambda c: (c.finished_at, c.id))
 
     def replica_stats(self) -> List[dict]:
-        """Per-replica host-side summary (benchmarks/dashboards)."""
+        """Per-replica host-side summary (benchmarks/dashboards).  A
+        deregistered replica keeps its row (historical dispatch counts
+        stay attributable) but its live state is gone."""
         out = []
         for i, s in enumerate(self.schedulers):
             out.append({
@@ -804,11 +964,11 @@ class Router:
                     1 for reps in self.assignments.values()
                     if reps and reps[-1] == i
                 ),
-                "completions": len(s.completions),
+                "completions": len(s.completions) if s is not None else 0,
                 "occupancy_mean": (
                     self._occ_sum[i] / self._occ_n if self._occ_n else 0.0
                 ),
-                "engine": s.engine.stats(),
+                "engine": s.engine.stats() if s is not None else None,
             })
         return out
 
@@ -832,6 +992,7 @@ class Router:
                 "epoch_perf": _tracing.EPOCH_PERF,
             }
             for i, ring in enumerate(self.rings)
+            if ring is not None  # deregistered replicas released theirs
         ]
         merged = _fleet.merge_fleet_trace(dumps)
         merged["summary"]["path"] = _fleet.write_fleet_trace(

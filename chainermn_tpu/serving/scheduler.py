@@ -629,6 +629,17 @@ class Scheduler:
             )
         return entry
 
+    def ready_slots(self) -> List["_Slot"]:
+        """Live DECODE-READY slots (prefill finished) — the set a
+        cmn-kvmig-1 pack may ship with live KV (``disagg.pack_slots``
+        raises on a still-prefilling slot).  The drain/scale-down
+        handoff (ISSUE 17) moves these; still-prefilling slots and the
+        queue travel as recompute entries via :meth:`harvest_entries`
+        instead."""
+        return [
+            s for s in self._slots if s is not None and not s.prefilling
+        ]
+
     def harvest_entries(self) -> List[_QueueEntry]:
         """Strip EVERYTHING this replica holds — live slots and queued
         entries — into recompute ``_QueueEntry`` s, for the router's

@@ -1,0 +1,743 @@
+"""Elastic-fleet battery (ISSUE 17): closed-loop autoscaling +
+zero-downtime rolling deploys under chaos.
+
+The acceptance invariants: a scale-down drains every live slot and
+queued entry to survivors over the cmn-kvmig-1 path (nothing lost,
+survivors never recompile); a scale-up registers behind the probation
+breaker; deregistration fully releases the replica's state (weakref-gc
+proof) and the ledger's conservation oracle still holds; a mid-traffic
+rolling deploy replaces every replica with zero lost / duplicated
+requests and ``decode_compiles == 1`` per survivor, pausing with a
+critical incident when a replica dies mid-rollout; the autoscaler's
+hysteresis + cooldown keep bursty gauges from flapping the fleet (a
+suppressed reversal counts ``serve.autoscale.flap`` — a pinned critical
+default rule, like ``rollout_stalled``); and the chaos harness's
+terminal invariant holds across every elastic event, including
+``crash@serve_step`` during a drain and ``drop@migrate`` on the
+scale-down handoff.
+"""
+
+import gc
+import weakref
+
+import pytest
+
+from chainermn_tpu.observability.metrics import MetricsRegistry
+from chainermn_tpu.resilience.faults import (
+    FaultInjector,
+    parse_fault_spec,
+)
+from chainermn_tpu.serving import (
+    Autoscaler,
+    ChaosHarness,
+    DecodeEngine,
+    Request,
+    RollingDeploy,
+    Router,
+    chaos_schedule,
+    verify_terminal_invariant,
+)
+from chainermn_tpu.serving.recovery import FleetHealth
+
+pytestmark = [pytest.mark.tier1, pytest.mark.serving]
+
+
+def _mk_engine(make_model, tiny_params, capacity=2, num_blocks=24,
+               params=None):
+    return DecodeEngine(
+        make_model(), params if params is not None else tiny_params,
+        capacity=capacity, num_blocks=num_blocks, block_len=8,
+        prefill_chunk=8,
+    )
+
+
+def _inj(spec):
+    return FaultInjector(parse_fault_spec(spec))
+
+
+def _reqs(prompts, n, max_new=5, **kw):
+    return [
+        Request(id=i, prompt=prompts[i % len(prompts)],
+                max_new_tokens=max_new, **kw)
+        for i in range(n)
+    ]
+
+
+# ------------------------------------------------- FleetHealth (satellite)
+def test_fleet_health_draining_transitions():
+    """The explicit DRAINING state: entered from live/probation only,
+    still up (the drain itself ticks) but fenced from admission;
+    retirement is an orderly exit (not a counted death); removal
+    tombstones the row at a stable index."""
+    reg = MetricsRegistry()
+    h = FleetHealth(2, registry=reg, probation_ticks=2)
+    h.start_draining(0)
+    assert h.state(0) == "draining"
+    assert h.is_up(0) and h.is_draining(0) and not h.can_admit(0)
+    assert reg.peek("serve.health.draining").value == 1
+    with pytest.raises(ValueError):
+        h.start_draining(0)          # already draining
+    h.mark_retired(0)
+    assert h.state(0) == "dead"
+    assert reg.peek("serve.health.replica_dead").value == 0  # orderly exit
+    assert reg.peek("serve.health.draining").value == 0
+    # A mid-drain crash IS a counted death.
+    h.start_draining(1)
+    h.mark_dead(1, "crashed mid-drain")
+    assert reg.peek("serve.health.replica_dead").value == 1
+    # Growth + removal keep historical indices stable.
+    j = h.add_replica()
+    assert j == 2 and h.state(j) == "dead"
+    h.start_probation(j)
+    assert h.can_admit(j)
+    h.remove_replica(0)
+    assert h.state(0) == "removed" and not h.is_up(0)
+    assert h.replicas == 3              # tombstone row keeps indices stable
+    with pytest.raises(ValueError):
+        h.remove_replica(j)             # probation is not removable
+    with pytest.raises(ValueError):
+        h.start_draining(0)             # tombstones stay tombstones
+
+
+def test_draining_replica_fenced_from_admissions_and_steals(
+    make_model, tiny_params, prompts
+):
+    """Satellite: DRAINING fences a replica out of fresh admissions AND
+    rebalance — every request lands on the healthy replica while the
+    fenced one ticks along untouched."""
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=MetricsRegistry(),
+    )
+    router.health.start_draining(1)
+    assert router._admit_candidates() == [0]
+    comps = router.run(_reqs(prompts, 4, max_new=4))
+    assert len(comps) == 4 and all(c.status == "ok" for c in comps)
+    assert all(reps == [0] for reps in router.assignments.values())
+    assert router.schedulers[1]._iterations == 0
+
+
+# --------------------------------------------------- scale-up / scale-down
+@pytest.mark.slow  # tier-1 wall budget: the autoscaler backlog test +
+# the chaos scale_up events cover registration-behind-probation fast
+def test_scale_up_registers_behind_probation(make_model, tiny_params,
+                                             prompts, oracle):
+    """Tentpole seam: ``add_replica`` grows the fleet behind the
+    probation breaker — the newcomer ranks behind live replicas, takes
+    no recovered work, and graduates through clean ticks."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)],
+        registry=reg, probation_ticks=2,
+    )
+    i = router.add_replica(_mk_engine(make_model, tiny_params,
+                                      capacity=1))
+    assert i == 1
+    assert router.health.state(1) == "probation"
+    assert reg.peek("serve.health.probation").value == 1
+    assert router._ranked_replicas()[0] == 0       # live outranks newcomer
+    assert router._ranked_replicas(probation_ok=False) == [0]
+    comps = router.run(_reqs(prompts, 4, max_new=4))
+    assert len(comps) == 4 and all(c.status == "ok" for c in comps)
+    assert router.health.state(1) == "live"        # clean ticks graduated
+    for c in comps:
+        assert c.tokens == oracle(
+            router.schedulers[0].engine.model, tiny_params,
+            prompts[c.id % len(prompts)], 4,
+        )
+
+
+def test_scale_down_drains_zero_loss_no_recompile(
+    make_model, tiny_params, prompts, oracle
+):
+    """The scale-down acceptance: mid-traffic drain hands live
+    decode-ready slots to the survivor over cmn-kvmig-1 and requeues
+    the rest — every request completes exactly once, greedy-identical
+    to the oracle, the survivor's decode step never recompiles, the
+    drained replica releases every block, and the fleet ledger's
+    conservation oracle holds across the removal."""
+    from chainermn_tpu.observability.ledger import CostLedger
+
+    reg = MetricsRegistry()
+    ledger = CostLedger(registry=reg)
+    router = Router(
+        [_mk_engine(make_model, tiny_params) for _ in range(2)],
+        registry=reg, ledger=ledger,
+    )
+    n, max_new = 6, 6
+    reqs = _reqs(prompts, n, max_new=max_new)
+    for r in reqs:
+        router.submit(r)
+    for _ in range(5):              # both replicas mid-decode
+        router.tick()
+    victim = router.schedulers[1]
+    assert victim.pending
+    summary = router.drain_replica(1)
+    assert "crashed" not in summary
+    assert summary["slots_migrated"] >= 1 and summary["dest"] == 0
+    assert not victim.pending       # drained empty
+    assert victim.memory.check_drained(victim.engine) == 0
+    router.deregister_replica(1)
+    comps = router.run()
+    report = verify_terminal_invariant(reqs, router.completions)
+    assert report["holds"], report
+    assert all(c.status == "ok" for c in router.completions)
+    survivor = router.schedulers[0]
+    assert survivor.engine.decode_compiles == 1   # install never recompiles
+    for c in router.completions:
+        assert c.tokens == oracle(
+            survivor.engine.model, tiny_params,
+            prompts[c.id % len(prompts)], max_new,
+        ), (c.id, c.retries)
+    assert survivor.memory.check_drained(survivor.engine) == 0
+    assert ledger.verify_conservation(reqs)["holds"]
+    assert reg.peek("serve.router.migrated").value >= 1
+
+
+@pytest.mark.slow  # tier-1 wall budget: the chaos crash-during-drain
+# test exercises drop@migrate on the drain path in tier-1
+def test_scale_down_drop_migrate_falls_back_to_recompute(
+    make_model, tiny_params, prompts, oracle
+):
+    """``drop@migrate`` on the scale-down handoff loses the frame
+    BEFORE any detach: the slots fall back to the recompute path —
+    detected immediately (retry counted), zero requests lost."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params) for _ in range(2)],
+        registry=reg, fault=_inj("drop@migrate:1"),
+    )
+    n, max_new = 4, 12
+    reqs = _reqs(prompts, n, max_new=max_new)
+    for r in reqs:
+        router.submit(r)
+    for _ in range(3):
+        router.tick()
+    assert router.schedulers[1].ready_slots()
+    summary = router.drain_replica(1)
+    assert summary["dropped_frames"] == 1
+    assert summary["slots_migrated"] == 0
+    assert summary["entries_requeued"] >= 1       # recompute path took over
+    router.deregister_replica(1)
+    comps = router.run()
+    report = verify_terminal_invariant(reqs, router.completions)
+    assert report["holds"], report
+    assert all(c.status == "ok" for c in router.completions)
+    for c in router.completions:
+        assert c.tokens == oracle(
+            router.schedulers[0].engine.model, tiny_params,
+            prompts[c.id % len(prompts)], max_new,
+        )
+    assert reg.peek("serve.health.retries").value >= 1
+
+
+def test_deregister_releases_replica_state(make_model, tiny_params,
+                                           prompts):
+    """Satellite: deregistration drops every strong reference to the
+    replica's scheduler, span ring and metrics registry (weakref-gc
+    proof) and moves its finished completions to the router's books."""
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=MetricsRegistry(),
+    )
+    comps = router.run(_reqs(prompts, 2, max_new=3))
+    assert len(comps) == 2
+    refs = (
+        weakref.ref(router.schedulers[1]),
+        weakref.ref(router.rings[1]),
+        weakref.ref(router.replica_registries[1]),
+    )
+    done_before = {c.id for c in router.completions}
+    router.drain_replica(1)
+    router.deregister_replica(1)
+    gc.collect()
+    assert all(r() is None for r in refs), [r() for r in refs]
+    # Books survived the removal.
+    assert {c.id for c in router.completions} == done_before
+    assert router.health.state(1) == "removed"
+    # Removed rows are inert: dispatch, stats and traces all skip them.
+    assert router._admit_candidates() == [0]
+    assert router.replica_stats()[1]["engine"] is None
+    router.run(_reqs(prompts, 1, max_new=3))
+
+
+# -------------------------------------------------------------- autoscaler
+def test_autoscaler_scales_up_on_backlog(make_model, tiny_params,
+                                         prompts):
+    """Queue depth past CMN_SERVE_SCALE_UP_DEPTH for ``hysteresis``
+    consecutive ticks grows the fleet — behind probation — and the
+    decision is recorded + counted."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)],
+        registry=reg, max_queue=1,
+    )
+    scaler = Autoscaler(
+        router, lambda: _mk_engine(make_model, tiny_params, capacity=1),
+        registry=reg, up_depth=3, hysteresis=2, cooldown_ticks=4,
+        max_replicas=2,
+    )
+    for r in _reqs(prompts, 8, max_new=3):
+        router.submit(r)
+    router.tick()                       # dispatch: deep holdback remains
+    assert scaler.tick() is None        # streak 1 of 2
+    action = scaler.tick()
+    assert action == {"tick": 2, "action": "scale_up", "replica": 1,
+                      "reason": "autoscale_up_backlog"}
+    assert router.health.state(1) == "probation"
+    assert reg.peek("serve.autoscale.scale_up").value == 1
+    assert reg.peek("serve.autoscale.replicas").value == 2
+    assert scaler.tick() is None        # cooldown holds the fleet
+    comps = router.run()
+    assert len(comps) == 8 and all(c.status == "ok" for c in comps)
+    assert scaler.replica_ticks >= 3
+
+
+def test_autoscaler_scales_down_idle_fleet_to_min(make_model,
+                                                  tiny_params):
+    """Idle occupancy below CMN_SERVE_SCALE_DOWN_OCC with an empty
+    queue retires the coldest live replica — never past
+    CMN_SERVE_SCALE_MIN."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(3)],
+        registry=reg,
+    )
+    scaler = Autoscaler(
+        router, lambda: _mk_engine(make_model, tiny_params, capacity=1),
+        registry=reg, down_occ=0.3, hysteresis=2, cooldown_ticks=0,
+        min_replicas=2,
+    )
+    actions = [a for _ in range(6) if (a := scaler.tick()) is not None]
+    assert [a["action"] for a in actions] == ["scale_down"]
+    assert sum(1 for i in range(3) if router.health.is_up(i)) == 2
+    assert reg.peek("serve.autoscale.scale_down").value == 1
+    assert reg.peek("serve.autoscale.replicas").value == 2
+    removed = actions[0]["replica"]
+    assert router.health.state(removed) == "removed"
+    assert router.schedulers[removed] is None
+
+
+@pytest.mark.slow  # tier-1 wall budget: the scale_flap rule contract
+# stays tier-1-pinned in test_elastic_default_incident_rules_pinned
+def test_autoscaler_cooldown_suppresses_flap(make_model, tiny_params,
+                                             prompts, tmp_path):
+    """A reversal inside the cooldown window is the flap the damping
+    absorbs: suppressed, counted on ``serve.autoscale.flap``, and the
+    critical ``scale_flap`` default rule files on it."""
+    from chainermn_tpu.observability.incident import (
+        IncidentManager,
+        default_rules,
+    )
+
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg, max_queue=1,
+    )
+    scaler = Autoscaler(
+        router, lambda: _mk_engine(make_model, tiny_params, capacity=1),
+        registry=reg, up_depth=3, down_occ=0.3, hysteresis=1,
+        cooldown_ticks=16, min_replicas=1, max_replicas=3,
+    )
+    # Burst: one tick of deep backlog scales up...
+    for r in _reqs(prompts, 8, max_new=3):
+        router.submit(r)
+    router.tick()
+    assert scaler.tick()["action"] == "scale_up"
+    # ...then the burst drains and the idle signal fires INSIDE the
+    # cooldown — suppressed, not acted on.
+    router.run()
+    assert scaler.tick() is None
+    assert scaler.flaps == 1
+    assert reg.peek("serve.autoscale.flap").value == 1
+    assert sum(1 for i in range(3) if router.health.is_up(i)) == 3
+    mgr = IncidentManager(
+        registry=reg,
+        rules=[r for r in default_rules() if r.name == "scale_flap"],
+        directory=str(tmp_path), cooldown_s=0.0,
+    )
+    fired = mgr.evaluate()
+    assert len(fired) == 1 and fired[0]["rule"]["name"] == "scale_flap"
+
+
+@pytest.mark.slow  # tier-1 wall budget: the watch-wiring assertions
+# here are structural; the flap-free bench arm is the acceptance
+def test_autoscaler_down_hysteresis_damps_transient(make_model,
+                                                    tiny_params,
+                                                    prompts):
+    """``down_hysteresis`` gives the down watch a longer streak than
+    the up watches: the same one-tick idle dip that counts a flap at
+    ``hysteresis=1`` never even registers as an urge at
+    ``down_hysteresis=3`` — the aggressive-up configuration the
+    elastic bench runs with zero flaps."""
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg, max_queue=1,
+    )
+    scaler = Autoscaler(
+        router, lambda: _mk_engine(make_model, tiny_params, capacity=1),
+        registry=reg, up_depth=3, down_occ=0.3, hysteresis=1,
+        down_hysteresis=3, cooldown_ticks=16, min_replicas=1,
+        max_replicas=3,
+    )
+    down = [w for w, d in scaler.watches if d < 0]
+    assert [w.hysteresis for w in down] == [3]
+    assert all(
+        w.hysteresis == 1 for w, d in scaler.watches if d > 0
+    )
+    for r in _reqs(prompts, 8, max_new=3):
+        router.submit(r)
+    router.tick()
+    assert scaler.tick()["action"] == "scale_up"
+    # Burst drains; two idle evaluations inside the cooldown stay
+    # below the 3-tick down streak — no urge, no flap.
+    router.run()
+    assert scaler.tick() is None
+    assert scaler.tick() is None
+    assert scaler.flaps == 0
+    assert reg.peek("serve.autoscale.flap").value == 0
+
+
+def test_autoscaler_noop_instruments_when_obs_off(make_model,
+                                                  tiny_params,
+                                                  monkeypatch):
+    """The obs A/B contract: with no explicit registry and the master
+    switch off, the autoscaler publishes through noop stubs — zero
+    instrument overhead on the control loop."""
+    from chainermn_tpu.observability.metrics import NoopInstrument
+
+    monkeypatch.setenv("CMN_OBS", "0")
+    router = Router([_mk_engine(make_model, tiny_params, capacity=1)],
+                    registry=MetricsRegistry())
+    scaler = Autoscaler(
+        router, lambda: _mk_engine(make_model, tiny_params, capacity=1),
+    )
+    assert isinstance(scaler._m_flap, NoopInstrument)
+    assert isinstance(scaler._m_replicas, NoopInstrument)
+    assert scaler.tick() is None        # control loop still runs
+
+
+def test_elastic_env_knob_parsing(monkeypatch):
+    from chainermn_tpu.serving import elastic
+
+    monkeypatch.setenv("CMN_SERVE_SCALE_UP_DEPTH", "9")
+    monkeypatch.setenv("CMN_SERVE_SCALE_UP_DRIFT", "0.5")
+    monkeypatch.setenv("CMN_SERVE_SCALE_DOWN_OCC", "0.1")
+    monkeypatch.setenv("CMN_SERVE_SCALE_HYSTERESIS", "4")
+    monkeypatch.setenv("CMN_SERVE_SCALE_COOLDOWN_TICKS", "32")
+    monkeypatch.setenv("CMN_SERVE_SCALE_MIN", "2")
+    monkeypatch.setenv("CMN_SERVE_SCALE_MAX", "6")
+    monkeypatch.setenv("CMN_SERVE_ROLLOUT_TIMEOUT_TICKS", "64")
+    assert elastic.scale_up_depth_from_env() == 9
+    assert elastic.scale_up_drift_from_env() == 0.5
+    assert elastic.scale_down_occ_from_env() == 0.1
+    assert elastic.scale_hysteresis_from_env() == 4
+    assert elastic.scale_cooldown_from_env() == 32
+    assert elastic.scale_bounds_from_env() == (2, 6)
+    assert elastic.rollout_timeout_from_env() == 64
+    monkeypatch.setenv("CMN_SERVE_SCALE_MAX", "1")   # max clamps to min
+    assert elastic.scale_bounds_from_env() == (2, 2)
+    monkeypatch.setenv("CMN_SERVE_SCALE_UP_DEPTH", "junk")
+    assert elastic.scale_up_depth_from_env() == 4    # default
+
+
+# ---------------------------------------------------------- rolling deploy
+def test_rolling_deploy_checkpointed_params_zero_loss(
+    make_model, tiny_params, prompts, oracle, tmp_path
+):
+    """The rollout acceptance: a mid-traffic rolling deploy with
+    checkpointer-loaded params as the "new model version" replaces
+    every replica one at a time — health-gated on probation graduation
+    — with zero lost / duplicated requests, greedy outputs identical to
+    the oracle, and one decode compile per replacement engine."""
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.extensions import create_multi_node_checkpointer
+
+    # Round-trip the weights through the real checkpointer: what the
+    # rollout loads is what a deploy pipeline would publish.
+    comm = cmn.create_communicator("xla")
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    state = opt.init(tiny_params)
+    ckpt = create_multi_node_checkpointer(
+        "deploy", comm, path=str(tmp_path), async_save=False
+    )
+    ckpt.save(state)
+    ckpt.finalize()
+    ckpt2 = create_multi_node_checkpointer(
+        "deploy", comm, path=str(tmp_path), async_save=False
+    )
+    restored, _ = ckpt2.maybe_load(opt.init(tiny_params))
+    new_params = restored.params
+
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params) for _ in range(2)],
+        registry=reg, probation_ticks=2,
+    )
+    old_engines = [s.engine for s in router.schedulers]
+    n, max_new = 6, 5
+    reqs = _reqs(prompts, n, max_new=max_new)
+    for r in reqs:
+        router.submit(r)
+    for _ in range(3):                  # traffic in flight before rollout
+        router.tick()
+    rollout = RollingDeploy(
+        router, lambda params: _mk_engine(make_model, tiny_params,
+                                          params=params),
+        params=new_params, registry=reg, timeout_ticks=64,
+    )
+    assert rollout.pending == [0, 1]
+    guard = 0
+    while not rollout.done:
+        router.tick()
+        rollout.tick()
+        guard += 1
+        assert guard < 200, (rollout.replaced, rollout.paused)
+    assert not rollout.paused
+    assert rollout.replaced == [0, 1]
+    assert reg.peek("serve.rollout.replaced").value == 2
+    assert reg.peek("serve.rollout.in_progress").value == 0
+    router.run()
+    report = verify_terminal_invariant(reqs, router.completions)
+    assert report["holds"], report
+    assert all(c.status == "ok" for c in router.completions)
+    for i, s in enumerate(router.schedulers):
+        assert s.engine is not old_engines[i]      # really replaced
+        assert s.engine.decode_compiles <= 1
+        assert s.memory.check_drained(s.engine) == 0
+        assert router.health.state(i) == "live"
+    for c in router.completions:
+        assert c.tokens == oracle(
+            router.schedulers[0].engine.model, tiny_params,
+            prompts[c.id % len(prompts)], max_new,
+        ), (c.id, c.retries)
+
+
+def test_rollout_pauses_and_files_incident_on_death(
+    make_model, tiny_params, prompts, tmp_path
+):
+    """A replica dying mid-rollout PAUSES the rollout and files a
+    critical ``rollout_interrupted`` incident instead of marching the
+    fleet down; ``resume()`` continues after operator action."""
+    from chainermn_tpu.observability.incident import IncidentManager
+
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg, probation_ticks=1,
+    )
+    router.incidents = IncidentManager(
+        registry=reg, rules=[], directory=str(tmp_path), cooldown_s=0.0
+    )
+    rollout = RollingDeploy(
+        router, lambda: _mk_engine(make_model, tiny_params, capacity=1),
+        registry=reg,
+    )
+    # Replica 1 dies while still awaiting its rollout turn.
+    router.health.mark_dead(1, "chaos")
+    guard = 0
+    while not rollout.paused and not rollout.done:
+        router.tick()
+        rollout.tick()
+        guard += 1
+        assert guard < 50
+    assert rollout.paused and not rollout.done
+    bundles = [p.name for p in tmp_path.iterdir() if p.is_dir()]
+    assert any("rollout_interrupted" in b for b in bundles), bundles
+    # Operator revives the dead replica, acknowledges, rollout resumes.
+    router.revive_replica(1, _mk_engine(make_model, tiny_params,
+                                        capacity=1))
+    rollout.resume()
+    assert not rollout.paused
+
+
+def test_rollout_stall_watchdog_counts_and_rule_fires(
+    make_model, tiny_params, tmp_path
+):
+    """A rollout step stuck past CMN_SERVE_ROLLOUT_TIMEOUT_TICKS counts
+    ``serve.rollout.stalled`` exactly once, and the pinned critical
+    ``rollout_stalled`` default rule files on it."""
+    from chainermn_tpu.observability.incident import (
+        IncidentManager,
+        default_rules,
+    )
+
+    reg = MetricsRegistry()
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg, probation_ticks=500,   # graduation never comes
+    )
+    rollout = RollingDeploy(
+        router, lambda: _mk_engine(make_model, tiny_params, capacity=1),
+        registry=reg, timeout_ticks=3,
+    )
+    for _ in range(6):
+        rollout.tick()
+    assert reg.peek("serve.rollout.stalled").value == 1   # counted once
+    assert not rollout.done and not rollout.paused
+    mgr = IncidentManager(
+        registry=reg,
+        rules=[r for r in default_rules()
+               if r.name == "rollout_stalled"],
+        directory=str(tmp_path), cooldown_s=0.0,
+    )
+    fired = mgr.evaluate()
+    assert len(fired) == 1 and fired[0]["rule"]["name"] == "rollout_stalled"
+
+
+@pytest.mark.parametrize("rule_name,metric", [
+    ("scale_flap", "serve.autoscale.flap"),
+    ("rollout_stalled", "serve.rollout.stalled"),
+])
+def test_elastic_default_incident_rules_pinned(tmp_path, rule_name,
+                                               metric):
+    """CI/tooling satellite pin (like ``router_backlog`` and
+    ``replica_dead``): the shipped rule set watches the elastic plane's
+    counters as CRITICAL key_by_value rules."""
+    from chainermn_tpu.observability.incident import (
+        IncidentManager,
+        default_rules,
+    )
+
+    rules = [r for r in default_rules() if r.name == rule_name]
+    assert rules and rules[0].metric == metric
+    assert rules[0].severity == "critical"
+    assert rules[0].key_by_value
+    reg = MetricsRegistry()
+    mgr = IncidentManager(
+        registry=reg, rules=rules, directory=str(tmp_path),
+        cooldown_s=0.0,
+    )
+    assert mgr.evaluate() == []
+    reg.counter(metric).inc()
+    fired = mgr.evaluate()
+    assert len(fired) == 1 and fired[0]["rule"]["name"] == rule_name
+    assert mgr.evaluate() == []          # latched
+    reg.counter(metric).inc()            # each flap/stall is a new incident
+    assert len(mgr.evaluate()) == 1
+
+
+# ----------------------------------------------------------- chaos battery
+def test_chaos_schedule_elastic_events_seeded():
+    a = chaos_schedule(7, 3, scale_ups=2, scale_downs=1, rollout_at=9)
+    b = chaos_schedule(7, 3, scale_ups=2, scale_downs=1, rollout_at=9)
+    assert a == b
+    events = a["elastic"]
+    assert [e["tick"] for e in events] == sorted(e["tick"] for e in events)
+    kinds = [e["event"] for e in events]
+    assert kinds.count("scale_up") == 2
+    assert kinds.count("scale_down") == 1
+    assert {"tick": 9, "event": "rollout"} in events
+    assert "elastic" not in chaos_schedule(7, 3)
+
+
+def _elastic_chaos_drive(make_model, tiny_params, prompts, oracle,
+                         schedule, n=8, max_new=5, **harness_kw):
+    reg = MetricsRegistry()
+    harness = ChaosHarness(
+        lambda: _mk_engine(make_model, tiny_params),
+        replicas=3, seed=0, registry=reg, revive_after=2,
+        schedule=schedule, probation_ticks=4, **harness_kw,
+    )
+    reqs = _reqs(prompts, n, max_new=max_new)
+    report = harness.run(reqs)
+    assert report["holds"], report
+    router = harness.router
+    for i, s in enumerate(router.schedulers):
+        if s is None or not router.health.is_up(i):
+            continue
+        assert s.engine.decode_compiles <= 1, (i, report)
+        assert s.memory.check_drained(s.engine) == 0, i
+    for c in router.completions:
+        if c.status == "ok":
+            assert c.tokens == oracle(
+                make_model(), tiny_params,
+                prompts[c.id % len(prompts)], max_new,
+            ), (c.id, c.retries, c.evictions)
+    return harness, report, reg
+
+
+def test_chaos_crash_during_scale_down_drain(make_model, tiny_params,
+                                             prompts, oracle):
+    """The acceptance schedule: a replica crash lands while the fleet
+    is scaling (scale-up then scale-down mid-traffic), and the
+    scale-down handoff frame drops on the wire — the terminal
+    invariant holds across every event, zero lost / duplicated."""
+    schedule = {
+        "seed": None,
+        "replica_faults": [
+            None, "crash@serve_step:3", None,
+        ],
+        "router_faults": "drop@migrate:1",
+        "elastic": [
+            {"tick": 2, "event": "scale_up"},
+            {"tick": 5, "event": "scale_down"},
+        ],
+    }
+    harness, report, reg = _elastic_chaos_drive(
+        make_model, tiny_params, prompts, oracle, schedule,
+    )
+    events = {e["event"]: e for e in report["elastic"]}
+    assert "replica" in events["scale_up"]
+    assert "drain" in events["scale_down"]
+    assert reg.peek("serve.health.replica_dead").value >= 1
+    assert reg.peek("serve.autoscale.replicas") is None  # harness drives
+    removed = events["scale_down"]["replica"]
+    assert harness.router.health.state(removed) == "removed"
+
+
+def test_chaos_mid_traffic_rollout_zero_loss(make_model, tiny_params,
+                                             prompts, oracle):
+    """Mid-traffic rolling deploy under a lossy wire: every initial
+    replica is replaced, the rollout converges, and every request
+    terminates exactly once with oracle-identical tokens."""
+    schedule = {
+        "seed": None,
+        "replica_faults": [None, None, None],
+        "router_faults": "drop@migrate:1",
+        "elastic": [{"tick": 3, "event": "rollout"}],
+    }
+    harness, report, reg = _elastic_chaos_drive(
+        make_model, tiny_params, prompts, oracle, schedule,
+        max_revives=0,
+    )
+    assert report["rollout"]["done"] and not report["rollout"]["paused"]
+    assert sorted(report["rollout"]["replaced"]) == [0, 1, 2]
+    assert reg.peek("serve.rollout.replaced").value == 3
+    assert all(c.status == "ok" for c in harness.router.completions)
+
+
+def test_chaos_seeded_elastic_battery(make_model, tiny_params, prompts,
+                                      oracle):
+    """The randomized arm: a seeded schedule mixing crashes with
+    scale-ups and scale-downs — the invariant holds whatever
+    interleaving the seed draws."""
+    schedule = chaos_schedule(11, 3, scale_ups=1, scale_downs=1)
+    _elastic_chaos_drive(
+        make_model, tiny_params, prompts, oracle, schedule, n=6,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [2, 5, 9])
+def test_chaos_elastic_seed_sweep(make_model, tiny_params, prompts,
+                                  oracle, seed):
+    """Long randomized variant: more seeds, rollout + scaling + crashes
+    in one run."""
+    schedule = chaos_schedule(seed, 3, scale_ups=2, scale_downs=1,
+                              rollout_at=14)
+    _elastic_chaos_drive(
+        make_model, tiny_params, prompts, oracle, schedule, n=10,
+        max_new=6,
+    )

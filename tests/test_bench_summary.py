@@ -134,6 +134,39 @@ fit3 = bench._fit_summary(fat3)
 assert len(json.dumps(fit3)) <= bench.SUMMARY_MAX_BYTES
 assert "tenant_top_share" not in fit3
 assert fit3["metric"] == "m" and fit3["value"] == 1.0
+
+# Elastic pointers (ISSUE 17): replica-seconds saved + rollout zero-loss
+# verdict — present only when the serving headline carries the elastic
+# arm, and both ride the _fit_summary droppable list.
+srv5 = {"tokens_per_sec": 9.9, "speedup_vs_static": 1.6,
+        "elastic_replica_seconds_saved_pct": 41.3,
+        "elastic_p95_held": True, "elastic_flaps": 0,
+        "rollout_zero_loss": True,
+        "artifact": "result/serving_tpu.json", **blob}
+ok5 = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv5, None,
+)
+assert len(json.dumps(ok5)) <= bench.SUMMARY_MAX_BYTES
+assert ok5["elastic_replica_seconds_saved_pct"] == 41.3, ok5
+assert ok5["rollout_zero_loss"] is True, ok5
+no_arm = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv, None,
+)  # absent arm -> absent pointers
+assert "elastic_replica_seconds_saved_pct" not in no_arm
+assert "rollout_zero_loss" not in no_arm
+fat4 = {
+    "bench_summary": True, "metric": "m", "value": 1.0,
+    "elastic_replica_seconds_saved_pct": 41.3,
+    "rollout_zero_loss": True,
+    "perf_sentinel": {"verdict": "green", "note": "y" * 1500},
+}
+fit4 = bench._fit_summary(fat4)
+assert len(json.dumps(fit4)) <= bench.SUMMARY_MAX_BYTES
+assert "elastic_replica_seconds_saved_pct" not in fit4
+assert "rollout_zero_loss" not in fit4
+assert fit4["metric"] == "m" and fit4["value"] == 1.0
 print("SUMMARY-OK", len(line), len(line2))
 """
 
