@@ -36,6 +36,103 @@ from chainermn_tpu.resilience.policy import RetryPolicy
 from chainermn_tpu.training import Extension
 
 
+def capture_loop_state(trainer) -> dict:
+    """Snapshot the loop-resume state (trainer iteration/epoch, iterator
+    cursor + RNG) as a flat dict of numpy leaves.  Module-level because two
+    planes snapshot it: the orbax checkpointer (durable tier) and the
+    peer-replication plane (``resilience/replicate.py``, fast tier) — both
+    must carry identical loop state for a restore to be bit-exact."""
+    out = {
+        "iteration": np.zeros((), np.int64),
+        "epoch": np.zeros((), np.int64),
+        "it_pos": np.zeros((), np.int64),
+    }
+    if trainer is None:
+        return out
+    it = trainer.train_iter
+    out["iteration"] = np.asarray(trainer.iteration, np.int64)
+    out["epoch"] = np.asarray(getattr(it, "epoch", 0), np.int64)
+    # Iterators with lookahead (PrefetchIterator's native ring) expose an
+    # explicit consumption-granular cursor — their raw attributes must
+    # not be snapshotted (the submission cursor runs depth batches ahead).
+    st = (
+        it.checkpoint_loop_state()
+        if hasattr(it, "checkpoint_loop_state")
+        else None
+    )
+    if st is not None:
+        out["it_pos"] = np.asarray(st["pos"], np.int64)
+        out["it_order"] = np.asarray(st["order"], np.int64)
+        out["rng_keys"] = np.asarray(st["rng_keys"], np.uint32)
+        out["rng_pos"] = np.asarray(st["rng_pos"], np.int64)
+        out["rng_has_gauss"] = np.asarray(st["rng_has_gauss"], np.int64)
+        out["rng_cached"] = np.asarray(st["rng_cached"], np.float64)
+        # Degraded-cursor flag (see DevicePrefetchIterator): > 0 means
+        # the snapshot may replay/skip up to this many samples on
+        # restore.  ALWAYS present so the orbax tree structure is
+        # deterministic (StandardRestore templates must match).
+        out["it_inexact"] = np.asarray(st.get("inexact", 0), np.int64)
+        return out
+    out["it_pos"] = np.asarray(getattr(it, "_pos", 0), np.int64)
+    # Exact mid-epoch resume needs the iterator's in-flight permutation
+    # and RNG state (restoring _pos into a FRESH permutation would skip
+    # and duplicate samples).  SerialIterator-shaped iterators only.
+    if hasattr(it, "_order") and hasattr(it, "_rng"):
+        mt, keys, pos, has_gauss, cached = it._rng.get_state()
+        out["it_order"] = np.asarray(it._order, np.int64)
+        out["rng_keys"] = np.asarray(keys, np.uint32)
+        out["rng_pos"] = np.asarray(pos, np.int64)
+        out["rng_has_gauss"] = np.asarray(has_gauss, np.int64)
+        out["rng_cached"] = np.asarray(cached, np.float64)
+    return out
+
+
+def apply_loop_state(trainer, new_state, loop) -> None:
+    """Push restored trainer/iterator/extension state — shared by the
+    checkpointer's template and elastic restore paths and by the
+    peer-replication fast restore (``resilience/replicate.py``)."""
+    if trainer is None:
+        return
+    trainer.state = new_state
+    trainer.iteration = int(loop["iteration"])
+    it = trainer.train_iter
+    if hasattr(it, "restore_loop_state") and "it_order" in loop:
+        it.restore_loop_state(
+            int(loop["epoch"]),
+            {
+                "pos": int(loop["it_pos"]),
+                "order": loop["it_order"],
+                "rng_keys": loop["rng_keys"],
+                "rng_pos": int(loop["rng_pos"]),
+                "rng_has_gauss": int(loop["rng_has_gauss"]),
+                "rng_cached": float(loop["rng_cached"]),
+            },
+        )
+    else:
+        if hasattr(it, "epoch"):
+            it.epoch = int(loop["epoch"])
+        if hasattr(it, "_pos"):
+            it._pos = int(loop["it_pos"])
+        if "it_order" in loop and hasattr(it, "_order"):
+            it._order = np.asarray(loop["it_order"]).astype(np.int64)
+            it._rng.set_state((
+                "MT19937",
+                np.asarray(loop["rng_keys"]).astype(np.uint32),
+                int(loop["rng_pos"]),
+                int(loop["rng_has_gauss"]),
+                float(loop["rng_cached"]),
+            ))
+    # Sync trigger state so interval extensions don't all re-fire on
+    # the first post-resume iteration (which would burn a retention
+    # slot on a duplicate checkpoint and log a one-iteration window).
+    for ext in trainer.extensions:
+        ext._last_fired = (
+            int(loop["epoch"])
+            if ext.unit == "epoch"
+            else int(loop["iteration"])
+        )
+
+
 class MultiNodeCheckpointer(Extension):
     """Trainer extension that snapshots (TrainState, iterator state, trainer
     iteration) every trigger, keeps ``max_to_keep`` checkpoints, and restores
@@ -159,49 +256,7 @@ class MultiNodeCheckpointer(Extension):
 
     @staticmethod
     def _loop_state(trainer) -> dict:
-        out = {
-            "iteration": np.zeros((), np.int64),
-            "epoch": np.zeros((), np.int64),
-            "it_pos": np.zeros((), np.int64),
-        }
-        if trainer is None:
-            return out
-        it = trainer.train_iter
-        out["iteration"] = np.asarray(trainer.iteration, np.int64)
-        out["epoch"] = np.asarray(getattr(it, "epoch", 0), np.int64)
-        # Iterators with lookahead (PrefetchIterator's native ring) expose an
-        # explicit consumption-granular cursor — their raw attributes must
-        # not be snapshotted (the submission cursor runs depth batches ahead).
-        st = (
-            it.checkpoint_loop_state()
-            if hasattr(it, "checkpoint_loop_state")
-            else None
-        )
-        if st is not None:
-            out["it_pos"] = np.asarray(st["pos"], np.int64)
-            out["it_order"] = np.asarray(st["order"], np.int64)
-            out["rng_keys"] = np.asarray(st["rng_keys"], np.uint32)
-            out["rng_pos"] = np.asarray(st["rng_pos"], np.int64)
-            out["rng_has_gauss"] = np.asarray(st["rng_has_gauss"], np.int64)
-            out["rng_cached"] = np.asarray(st["rng_cached"], np.float64)
-            # Degraded-cursor flag (see DevicePrefetchIterator): > 0 means
-            # the snapshot may replay/skip up to this many samples on
-            # restore.  ALWAYS present so the orbax tree structure is
-            # deterministic (StandardRestore templates must match).
-            out["it_inexact"] = np.asarray(st.get("inexact", 0), np.int64)
-            return out
-        out["it_pos"] = np.asarray(getattr(it, "_pos", 0), np.int64)
-        # Exact mid-epoch resume needs the iterator's in-flight permutation
-        # and RNG state (restoring _pos into a FRESH permutation would skip
-        # and duplicate samples).  SerialIterator-shaped iterators only.
-        if hasattr(it, "_order") and hasattr(it, "_rng"):
-            mt, keys, pos, has_gauss, cached = it._rng.get_state()
-            out["it_order"] = np.asarray(it._order, np.int64)
-            out["rng_keys"] = np.asarray(keys, np.uint32)
-            out["rng_pos"] = np.asarray(pos, np.int64)
-            out["rng_has_gauss"] = np.asarray(has_gauss, np.int64)
-            out["rng_cached"] = np.asarray(cached, np.float64)
-        return out
+        return capture_loop_state(trainer)
 
     # -------------------------------------------------------------- restore
     def _restore(self, step, template):
@@ -390,48 +445,7 @@ class MultiNodeCheckpointer(Extension):
         return new_state, int(loop["iteration"])
 
     def _apply_loop(self, trainer, new_state, loop) -> None:
-        """Push restored trainer/iterator/extension state (shared by the
-        template and elastic restore paths)."""
-        if trainer is None:
-            return
-        trainer.state = new_state
-        trainer.iteration = int(loop["iteration"])
-        it = trainer.train_iter
-        if hasattr(it, "restore_loop_state") and "it_order" in loop:
-            it.restore_loop_state(
-                int(loop["epoch"]),
-                {
-                    "pos": int(loop["it_pos"]),
-                    "order": loop["it_order"],
-                    "rng_keys": loop["rng_keys"],
-                    "rng_pos": int(loop["rng_pos"]),
-                    "rng_has_gauss": int(loop["rng_has_gauss"]),
-                    "rng_cached": float(loop["rng_cached"]),
-                },
-            )
-        else:
-            if hasattr(it, "epoch"):
-                it.epoch = int(loop["epoch"])
-            if hasattr(it, "_pos"):
-                it._pos = int(loop["it_pos"])
-            if "it_order" in loop and hasattr(it, "_order"):
-                it._order = np.asarray(loop["it_order"]).astype(np.int64)
-                it._rng.set_state((
-                    "MT19937",
-                    np.asarray(loop["rng_keys"]).astype(np.uint32),
-                    int(loop["rng_pos"]),
-                    int(loop["rng_has_gauss"]),
-                    float(loop["rng_cached"]),
-                ))
-        # Sync trigger state so interval extensions don't all re-fire on
-        # the first post-resume iteration (which would burn a retention
-        # slot on a duplicate checkpoint and log a one-iteration window).
-        for ext in trainer.extensions:
-            ext._last_fired = (
-                int(loop["epoch"])
-                if ext.unit == "epoch"
-                else int(loop["iteration"])
-            )
+        apply_loop_state(trainer, new_state, loop)
 
     # ------------------------------------------------- known-good ring
     # (training-health guard rollback recovery — see resilience/guard.py)

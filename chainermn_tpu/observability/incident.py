@@ -290,6 +290,32 @@ def default_rules() -> List[Watch]:
                         "rollout is wedged "
                         "(key_by_value: each stalled step files)",
         ),
+        Watch(
+            "replication_fallback", "train.rep.fallback", "> 0",
+            severity="critical", key_by_value=True,
+            description="a supervised relaunch could not assemble a "
+                        "peer-restore quorum (missing/mismatched shards "
+                        "or a world-size change) and fell back to the "
+                        "orbax restore — recovery paid full checkpoint "
+                        "I/O and lost work since the last durable save "
+                        "(key_by_value: each fallback files)",
+        ),
+        Watch(
+            "replication_lost_steps", "train.rep.lost_steps_excess",
+            "> 0", severity="critical",
+            description="a fast restore lost more work than one "
+                        "replication cadence — the ≤-cadence loss bound "
+                        "the replication plane exists to guarantee was "
+                        "violated",
+        ),
+        Watch(
+            "replication_torn", "train.rep.torn", "> 0",
+            severity="warning",
+            description="a replication frame or spill file failed "
+                        "schema/crc/digest validation and was discarded "
+                        "— a torn replica never installs, but repeated "
+                        "tears mean the replication plane is degraded",
+        ),
     ]
 
 

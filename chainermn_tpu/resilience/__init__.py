@@ -59,6 +59,17 @@ from chainermn_tpu.resilience.guard import (
     HealthEscalationInterrupt,
     TrainingHealthGuard,
 )
+from chainermn_tpu.resilience.replicate import (
+    REPLICATE_SCHEMA,
+    ReplicationError,
+    ShardReplicator,
+    TrainingChaosHarness,
+    chaos_schedule,
+    negotiate_restore,
+    pick_quorum,
+    shard_digest,
+    should_negotiate,
+)
 from chainermn_tpu.resilience import (
     consistency,
     detector,
@@ -66,6 +77,7 @@ from chainermn_tpu.resilience import (
     guard,
     policy,
     preemption,
+    replicate,
 )
 
 __all__ = [
@@ -92,10 +104,20 @@ __all__ = [
     "VoteResult",
     "majority_vote",
     "tree_digest",
+    "REPLICATE_SCHEMA",
+    "ReplicationError",
+    "ShardReplicator",
+    "TrainingChaosHarness",
+    "chaos_schedule",
+    "negotiate_restore",
+    "pick_quorum",
+    "shard_digest",
+    "should_negotiate",
     "consistency",
     "detector",
     "faults",
     "guard",
     "policy",
     "preemption",
+    "replicate",
 ]

@@ -94,6 +94,7 @@ class PreemptionGuard:
         self._prev_handlers = {}
         self._installed = False
         self._drain = None
+        self._replicator = None
 
     # ------------------------------------------------------------- handlers
     def install(self) -> "PreemptionGuard":
@@ -157,6 +158,22 @@ class PreemptionGuard:
         if not self._vote():
             return
         it = int(trainer.iteration)
+        # Replication flush FIRST: it is cheap (host pickle + local write,
+        # no collectives, no shared storage), so even a SIGKILL landing
+        # mid way through the orbax emergency_save below still leaves a
+        # restorable local shard at THIS iteration for the fast-restore
+        # quorum.  Ordering the slow shared-storage save first would
+        # forfeit exactly the grace-window seconds replication exists for.
+        rep = self._replicator or self._find_replicator(trainer)
+        if rep is not None:
+            try:
+                rep.flush_local(trainer)
+            except Exception as e:  # the orbax save below must still run
+                sys.stderr.write(
+                    "[chainermn_tpu.resilience] preemption: replication "
+                    f"flush failed ({type(e).__name__}: {e}); continuing "
+                    "to emergency checkpoint\n"
+                )
         ckpt = self.checkpointer or self._find_checkpointer(trainer)
         if ckpt is not None:
             ckpt.emergency_save(trainer)
@@ -231,11 +248,27 @@ class PreemptionGuard:
                 )
         self._exit_preempted(tick, action)
 
+    def attach_replicator(self, replicator) -> None:
+        """Pin the :class:`~chainermn_tpu.resilience.replicate
+        .ShardReplicator` whose snapshot :meth:`poll` flushes locally
+        before the orbax emergency save; if never called, the trainer's
+        extensions are searched at preemption time."""
+        self._replicator = replicator
+
     @staticmethod
     def _find_checkpointer(trainer):
         from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
 
         for ext in getattr(trainer, "extensions", []):
             if isinstance(ext, MultiNodeCheckpointer):
+                return ext
+        return None
+
+    @staticmethod
+    def _find_replicator(trainer):
+        from chainermn_tpu.resilience.replicate import ShardReplicator
+
+        for ext in getattr(trainer, "extensions", []):
+            if isinstance(ext, ShardReplicator):
                 return ext
         return None

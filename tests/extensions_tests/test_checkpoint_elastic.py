@@ -246,3 +246,103 @@ def test_zero_elastic_across_transform_families(devices, tmp_path, tx_name):
         _oracle_params(params, loss_fn, tx, batches),
         atol=5e-5, rtol=5e-5,
     )
+
+
+@pytest.mark.parametrize("split", [(4, 3), (3, 4)])
+def test_zero_elastic_single_device_delta(devices, tmp_path, split):
+    """ISSUE 18 coverage: the by-one shrink (N→N-1) and grow (N→N+1)
+    restores — the shapes a single lost or recovered host produces, and
+    padding deltas the power-of-two splits above never exercise."""
+    n_save, n_resume = split
+    model = MLP(hidden=(18,), n_out=5)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 7), np.float32)
+    )["params"]
+    loss_fn = classification_loss(model)
+    tx = optax.adam(1e-2)
+    rng = np.random.RandomState(5)
+    batches = [
+        (
+            rng.normal(size=(60, 7)).astype(np.float32),
+            rng.randint(0, 5, size=(60,)).astype(np.int32),
+        )
+        for _ in range(4)
+    ]
+
+    comm_a = cmn.create_communicator("xla", devices=devices[:n_save])
+    opt_a = cmn.create_zero_optimizer(tx, comm_a)
+    state = opt_a.init(params)
+    for b in batches[:2]:
+        state, _ = opt_a.update(state, b, loss_fn, has_aux=True)
+    ckpt = create_multi_node_checkpointer(
+        f"delta_{n_save}_{n_resume}", comm_a, path=str(tmp_path),
+        async_save=False,
+    )
+    ckpt.save(state)
+    ckpt.finalize()
+
+    comm_b = cmn.create_communicator("xla", devices=devices[:n_resume])
+    opt_b = cmn.create_zero_optimizer(tx, comm_b)
+    ckpt_b = create_multi_node_checkpointer(
+        f"delta_{n_save}_{n_resume}", comm_b, path=str(tmp_path),
+        async_save=False,
+    )
+    state_b, it = ckpt_b.maybe_load_elastic(opt_b, params)
+    assert int(state_b.step) == 2
+    _assert_tree_close(
+        opt_b.materialize_params(state_b), opt_a.materialize_params(state)
+    )
+    for b in batches[2:]:
+        state_b, _ = opt_b.update(state_b, b, loss_fn, has_aux=True)
+
+    _assert_tree_close(
+        opt_b.materialize_params(state_b),
+        _oracle_params(params, loss_fn, tx, batches),
+        atol=5e-5, rtol=5e-5,
+    )
+
+
+def test_quorum_declines_world_size_change_elastic_serves(tmp_path):
+    """The documented replication/elastic interaction (ISSUE 18): peer
+    replicas recorded under the old world size never enter the restore
+    offer — ``negotiate_restore`` declines with ``world-size-changed``
+    and the orbax-elastic callable (``maybe_load_elastic`` in real
+    wiring) serves the resize."""
+    from chainermn_tpu.resilience.replicate import (
+        ShardReplicator,
+        negotiate_restore,
+    )
+
+    class _Tr:
+        def __init__(self):
+            self.state = {"w": np.zeros(4, np.float32)}
+            self.iteration = 0
+            self.train_iter = None
+            self.extensions = []
+
+    # A previous 2-rank life left a rank-0 snapshot on this host...
+    old = ShardReplicator(None, every=2, spill_dir=str(tmp_path),
+                          _use_process_injector=False)
+    old.size = 2  # stamp the snapshot with the old world size
+    tr = _Tr()
+    tr.iteration = 6
+    old._persist(old._snapshot(tr), 0)
+
+    # ...and the relaunch came back single-process (shrunk fleet).
+    rep = ShardReplicator(None, every=2, spill_dir=str(tmp_path),
+                          _use_process_injector=False)
+    inv = rep.inventory()
+    assert inv["own"] == {} and inv["stale_world"] is True
+    served = []
+
+    def elastic():
+        served.append(True)
+        return {"w": np.ones(4, np.float32)}, 6
+
+    new_state, it, report = negotiate_restore(
+        rep, tr.state, trainer=None, elastic=elastic
+    )
+    assert served == [True]
+    assert it == 6
+    assert report["source"] == "orbax"
+    assert report["reason"] == "world-size-changed"

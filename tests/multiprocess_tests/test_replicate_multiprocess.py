@@ -1,0 +1,81 @@
+"""Peer-replication fast restore, end to end across real OS processes.
+
+The ISSUE-18 acceptance path: rank 1 crashes mid-run (``crash@iter:8``),
+``launch.supervise`` relaunches, and — with rank 1's spill dir wiped to
+simulate the host's disk dying with it — the relaunch restores from the
+replica rank 0 held, with NO orbax checkpointer anywhere in the job.  The
+final params must be bit-identical to an unfaulted oracle job's (same
+seeds, same batch stream), the resume step must be the last replication
+cadence before the crash (work lost ≤ one cadence), and the worker's
+stderr must attribute the restore (``restore_source=peer``).
+"""
+
+import json
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(_HERE, "worker_replicate.py")
+
+#: Cadence 3, crash at iteration 8 → newest fleet-complete snapshot is 6;
+#: the relaunch must lose exactly 2 iterations (≤ one cadence).
+REP_ENV = {"CMN_REP_EVERY": "3", "CMN_REP_FACTOR": "1"}
+
+
+def _verdicts(tmp_path, tag, nproc=2):
+    out = []
+    for pid in range(nproc):
+        p = tmp_path / f"verdict_{tag}_{pid}.json"
+        assert p.exists(), f"missing verdict for rank {pid} ({tag})"
+        with open(p) as f:
+            out.append(json.load(f))
+    return out
+
+
+def test_crash_fast_restores_from_peer_replica(launch_job, tmp_path):
+    # ---- oracle: same job, no faults, fresh spill ----------------------
+    job = launch_job(
+        WORKER, nproc=2, timeout=240,
+        extra_env={**REP_ENV, "CMN_TEST_TAG": "oracle",
+                   "CMN_REP_DIR": str(tmp_path / "rep_oracle")},
+    )
+    assert job.returncode == 0, job.tail()
+    oracle = _verdicts(tmp_path, "oracle")
+    assert {v["status"] for v in oracle} == {"ok"}
+    oracle_digests = {v["digest"] for v in oracle}
+    assert len(oracle_digests) == 1  # DP replicas agree
+    oracle_digest = oracle_digests.pop()
+
+    # ---- chaos: crash rank 1 at iter 8, supervised relaunch, wiped disk
+    job = launch_job(
+        WORKER, nproc=2, timeout=300,
+        extra_args=("--restarts", "1"),
+        extra_env={
+            **REP_ENV,
+            "CMN_TEST_TAG": "chaos",
+            "CMN_REP_DIR": str(tmp_path / "rep_chaos"),
+            "CMN_FAULT": "crash@iter:8",
+            "CMN_FAULT_RANK": "1",
+            "CMN_TEST_WIPE_RANK": "1",
+        },
+    )
+    log = job.log
+    assert job.returncode == 0, job.tail()
+    assert "injected fault" in log, job.tail()       # the crash happened
+    assert "attempt 1:" in log, job.tail()           # supervise relaunched
+    assert "restore_source=peer" in log, job.tail()  # stderr attribution
+
+    verdicts = _verdicts(tmp_path, "chaos")
+    assert {v["status"] for v in verdicts} == {"ok"}
+    by_pid = {v["process_id"]: v for v in verdicts}
+    # The wiped rank restored from its peer's replica; the survivor
+    # restored from its own local spill.
+    assert by_pid[1]["restore_source"] == "peer", by_pid
+    assert by_pid[0]["restore_source"] == "local", by_pid
+    # Resume landed on the newest fleet-complete cadence (6), so the
+    # crash at 8 lost 2 iterations — within one replication cadence.
+    for v in verdicts:
+        assert v["resumed_from"] == 6, verdicts
+        assert v["lost_steps"] is not None and v["lost_steps"] <= 3
+        assert v["final_iteration"] == 12
+        # Bit-exact resume: the replayed iterations reproduce the oracle.
+        assert v["digest"] == oracle_digest, (v["digest"], oracle_digest)

@@ -1,0 +1,115 @@
+"""Worker for the peer-replication fast-restore acceptance test.
+
+Trains a small DP MLP across OS processes with a :class:`ShardReplicator`
+at cadence ``CMN_REP_EVERY`` and NO orbax checkpointer — the replication
+plane is the only restore tier, so a successful resume PROVES the peer
+path.  The crash is the resilience layer's own (``CMN_FAULT=crash@iter:N``
+scoped to rank 1, first attempt only); ``launch.supervise`` relaunches,
+and on ``CMN_LAUNCH_ATTEMPT > 0`` this worker first simulates rank 1's
+disk dying (``CMN_TEST_WIPE_RANK`` wipes its spill dir — the replica held
+by rank 0 is all that survives), then runs ``negotiate_restore`` and
+finishes.  The verdict carries the restore source/step and a final param
+digest for the bit-exactness check against the unfaulted oracle job.
+"""
+
+import json
+import os
+import shutil
+import sys
+import traceback
+
+import numpy as np
+
+
+def main() -> dict:
+    import jax
+
+    import chainermn_tpu as cmn
+
+    cmn.init_distributed(cpu_collectives="gloo")
+    pid = jax.process_index()
+    out = {"process_id": pid}
+
+    import optax
+
+    from chainermn_tpu.datasets import make_synthetic_classification
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.models import MLP, classification_loss
+    from chainermn_tpu.resilience.consistency import tree_digest
+    from chainermn_tpu.resilience.replicate import (
+        ShardReplicator,
+        negotiate_restore,
+        should_negotiate,
+    )
+    from chainermn_tpu.training import Trainer
+
+    comm = cmn.create_communicator("flat")
+    ds = cmn.scatter_dataset(
+        make_synthetic_classification(256, 8, 4, seed=9), comm, shuffle=True,
+        seed=4,
+    )
+    model = MLP(hidden=(8,), n_out=4)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.float32))[
+        "params"
+    ]
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    it = SerialIterator(ds, 64, shuffle=True, seed=2)
+    stop = int(os.environ.get("CMN_TEST_STOP", "12"))
+    trainer = Trainer(
+        opt, opt.init(params), classification_loss(model), it,
+        stop=(stop, "iteration"), has_aux=True,
+    )
+    rep = ShardReplicator(comm)  # cadence/spill from CMN_REP_* env
+    trainer.extend(rep)
+
+    attempt = int(os.environ.get("CMN_LAUNCH_ATTEMPT", "0"))
+    if should_negotiate():
+        wipe = os.environ.get("CMN_TEST_WIPE_RANK")
+        if wipe is not None and int(wipe) == pid and attempt == 1:
+            # This rank "lost its disk" with the host: only the replica a
+            # peer holds can bring its shard back.
+            shutil.rmtree(rep.spill_dir, ignore_errors=True)
+            os.makedirs(rep.spill_dir, exist_ok=True)
+        new_state, resumed, report = negotiate_restore(
+            rep, trainer.state, trainer=trainer
+        )
+        out["resumed_from"] = int(resumed)
+        out["restore_source"] = report["source"]
+        out["restore_reason"] = report["reason"]
+        out["recovery_ms"] = report["recovery_ms"]
+        out["lost_steps"] = report["lost_steps"]
+    else:
+        out["resumed_from"] = 0
+        out["restore_source"] = None
+
+    trainer.run()
+
+    out["final_iteration"] = trainer.iteration
+    out["digest"] = tree_digest(trainer.state.params)
+    comm.barrier()
+    cmn.shutdown_distributed()
+    out["status"] = "ok"
+    return out
+
+
+if __name__ == "__main__":
+    tag = os.environ.get("CMN_TEST_TAG", "rep")
+    result_path = os.path.join(
+        os.environ["CMN_TEST_TMP"],
+        f"verdict_{tag}_{os.environ['CMN_PROCESS_ID']}.json",
+    )
+    if os.environ.get("CMN_FAULT") and os.environ.get(
+        "CMN_LAUNCH_ATTEMPT", "0"
+    ) == os.environ.get("CMN_FAULT_ATTEMPT", "0"):
+        # Fault phase: NO safety net — the injected crash (and the peer's
+        # collective failure against the dead rank) must reach
+        # sys.excepthook so the whole-job teardown is what's under test.
+        verdict = main()
+    else:
+        try:
+            verdict = main()
+        except BaseException:
+            verdict = {"status": "fail", "traceback": traceback.format_exc()}
+    with open(result_path, "w") as f:
+        json.dump(verdict, f)
+    sys.exit(0 if verdict.get("status") == "ok" else 1)

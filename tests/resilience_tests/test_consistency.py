@@ -17,6 +17,8 @@ from chainermn_tpu.resilience.consistency import (
     exchange_digests,
 )
 
+pytestmark = pytest.mark.tier1
+
 
 # ------------------------------------------------------------------ digests
 def test_digest_deterministic_and_content_sensitive():

@@ -17,6 +17,8 @@ from chainermn_tpu.resilience import (
     PeerFailedError,
 )
 
+pytestmark = pytest.mark.tier1
+
 
 # ----------------------------------------------------------- DetectorCore
 def test_core_transitions_alive_suspect_dead():

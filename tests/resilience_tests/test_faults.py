@@ -10,6 +10,8 @@ from chainermn_tpu.resilience import (
 )
 from chainermn_tpu.resilience import faults as faults_mod
 
+pytestmark = pytest.mark.tier1
+
 
 # ------------------------------------------------------------------ parsing
 def test_parse_all_kinds():

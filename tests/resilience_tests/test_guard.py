@@ -30,6 +30,8 @@ from chainermn_tpu.resilience import (
 from chainermn_tpu.resilience import faults as faults_mod
 from chainermn_tpu.training import Extension, Trainer
 
+pytestmark = pytest.mark.tier1
+
 
 @pytest.fixture
 def inject(monkeypatch):

@@ -8,6 +8,8 @@ import pytest
 
 from chainermn_tpu.resilience import RetryExhaustedError, RetryPolicy
 
+pytestmark = pytest.mark.tier1
+
 
 def test_schedule_is_deterministic_and_capped():
     p = RetryPolicy(max_attempts=6, base_delay_s=0.5, multiplier=2.0,

@@ -13,6 +13,8 @@ from chainermn_tpu.resilience import (
     PreemptionInterrupt,
 )
 
+pytestmark = pytest.mark.tier1
+
 
 class FakeTrainer:
     def __init__(self, iteration=7):
@@ -149,3 +151,79 @@ def test_repeat_signal_is_idempotent():
 def test_check_every_validation():
     with pytest.raises(ValueError):
         PreemptionGuard(check_every=0)
+
+
+# --------------------------------------------------- replication ordering
+def test_replication_flush_lands_before_emergency_save(tmp_path):
+    """ISSUE 18 ordering fix: the replication flush (cheap, local) runs
+    BEFORE the orbax emergency save (slow, shared storage), so a kill
+    landing mid-save still leaves a restorable local shard — regression
+    via an event log, with the preemption fired BETWEEN replication
+    cadences (iteration 5, cadence 4)."""
+    from chainermn_tpu.resilience.replicate import ShardReplicator
+
+    events = []
+
+    class OrderCkpt(FakeCheckpointer):
+        def emergency_save(self, trainer):
+            events.append(("orbax", int(trainer.iteration)))
+            return super().emergency_save(trainer)
+
+    class OrderRep(ShardReplicator):
+        def flush_local(self, trainer):
+            events.append(("rep", int(trainer.iteration)))
+            return super().flush_local(trainer)
+
+    rep = OrderRep(None, every=4, spill_dir=str(tmp_path),
+                   _use_process_injector=False)
+    tr = FakeTrainer(iteration=5)
+    tr.state = {"w": __import__("numpy").zeros(3, "float32")}
+    tr.train_iter = None
+    guard = PreemptionGuard(checkpointer=OrderCkpt())
+    guard.attach_replicator(rep)
+    guard.request()
+    with pytest.raises(PreemptionInterrupt):
+        guard.poll(tr)
+    assert events == [("rep", 5), ("orbax", 5)]  # flush strictly first
+    # The between-cadence iteration 5 (NOT a multiple of 4) is now a
+    # restorable local shard — the fast-restore quorum can serve it.
+    assert sorted(rep.inventory()["own"]) == [5]
+
+
+def test_replication_flush_failure_does_not_block_emergency_save(tmp_path):
+    """A broken replicator must never cost the durable-tier save."""
+    from chainermn_tpu.resilience.replicate import ShardReplicator
+
+    class BrokenRep(ShardReplicator):
+        def flush_local(self, trainer):
+            raise RuntimeError("spill disk gone")
+
+    rep = BrokenRep(None, every=4, spill_dir=str(tmp_path),
+                    _use_process_injector=False)
+    ckpt = FakeCheckpointer()
+    guard = PreemptionGuard(checkpointer=ckpt)
+    guard.attach_replicator(rep)
+    guard.request()
+    with pytest.raises(PreemptionInterrupt):
+        guard.poll(FakeTrainer(iteration=6))
+    assert ckpt.saved_at == [6]  # orbax save still landed
+
+
+def test_poll_finds_replicator_in_trainer_extensions(tmp_path):
+    from chainermn_tpu.resilience.replicate import ShardReplicator
+
+    flushed = []
+
+    class TrackingRep(ShardReplicator):
+        def flush_local(self, trainer):
+            flushed.append(int(trainer.iteration))
+            return int(trainer.iteration)
+
+    tr = FakeTrainer(iteration=9)
+    tr.extensions.append(TrackingRep(None, every=2, spill_dir=str(tmp_path),
+                                     _use_process_injector=False))
+    guard = PreemptionGuard(checkpointer=FakeCheckpointer())
+    guard.request()
+    with pytest.raises(PreemptionInterrupt):
+        guard.poll(tr)
+    assert flushed == [9]

@@ -167,6 +167,36 @@ assert len(json.dumps(fit4)) <= bench.SUMMARY_MAX_BYTES
 assert "elastic_replica_seconds_saved_pct" not in fit4
 assert "rollout_zero_loss" not in fit4
 assert fit4["metric"] == "m" and fit4["value"] == 1.0
+
+# Resilience pointers (ISSUE 18): the training-chaos goodput ratio +
+# per-arm recovery_ms p50s — present only when a resilience headline is
+# passed, and both ride the _fit_summary droppable list.
+res = {"metric": "train_chaos_goodput", "goodput_ratio": 1.3,
+       "recovery_ms_peer_p50": 63.5, "recovery_ms_orbax_p50": 94.9,
+       "rep_overhead_pct": 0.4, "bit_exact_vs_oracle": True,
+       "invariant_holds": True, **blob}
+ok6 = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, None, None, res,
+)
+assert len(json.dumps(ok6)) <= bench.SUMMARY_MAX_BYTES
+assert ok6["chaos_goodput"] == 1.3, ok6
+assert ok6["recovery_ms"] == {"peer_p50": 63.5, "orbax_p50": 94.9}, ok6
+no_res = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, None, None,
+)  # absent capture -> absent pointers
+assert "chaos_goodput" not in no_res and "recovery_ms" not in no_res
+fat5 = {
+    "bench_summary": True, "metric": "m", "value": 1.0,
+    "chaos_goodput": 1.3,
+    "recovery_ms": {"peer_p50": 63.5, "orbax_p50": 94.9},
+    "perf_sentinel": {"verdict": "green", "note": "y" * 1500},
+}
+fit5 = bench._fit_summary(fat5)
+assert len(json.dumps(fit5)) <= bench.SUMMARY_MAX_BYTES
+assert "chaos_goodput" not in fit5 and "recovery_ms" not in fit5
+assert fit5["metric"] == "m" and fit5["value"] == 1.0
 print("SUMMARY-OK", len(line), len(line2))
 """
 

@@ -59,6 +59,7 @@ _TIERED_DIRS = (
     os.path.join("tests", "ops_tests"),
     os.path.join("tests", "observability_tests"),
     os.path.join("tests", "serving_tests"),
+    os.path.join("tests", "resilience_tests"),
 )
 def test_long_pole_dirs_declare_test_tiers():
     undeclared = []
