@@ -295,6 +295,16 @@ def _serving_headline() -> dict | None:
                 "tenants", {}
             ).get("conservation_holds"),
             "tenant_count": rec.get("tenants", {}).get("tenants"),
+            # SLO-policy arm (ISSUE 19), when the artifact carries it:
+            # the latency-sensitive tenant's p95-held verdict under the
+            # adversarial burst and the policy arm's aggregate
+            # throughput as a percent of FIFO's (contract: >= 95).
+            "slo_tenant_p95_held": rec.get(
+                "multitenant", {}
+            ).get("slo_tenant_p95_held"),
+            "fairness_throughput_pct": rec.get(
+                "multitenant", {}
+            ).get("fairness_throughput_pct"),
         }
 
     return _best_result("serving*.json", cands)
@@ -487,6 +497,16 @@ def _summary_line(payload: dict, lm=None, dec=None, srv=None,
         ]
     if srv is not None and srv.get("rollout_zero_loss") is not None:
         summary["rollout_zero_loss"] = srv["rollout_zero_loss"]
+    # Policy-arm pointers (ISSUE 19): the SLO tenant's p95-held verdict
+    # and the fairness-throughput percentage — present only when the
+    # serving artifact carries the multitenant SLO-policy arm.
+    if srv is not None and srv.get("slo_tenant_p95_held") is not None:
+        summary["slo_tenant_p95_held"] = srv["slo_tenant_p95_held"]
+    if srv is not None and \
+            srv.get("fairness_throughput_pct") is not None:
+        summary["fairness_throughput_pct"] = srv[
+            "fairness_throughput_pct"
+        ]
     # Training-chaos pointers (ISSUE 18): the peer-restore vs orbax-only
     # goodput ratio and the per-arm recovery_ms p50s, present only when a
     # resilience capture exists (full verdict — bit-exactness, invariant,
@@ -565,6 +585,7 @@ def _fit_summary(summary: dict) -> dict:
               "recovery_ms", "chaos_goodput",
               "tenant_top_share", "elastic_replica_seconds_saved_pct",
               "rollout_zero_loss",
+              "slo_tenant_p95_held", "fairness_throughput_pct",
               "router_tokens_per_sec", "cache_source_commit",
               "serving_artifact", "decode_artifact", "lm_artifact",
               "cache_age_hours", "incident_count", "perf_sentinel",

@@ -74,6 +74,16 @@ and a mid-traffic rolling-deploy sub-arm whose ``rollout_zero_loss``
 verdict pins zero lost / duplicated requests across a full fleet
 replacement.
 
+``--multitenant`` (ISSUE 19) runs the SLO-policy arm: a bursty
+adversarial tenant dumps a 2x-capacity burst at t=0 with a
+latency-sensitive tenant queued behind it, served twice over the same
+warmed engine — plain FIFO, then through a ``PolicyPlane`` giving the
+SLO tenant a 4:1 weighted-fair (VTC) share — reporting the SLO tenant's
+p95 both ways, ``slo_tenant_p95_held`` (policy p95 within 1.1x of
+FIFO's), and ``fairness_throughput_pct`` (policy aggregate tokens/s as
+a percent of FIFO's; contract: >= 95 — fairness reorders work, it must
+not destroy it).
+
     python benchmarks/serving.py --out result/serving_tpu.json  # real chip
     JAX_PLATFORMS=cpu python benchmarks/serving.py --smoke      # plumbing
 """
@@ -215,6 +225,14 @@ def main():
                          "per-tenant tokens/s and block-second shares, "
                          "the top-consumer share, and the conservation "
                          "verdict (0 = skip)")
+    ap.add_argument("--multitenant", action="store_true",
+                    help="also run the SLO-POLICY arm (ISSUE 19): a "
+                         "bursty adversarial tenant's 2x-capacity "
+                         "burst with a latency-sensitive tenant queued "
+                         "behind it, served FIFO then through the "
+                         "PolicyPlane (4:1 VTC weights) — reports "
+                         "slo_tenant_p95_held and "
+                         "fairness_throughput_pct (contract: >= 95)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--trace-out", default=None,
@@ -260,7 +278,7 @@ def main():
             d_ff=1024, vocab=4096, block_len=8, prefill_chunk=16,
             repeats=4, obs_pairs=12, prefix_reuse=4, spec_k=3,
             draft_layers=1, replicas=2, disagg=True, chaos=True,
-            tenants=3, elastic=True,
+            tenants=3, elastic=True, multitenant=True,
         )
         for k, v in smoke_over.items():
             if getattr(args, k) == ap.get_default(k):
@@ -1435,6 +1453,124 @@ def main():
         }
         del tn_router
 
+    # -------------------------------------------------- multitenant arm
+    # SLO-aware policy (ISSUE 19): a bursty adversarial tenant dumps a
+    # 2x-capacity burst at t=0 with a latency-sensitive tenant's
+    # requests queued BEHIND it (submission order — FIFO's worst case),
+    # served twice over the same warmed engine: plain FIFO, then
+    # through a PolicyPlane giving the SLO tenant a 4:1 VTC weight.
+    # Same priority class both ways — the comparison is about admission
+    # ORDER, not preemption recompute — so aggregate work is identical
+    # and the fairness contract (policy tokens/s >= 95% of FIFO's) has
+    # no systematic reason to fail; FIFO drains the whole burst before
+    # the SLO tenant sees a slot, while the policy hands every freed
+    # slot to the cheapest virtual clock, collapsing the SLO tenant's
+    # p95.  Reuses the warmed continuous engine: decode_compiles must
+    # stay pinned with the policy ON.
+    mt_payload = None
+    if args.multitenant:
+        from chainermn_tpu.observability.metrics import MetricsRegistry
+        from chainermn_tpu.serving import (
+            PolicyPlane,
+            Router,
+            TenantPolicy,
+        )
+
+        mt_adv = min(2 * args.batch, 16)
+        mt_slo = max(4, args.batch // 2)
+
+        def mt_reqs(base_id):
+            def pick(j, tenant, i):
+                return Request(
+                    id=base_id + j,
+                    prompt=prompts[i % len(prompts)].tolist(),
+                    max_new_tokens=min(
+                        int(new_counts[i % len(new_counts)]), 16
+                    ),
+                    arrival=0.0, tenant=tenant,
+                )
+            adv = [pick(i, "adv", i) for i in range(mt_adv)]
+            slo = [pick(500 + i, "slo", mt_adv + i)
+                   for i in range(mt_slo)]
+            return adv + slo  # burst first, SLO trickle queued behind
+
+        def mt_pass(base_id, policy):
+            eng.drop_prefix_cache()
+            mr = Router([eng], registry=MetricsRegistry(),
+                        policy=policy)
+            reqs = mt_reqs(base_id)
+            comps = mr.run(reqs)
+            assert all(c.status == "ok" for c in comps)
+            span = max(
+                max(c.finished_at for c in comps)
+                - min(c.arrival for c in comps), 1e-9,
+            )
+            tps = sum(len(c.tokens) for c in comps) / span
+            slo_lat = [c.finished_at - c.arrival for c in comps
+                       if c.id >= base_id + 500]
+            adv_lat = [c.finished_at - c.arrival for c in comps
+                       if c.id < base_id + 500]
+            return tps, _pct(slo_lat, 0.95), _pct(adv_lat, 0.95)
+
+        # Alternating best-of-2 passes per arm (the bench's min-of-N
+        # idiom): both arms run the SAME work in a different order, so
+        # any tokens/s gap is host noise — a single pass on a shared
+        # CPU can swing the fairness ratio by several percent either
+        # way and flip the >=95% verdict on nothing.
+        fifo_runs, pol_runs = [], []
+        mt_plane = None
+        for mp in range(2):
+            fifo_runs.append(mt_pass(90_000 + 2_000 * mp, None))
+            mt_plane = PolicyPlane(
+                tenants={"slo": TenantPolicy("slo", weight=4.0),
+                         "adv": TenantPolicy("adv", weight=1.0)},
+                registry=MetricsRegistry(),
+            )
+            pol_runs.append(mt_pass(91_000 + 2_000 * mp, mt_plane))
+        fifo_tps = max(r[0] for r in fifo_runs)
+        fifo_slo_p95 = min(r[1] for r in fifo_runs)
+        fifo_adv_p95 = min(r[2] for r in fifo_runs)
+        pol_tps = max(r[0] for r in pol_runs)
+        pol_slo_p95 = min(r[1] for r in pol_runs)
+        pol_adv_p95 = min(r[2] for r in pol_runs)
+        mt_payload = {
+            "adv_requests": mt_adv,
+            "slo_requests": mt_slo,
+            "weights": {"slo": 4.0, "adv": 1.0},
+            "fifo": {
+                "tokens_per_sec": round(fifo_tps, 1),
+                "slo_p95_latency_s": round(fifo_slo_p95, 4),
+                "adv_p95_latency_s": round(fifo_adv_p95, 4),
+            },
+            "policy": {
+                "tokens_per_sec": round(pol_tps, 1),
+                "slo_p95_latency_s": round(pol_slo_p95, 4),
+                "adv_p95_latency_s": round(pol_adv_p95, 4),
+                # VTC audit trail: admitted tenant order (first wave)
+                # and the final virtual clocks — the SLO tenant's must
+                # run ~1/4 the adversary's per unit charged.
+                "admission_order": [
+                    t for _, t, _ in mt_plane.admission_log[:8]
+                ],
+                "virtual_clock": {
+                    t: round(v, 2)
+                    for t, v in sorted(mt_plane.virtual.items())
+                },
+            },
+            "decode_compiles": eng.decode_compiles,
+            # Held = the policy's SLO-tenant p95 within 1.1x FIFO's
+            # (in practice far below it: the burst no longer queues
+            # ahead); the margin absorbs host jitter on the shared-CPU
+            # smoke path.
+            "slo_tenant_p95_held": bool(
+                pol_slo_p95 <= 1.1 * fifo_slo_p95
+            ),
+            "fairness_throughput_pct": round(
+                100.0 * pol_tps / max(fifo_tps, 1e-9), 2
+            ),
+            "contract": "slo p95 held at >= 95% of FIFO tokens/s",
+        }
+
     payload = {
         "metric": "serving_tokens_per_sec",
         "value": round(cont_tps, 1),
@@ -1529,6 +1665,8 @@ def main():
         payload["elastic"] = elastic_payload
     if tenant_payload is not None:
         payload["tenants"] = tenant_payload
+    if mt_payload is not None:
+        payload["multitenant"] = mt_payload
     print(json.dumps(payload))
     if args.out:
         from chainermn_tpu.utils import atomic_json_dump

@@ -248,6 +248,17 @@ def default_rules() -> List[Watch]:
                         "backpressure; the scale-out signal)",
         ),
         Watch(
+            "tenant_starvation", "serve.policy.starved_tenant", ">= 0",
+            severity="warning", hysteresis=3, key_by_value=True,
+            description="a tenant's rolling queue-wait p95 breached "
+                        "CMN_POLICY_STARVATION_MS across consecutive "
+                        "evaluations — the policy plane's fair shares "
+                        "or weights are mis-tuned for this load shape "
+                        "(value = tenant index; −1 = nobody, never "
+                        "fires; key_by_value: each starved tenant "
+                        "files its own incident)",
+        ),
+        Watch(
             "migration_failed", "serve.migration.failed", "> 0",
             severity="critical",
             description="a KV-block migration frame was dropped or torn "

@@ -56,6 +56,15 @@ Six layers:
   (fence → drain → revive, one replica at a time, health-gated on
   probation graduation; a mid-rollout death pauses and files a
   critical incident).
+* :mod:`~chainermn_tpu.serving.policy` — the multi-tenant policy plane:
+  one :class:`~chainermn_tpu.serving.policy.PolicyPlane` the Scheduler
+  and Router consult at every admission/eviction/steal decision —
+  weighted fair queuing over a VTC-style service clock charged from the
+  ledger's cost seams, priority preemption through the
+  recompute-requeue path, drift-driven chunked-prefill budgeting
+  (Sarathi-style, hysteresis-latched), and per-tenant isolation knobs
+  (rate limits, prefix-cache quotas, deadline/shed defaults).  All
+  host-side: ``decode_compiles == 1`` holds with policy ON.
 * :mod:`~chainermn_tpu.serving.disagg` — disaggregated prefill/decode:
   the KV-block migration primitive (live blocks + block table + carried
   tokens shipped as framed ``send_obj`` payloads over the hostcomm p2p
@@ -79,6 +88,7 @@ from chainermn_tpu.serving.disagg import (
 )
 from chainermn_tpu.serving.elastic import Autoscaler, RollingDeploy
 from chainermn_tpu.serving.engine import DecodeEngine
+from chainermn_tpu.serving.policy import PolicyPlane, TenantPolicy
 from chainermn_tpu.serving.kv_pool import (
     BlockAllocator,
     PagedKVPool,
@@ -117,9 +127,11 @@ __all__ = [
     "RollingDeploy",
     "Completion",
     "FleetHealth",
+    "PolicyPlane",
     "Request",
     "Router",
     "Scheduler",
+    "TenantPolicy",
     "chaos_schedule",
     "drain_all",
     "serve_disaggregated",

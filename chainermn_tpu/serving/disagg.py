@@ -172,6 +172,10 @@ def _pack_entry(entry: _QueueEntry) -> dict:
             # a pre-ISSUE-16 sender simply lacks the key and Request's
             # dataclass default fills "default" at unpack.
             "tenant": r.tenant,
+            # Additive (ISSUE 19): priority class survives migration,
+            # recovery re-dispatch and disagg handoff; pre-ISSUE-19
+            # frames lack the key and the dataclass default fills 0.
+            "priority": r.priority,
         },
         "carried": list(entry.carried),
         "evictions": entry.evictions,

@@ -38,6 +38,16 @@ Three rules keep the trie honest:
   into a live slot (refcount > 1) is never evicted from under it, and
   inner nodes outlive their children so every cached chain stays
   reachable from the root.
+
+Multi-tenant quotas (ISSUE 19): every node remembers the tenant that
+first cached it (``owner``), and :attr:`quotas` caps how many trie
+blocks each named tenant may pin.  The cap is enforced *at insert
+time*: a tenant at its quota recycles its OWN least-recently-used
+eligible leaf to make room, and stops inserting when it has none —
+one tenant's churn can displace only its own cached prefixes, never
+another tenant's trie nodes.  Pool-pressure :meth:`evict` stays
+tenant-blind (capacity pressure is everyone's problem; isolation is
+about who a CACHE WRITER may displace).
 """
 
 from __future__ import annotations
@@ -46,15 +56,20 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class _Node:
-    __slots__ = ("tokens", "block", "parent", "children", "stamp")
+    __slots__ = ("tokens", "block", "parent", "children", "stamp",
+                 "owner")
 
     def __init__(self, tokens: Tuple[int, ...], block: int,
-                 parent: Optional["_Node"]):
+                 parent: Optional["_Node"],
+                 owner: Optional[str] = None):
         self.tokens = tokens
         self.block = block
         self.parent = parent
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.stamp = 0
+        #: tenant that FIRST cached this block (quota accounting);
+        #: None = unattributed (quota-exempt).
+        self.owner = owner
 
 
 class PrefixCache:
@@ -73,6 +88,12 @@ class PrefixCache:
         self.allocator = allocator
         self._root_children: Dict[Tuple[int, ...], _Node] = {}
         self._clock = 0
+        #: per-tenant trie block caps (ISSUE 19) — the policy plane
+        #: shares its live quota view here by reference; tenants not
+        #: listed are uncapped.
+        self.quotas: Dict[str, int] = {}
+        #: live owned-node counts behind the quota check.
+        self._owner_count: Dict[str, int] = {}
         # Incremental node count: the scheduler reads it per admission /
         # retirement (the ``serve.prefix.cached_blocks`` gauge), so it
         # must not be a trie walk.
@@ -136,13 +157,21 @@ class PrefixCache:
         return blocks, matched
 
     # ----------------------------------------------------------- insert
-    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int],
+               owner: Optional[str] = None) -> int:
         """Register the FULL blocks backing ``tokens`` (``blocks[i]``
         holds ``tokens[i*BL:(i+1)*BL]``; a trailing partial block must
         not be passed).  Already-cached chains dedupe in place — the
-        existing node's block wins and the duplicate is left to its
-        current holders.  Takes one allocator reference per NEW node.
-        Returns the number of nodes added."""
+        existing node's block wins (and keeps its original owner) and
+        the duplicate is left to its current holders.  Takes one
+        allocator reference per NEW node.  Returns the number of nodes
+        added.
+
+        ``owner`` attributes each NEW node to a tenant for quota
+        accounting: a tenant at its :attr:`quotas` cap recycles its OWN
+        least-recently-used eligible leaf per new node, and the insert
+        stops early when it has none to recycle — never touching
+        another tenant's nodes (ISSUE 19)."""
         BL = self.block_len
         if len(blocks) * BL > len(tokens):
             raise ValueError(
@@ -151,6 +180,7 @@ class PrefixCache:
                 "cacheable"
             )
         self._clock += 1
+        quota = self.quotas.get(owner) if owner is not None else None
         added = 0
         children = self._root_children
         parent: Optional[_Node] = None
@@ -158,11 +188,23 @@ class PrefixCache:
             key = tuple(tokens[i * BL:(i + 1) * BL])
             node = children.get(key)
             if node is None:
+                if quota is not None and \
+                        self._owner_count.get(owner, 0) >= quota:
+                    # Over quota: make room from this owner's OWN
+                    # cached leaves, or stop inserting.  (A node just
+                    # added this call is never a victim — its block is
+                    # still slot-held, refcount > 1.)
+                    if not self._evict_owner(owner):
+                        break
                 self.allocator.share([b])
-                node = _Node(key, b, parent)
+                node = _Node(key, b, parent, owner=owner)
                 children[key] = node
                 self._count += 1
                 added += 1
+                if owner is not None:
+                    self._owner_count[owner] = (
+                        self._owner_count.get(owner, 0) + 1
+                    )
             node.stamp = self._clock
             parent = node
             children = node.children
@@ -199,6 +241,26 @@ class PrefixCache:
                 released += 1
         return released
 
+    def _evict_owner(self, owner: str) -> bool:
+        """Release ``owner``'s least-recently-used LEAF node whose
+        block only the trie holds (refcount 1) — the quota-recycle
+        move.  Returns whether a block was released."""
+        victim: Optional[_Node] = None
+        stack = list(self._root_children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            elif node.owner == owner and \
+                    self.allocator.refcount(node.block) == 1 and \
+                    (victim is None or node.stamp < victim.stamp):
+                victim = node
+        if victim is None:
+            return False
+        self._detach(victim)
+        self.allocator.free([victim.block])
+        return True
+
     def _detach(self, node: _Node) -> None:
         siblings = (
             node.parent.children if node.parent is not None
@@ -206,6 +268,8 @@ class PrefixCache:
         )
         del siblings[node.tokens]
         self._count -= 1
+        if node.owner is not None:
+            self._owner_count[node.owner] -= 1
 
     def clear(self) -> int:
         """Drop every cached reference (gc/retire pass): the allocator
@@ -220,4 +284,5 @@ class PrefixCache:
             released += 1
         self._root_children = {}
         self._count = 0
+        self._owner_count = {}
         return released

@@ -102,7 +102,7 @@ class Router:
                  retry_budget: Optional[int] = None,
                  probation_ticks: Optional[int] = None,
                  shed_depth: Optional[int] = None,
-                 ledger=None):
+                 ledger=None, policy=None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability.metrics import (
             DEFAULT_MS_EDGES,
@@ -176,6 +176,16 @@ class Router:
             self.ledger = _oledger.CostLedger(registry=registry)
         else:
             self.ledger = None
+        #: Multi-tenant policy plane (ISSUE 19): ONE fleet plane shared
+        #: by the router's own dispatch pick and every replica (revivals
+        #: and scale-ups included), so the fair-share clocks, rate
+        #: limits and prefix quotas are fleet-coherent — exactly the
+        #: shared-ledger discipline.  ``fleet`` flips so replicas defer
+        #: the per-tenant queue-depth census to the router's fleet-wide
+        #: one.  None keeps FIFO dispatch bit-for-bit.
+        self.policy = policy
+        if policy is not None:
+            policy.fleet = True
         self.schedulers: List[Scheduler] = [
             Scheduler(
                 eng, registry=reg, clock=self.clock,
@@ -183,6 +193,7 @@ class Router:
                 ledger=(
                     self.ledger if self.ledger is not None else False
                 ),
+                policy=policy,
             )
             for eng, reg, ring, fi in zip(
                 engines, self.replica_registries, self.rings, faults
@@ -373,7 +384,21 @@ class Router:
         this pick and the next candidate tried."""
         progressed = self._drain_recovered()
         now = self.clock.now()
-        while self._queue and self._queue[0].arrival <= now:
+        while self._queue:
+            if self.policy is None:
+                if self._queue[0].arrival > now:
+                    break
+                qi = 0
+            else:
+                # Weighted-fair dispatch (ISSUE 19): the holdback pick
+                # runs on the same fleet plane the replicas consult, so
+                # the order work LEAVES the router already honors the
+                # fair-share clocks (per-tenant FIFO within a tenant).
+                # None = nothing arrived, or every arrived tenant is
+                # rate-throttled this instant — both wait here.
+                qi = self.policy.pick_index(self._queue, now)
+                if qi is None:
+                    break
             t0 = time.perf_counter()
             ranked = self._ranked_replicas()
             if not ranked:
@@ -381,7 +406,7 @@ class Router:
                 # is never lost) — count the deferral, surface depth.
                 self._m_bp.inc()
                 break
-            req = self._queue[0]
+            req = self._queue[qi]
             placed = None
             misfit = None
             for i in ranked:
@@ -405,7 +430,7 @@ class Router:
                 ):
                     self._m_bp.inc()
                     break
-                self._queue.pop(0)
+                self._queue.pop(qi)
                 self._terminal_request(
                     req, "poisoned",
                     error=f"PoolExhausted: {misfit}",
@@ -415,7 +440,7 @@ class Router:
                     self.incidents.evaluate()
                 progressed = True
                 continue
-            self._queue.pop(0)
+            self._queue.pop(qi)
             self.assignments.setdefault(req.id, []).append(placed)
             self._since_gauge[placed] += 1
             ms = (time.perf_counter() - t0) * 1e3
@@ -434,12 +459,45 @@ class Router:
         the holdback queue, refuse the newest-arrived
         (``status="shed"``) — bounded queues instead of unbounded
         latency collapse.  0 (the default) disables shedding; future
-        arrivals never count (they are not waiting yet)."""
+        arrivals never count (they are not waiting yet).
+
+        Per-tenant depths (ISSUE 19): a policy tenant with its own
+        ``shed_depth`` gets the same newest-first discipline applied to
+        ITS arrived backlog alone — a bursty tenant's overflow sheds at
+        its cap without the fleet cap ever engaging, and without
+        another tenant's requests counting against it."""
+        progressed = False
+        if self.policy is not None:
+            for tenant in sorted({r.tenant for r in self._queue}):
+                depth = self.policy.shed_depth(tenant)
+                if not depth:
+                    continue
+                t_arrived = [
+                    r for r in self._queue
+                    if r.tenant == tenant and r.arrival <= now
+                ]
+                if len(t_arrived) <= depth:
+                    continue
+                victims = sorted(
+                    t_arrived, key=lambda r: r.arrival
+                )[depth:]
+                shed_ids = {id(v) for v in victims}
+                self._queue = [
+                    r for r in self._queue if id(r) not in shed_ids
+                ]
+                for req in sorted(victims, key=lambda r: -r.arrival):
+                    self._terminal_request(
+                        req, "shed",
+                        error=f"tenant {tenant!r} holdback depth > "
+                              f"{depth}",
+                    )
+                    self.health.m_shed.inc()
+                progressed = True
         if not self.shed_depth:
-            return False
+            return progressed
         arrived = [r for r in self._queue if r.arrival <= now]
         if len(arrived) <= self.shed_depth:
-            return False
+            return progressed
         victims = sorted(arrived, key=lambda r: r.arrival)[
             self.shed_depth:
         ]
@@ -665,6 +723,7 @@ class Router:
             engine, registry=reg, clock=self.clock,
             timeline=RequestTimeline(ring=ring), fault=fault,
             ledger=self.ledger if self.ledger is not None else False,
+            policy=self.policy,
         )
         self._since_gauge[i] = 0
         self.health.start_probation(i)
@@ -698,6 +757,7 @@ class Router:
             engine, registry=reg, clock=self.clock,
             timeline=RequestTimeline(ring=ring), fault=fault,
             ledger=self.ledger if self.ledger is not None else False,
+            policy=self.policy,
         ))
         self._since_gauge.append(0)
         self._occ_sum.append(0.0)
@@ -870,6 +930,17 @@ class Router:
             self._occ_sum[i] += o
         self._occ_n += 1
         self._ticks += 1
+        if self.policy is not None:
+            # Fleet-wide per-tenant queue census: holdback + parked
+            # recovered work + every UP replica's queue — the
+            # ``serve.tenant.<t>.queue_depth`` gauges the starvation
+            # rule and dashboards read.
+            census = [r.tenant for r in self._queue]
+            census += [e.req.tenant for e in self._recovered]
+            for j, s in enumerate(self.schedulers):
+                if s is not None and self.health.is_up(j):
+                    census += [e.req.tenant for e in s._queue]
+            self.policy.publish_queue(census)
         if self.incidents is not None and \
                 self._ticks % self._inc_every == 0:
             self.incidents.evaluate()
@@ -904,7 +975,24 @@ class Router:
             self.submit(r)
         while self.pending:
             if not self.tick():
-                nxt = [r.arrival for r in self._queue[:1]]
+                if self.policy is None:
+                    nxt = [r.arrival for r in self._queue[:1]]
+                else:
+                    # Policy dispatch can pick ANY queued entry, and a
+                    # fully-throttled holdback unblocks at the earliest
+                    # rate release, not an arrival — cover both, with
+                    # the min arrival as the no-candidate fallback
+                    # (parity with the FIFO head).
+                    now = self.clock.now()
+                    nxt = [
+                        r.arrival for r in self._queue
+                        if r.arrival > now
+                    ]
+                    rel = self.policy.next_release(self._queue, now)
+                    if rel is not None:
+                        nxt.append(rel)
+                    if not nxt and self._queue:
+                        nxt = [min(r.arrival for r in self._queue)]
                 nxt += [
                     t for t in (
                         s.next_arrival()

@@ -146,6 +146,14 @@ class Request:
     #: Additive like ``deadline_ms`` — old callers and pre-ISSUE-16
     #: ``cmn-kvmig-1`` frames default to ``"default"``.
     tenant: str = "default"
+    #: priority class (ISSUE 19): under a
+    #: :class:`~chainermn_tpu.serving.policy.PolicyPlane`, a strictly
+    #: higher class may preempt a running lower-class slot through the
+    #: recompute-requeue path; 0 defers to the tenant's default class.
+    #: Additive like ``tenant`` — old callers and pre-ISSUE-19
+    #: ``cmn-kvmig-1`` frames default to 0, and the field rides the
+    #: codec so a harvested/migrated entry keeps its class.
+    priority: int = 0
 
 
 @dataclass
@@ -291,7 +299,7 @@ class Scheduler:
     def __init__(self, engine, registry=None, clock: Optional[_Clock] = None,
                  slo=None, timeline=None, memory=None, incidents=None,
                  fault=None, deadline_ms: Optional[float] = None,
-                 ledger=None):
+                 ledger=None, policy=None):
         import chainermn_tpu.observability as _obs
         from chainermn_tpu.observability import flight as _flight
         from chainermn_tpu.observability import tracing as _tracing
@@ -329,6 +337,18 @@ class Scheduler:
             deadline_ms if deadline_ms is not None
             else deadline_ms_from_env()
         )
+        #: Multi-tenant policy plane (ISSUE 19): consulted at every
+        #: admission / eviction / steal decision.  The router passes
+        #: ONE fleet plane into every replica (revivals and scale-ups
+        #: included) so the fair-share clocks and rate limits are
+        #: fleet-coherent, exactly like the shared ledger.  None keeps
+        #: the original FIFO behavior bit-for-bit.
+        self.policy = policy
+        if policy is not None and getattr(engine, "prefix", None) is not None:
+            # The prefix trie enforces per-tenant block quotas at
+            # insert time — hand it the plane's live quota view (one
+            # dict, shared by reference across replicas).
+            engine.prefix.quotas = policy.prefix_quotas
         enabled = _obs.enabled()
         # An explicitly passed registry always publishes; the ambient
         # global registry rides the CMN_OBS master switch like every
@@ -616,9 +636,28 @@ class Scheduler:
         youngest is the right victim for the same reason eviction picks
         it: the head of the queue is the oldest waiter (possibly an
         evicted re-admission carrying generated tokens) and keeps its
-        position."""
+        position.
+
+        Under a policy plane the victim is instead the weighted-fair
+        admission HEAD — the entry this scheduler would serve next.  The
+        steal's destination is an idle replica, so moving the fair head
+        only accelerates the fair schedule; stealing the youngest
+        regardless of tenant would let an adversarial tenant's backlog
+        ride a rebalance ahead of an SLO tenant's queue (ISSUE 19)."""
         if not self._queue:
             return None
+        if self.policy is not None:
+            idx = self.policy.steal_index(
+                [e.req for e in self._queue], self.clock.now()
+            )
+            if idx is None:
+                return None
+            entry = self._queue.pop(idx)
+            if self.timeline is not None:
+                self.timeline.record(
+                    "steal", t=self.clock.now(), req=entry.req.id,
+                )
+            return entry
         entry = self._queue[-1]
         if entry.req.arrival > self.clock.now():
             return None
@@ -673,6 +712,10 @@ class Scheduler:
                 # book the recompute-requeue.
                 self.ledger.set_blocks(slot.entry.req.id, 0, now)
                 self.ledger.book(slot.entry.req.id, "evictions", 1)
+            if self.policy is not None:
+                self.policy.set_blocks(
+                    slot.entry.req.id, slot.entry.req.tenant, 0, now
+                )
             self._slots[slot.idx] = None
             out.append(slot.entry)
             if self.timeline is not None:
@@ -706,10 +749,13 @@ class Scheduler:
 
     # ----------------------------------------------------------- deadline
     def _deadline_s(self, req: Request) -> Optional[float]:
-        dl = (
-            req.deadline_ms if req.deadline_ms is not None
-            else self._default_deadline_ms
-        )
+        # Specificity order: the request's own deadline, then its
+        # tenant's policy default (ISSUE 19), then the fleet default.
+        dl = req.deadline_ms
+        if dl is None and self.policy is not None:
+            dl = self.policy.deadline_ms(req.tenant)
+        if dl is None:
+            dl = self._default_deadline_ms
         return dl / 1e3 if dl is not None and dl > 0 else None
 
     def _cancel_deadlines(self) -> bool:
@@ -725,6 +771,10 @@ class Scheduler:
             if dl is None or now - slot.entry.req.arrival <= dl:
                 continue
             self.engine.release_blocks(slot.blocks)
+            if self.policy is not None:
+                self.policy.set_blocks(
+                    slot.entry.req.id, slot.entry.req.tenant, 0, now
+                )
             self._slots[slot.idx] = None
             slot.entry.carried = (
                 list(slot.entry.carried) + list(slot.generated)
@@ -771,10 +821,33 @@ class Scheduler:
         return any(s is None for s in self._slots)
 
     def next_arrival(self) -> Optional[float]:
-        """The head entry's arrival time (admission is strictly FIFO,
-        so the head is the only entry whose arrival can unblock
-        anything), or None on an empty queue."""
-        return self._queue[0].req.arrival if self._queue else None
+        """The next time an admission can unblock, or None on an empty
+        queue.  FIFO: the head entry's arrival (the head is the only
+        entry whose arrival can unblock anything).  Under a policy
+        plane any queued entry is pickable, so the bound is the min
+        future arrival — and when every ARRIVED tenant is
+        rate-throttled, the earliest throttle release (otherwise an
+        idle-skip loop would jump to an already-past arrival and
+        spin)."""
+        if not self._queue:
+            return None
+        if self.policy is None:
+            return self._queue[0].req.arrival
+        now = self.clock.now()
+        cands = [
+            e.req.arrival for e in self._queue if e.req.arrival > now
+        ]
+        rel = self.policy.next_release(
+            [e.req for e in self._queue], now
+        )
+        if rel is not None:
+            cands.append(rel)
+        if not cands:
+            # Everything has arrived and nobody is throttled — the old
+            # contract (an already-past time: no skip, admission is
+            # gated on slots, not the clock).
+            return min(e.req.arrival for e in self._queue)
+        return min(cands)
 
     def _worst_prefill_end(self, lo: int, hi: int) -> int:
         """Max padded prefill end over admission text lengths in
@@ -796,12 +869,41 @@ class Scheduler:
         if not self._queue:
             return False
         now = self.clock.now()
-        entry = self._queue[0]
-        if entry.req.arrival > now:
-            return False
+        if self.policy is None:
+            entry = self._queue[0]
+            if entry.req.arrival > now:
+                return False
+        else:
+            # Weighted-fair pick (ISSUE 19): the first-queued entry of
+            # the arrived, un-throttled tenant with the smallest
+            # virtual service clock.  None = nothing arrived, or every
+            # arrived tenant is rate-throttled this instant.
+            qidx = self.policy.pick_index(
+                [e.req for e in self._queue], now
+            )
+            if qidx is None:
+                return False
+            entry = self._queue[qidx]
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
-            return False
+            if self.policy is None:
+                return False
+            # Priority preemption: a strictly higher class may evict
+            # the lowest-class (youngest among equals) running slot
+            # through the recompute-requeue path.  The victim re-queues
+            # at the GLOBAL head, which is its tenant's head too — it
+            # was admitted before anything still queued from its tenant
+            # (per-tenant FIFO) — and `retries` is untouched (that
+            # counter means replica deaths, not scheduling decisions).
+            victim = self.policy.preempt_pick(
+                [s for s in self._slots if s is not None],
+                self.policy.effective_priority(entry.req),
+            )
+            if victim is None:
+                return False
+            self._evict_slot(victim, preempted=True)
+            self.policy.note_preemption(victim.entry.req.tenant)
+            free = [i for i, s in enumerate(self._slots) if s is None]
         eng = self.engine
         BL = eng.block_len
         text = list(entry.req.prompt) + list(entry.carried)
@@ -825,13 +927,18 @@ class Scheduler:
             matched, blocks, first = self._admission_plan(text)
             if not eng.pool.allocator.can_alloc(first):
                 return False
-        self._queue.pop(0)
+        # Remove by identity: a preemption above re-queued its victim
+        # at index 0, so the picked entry's index may have shifted.
+        self._queue.remove(entry)
+        if self.policy is not None:
+            self.policy.note_admission(entry.req)
         if entry.first_admit is None:
             entry.first_admit = now
+            wait_ms = (now - entry.req.arrival) * 1e3
             if self.slo is not None:
-                self.slo.observe(
-                    "queue_wait", (now - entry.req.arrival) * 1e3
-                )
+                self.slo.observe("queue_wait", wait_ms)
+            if self.policy is not None:
+                self.policy.note_queue_wait(entry.req.tenant, wait_ms)
             if self.ledger is not None:
                 # First admission FLEET-WIDE: first_admit rides the
                 # migration codec, so re-admissions (eviction, harvest,
@@ -883,6 +990,10 @@ class Scheduler:
             # sharing saves COMPUTE, the pool pressure is real).
             self.ledger.set_blocks(
                 entry.req.id, len(slot.blocks), now
+            )
+        if self.policy is not None:
+            self.policy.set_blocks(
+                entry.req.id, entry.req.tenant, len(slot.blocks), now
             )
         self.engine.seed_slot(free[0], entry.req.seed,
                               entry.req.temperature)
@@ -958,11 +1069,14 @@ class Scheduler:
         return matched
 
     # ----------------------------------------------------------- eviction
-    def _evict_youngest(self) -> bool:
-        live = [s for s in self._slots if s is not None]
-        if not live:
-            return False
-        victim = max(live, key=lambda s: s.admit_seq)
+    def _evict_slot(self, victim: _Slot, preempted: bool = False) -> None:
+        """Evict ``victim`` through the recompute-requeue path — THE one
+        eviction discipline, shared by pool-pressure eviction and
+        priority preemption (ISSUE 19): generated tokens fold into
+        ``carried``, the entry re-queues at the head (its tenant's head
+        too — it predates everything still queued from its tenant), and
+        the re-admission re-matches its own just-cached prefix, so the
+        continuation is greedy-identical and nearly free."""
         self.engine.release_blocks(victim.blocks)
         victim.entry.carried = (
             list(victim.entry.carried) + list(victim.generated)
@@ -970,19 +1084,30 @@ class Scheduler:
         victim.entry.evictions += 1
         self._queue.insert(0, victim.entry)
         self._slots[victim.idx] = None
+        now = self.clock.now()
         if self.ledger is not None:
             # Settle the occupancy integral at release; the re-admission
             # restarts it (recompute cost books as fresh prefill tokens).
-            self.ledger.set_blocks(
-                victim.entry.req.id, 0, self.clock.now()
-            )
+            self.ledger.set_blocks(victim.entry.req.id, 0, now)
             self.ledger.book(victim.entry.req.id, "evictions", 1)
-        if self.timeline is not None:
-            self.timeline.record(
-                "evict", t=self.clock.now(), req=victim.entry.req.id,
-                slot=victim.idx,
-                info={"carried": len(victim.entry.carried)},
+        if self.policy is not None:
+            self.policy.set_blocks(
+                victim.entry.req.id, victim.entry.req.tenant, 0, now
             )
+        if self.timeline is not None:
+            info = {"carried": len(victim.entry.carried)}
+            if preempted:
+                info["preempted"] = True
+            self.timeline.record(
+                "evict", t=now, req=victim.entry.req.id,
+                slot=victim.idx, info=info,
+            )
+
+    def _evict_youngest(self) -> bool:
+        live = [s for s in self._slots if s is not None]
+        if not live:
+            return False
+        self._evict_slot(max(live, key=lambda s: s.admit_seq))
         return True
 
     def _alloc_blocks(self, slot: _Slot, n: int) -> Optional[List[int]]:
@@ -1029,12 +1154,18 @@ class Scheduler:
                 slot.table[len(slot.blocks)] = b
                 slot.blocks.append(b)
             grew = True
-        if grew and self.ledger is not None:
-            # New occupancy level from here on (piecewise-constant
-            # integration: the old level was settled up to now).
-            self.ledger.set_blocks(
-                slot.entry.req.id, len(slot.blocks), self.clock.now()
-            )
+        if grew:
+            if self.ledger is not None:
+                # New occupancy level from here on (piecewise-constant
+                # integration: the old level was settled up to now).
+                self.ledger.set_blocks(
+                    slot.entry.req.id, len(slot.blocks), self.clock.now()
+                )
+            if self.policy is not None:
+                self.policy.set_blocks(
+                    slot.entry.req.id, slot.entry.req.tenant,
+                    len(slot.blocks), self.clock.now(),
+                )
 
     def _resolve_cow(self, slot: _Slot) -> None:
         """Copy-on-write the slot's borrowed PARTIAL prefix block before
@@ -1071,13 +1202,33 @@ class Scheduler:
         extra iterations.
         """
         progressed = False
+        # Drift-driven chunked-prefill budget (ISSUE 19, Sarathi-style):
+        # while the policy's SLO latch is engaged, cap the prefill
+        # tokens started per iteration.  The FIRST candidate always
+        # runs (prefill can never wedge — progress is guaranteed even
+        # with a cap below one chunk), and the cap is chunk-granular:
+        # the final chunk that crosses it completes.
+        budget = (
+            self.policy.prefill_budget() if self.policy is not None
+            else None
+        )
+        spent, first = 0, True
         for slot in sorted(
             (s for s in self._slots if s is not None and s.prefilling),
             key=lambda s: s.admit_seq,
         ):
             if self._slots[slot.idx] is not slot:
                 continue  # evicted by an earlier candidate's allocation
+            if budget is not None and not first and spent >= budget:
+                self.policy.note_prefill_capped()
+                break
+            p_before = slot.pos
             progressed = self._prefill_chunk(slot) or progressed
+            # The slot object survives retirement/eviction, and an
+            # eviction-under-pressure bails before advancing pos — the
+            # delta is exactly the tokens this chunk computed.
+            spent += max(0, slot.pos - p_before)
+            first = False
         return progressed
 
     def _prefill_chunk(self, slot: _Slot) -> bool:
@@ -1115,6 +1266,13 @@ class Scheduler:
             self.ledger.book(
                 slot.entry.req.id, "prefill_tokens", end - p0
             )
+        if self.policy is not None:
+            # The fair-share clock charges the SAME computed-token count
+            # the ledger books — net of prefix hits by construction
+            # (p0 starts past the matched prefix).
+            self.policy.charge(
+                slot.entry.req.tenant, "prefill_tokens", end - p0
+            )
         # A final chunk's first-token readback drains every dispatch
         # queued before it; a non-final chunk is dispatch-only and its
         # compute drains into the NEXT synced op (the mixed-iteration
@@ -1136,6 +1294,7 @@ class Scheduler:
                 eng.prefix.insert(
                     slot.text,
                     slot.blocks[: len(slot.text) // eng.block_len],
+                    owner=slot.entry.req.tenant,
                 )
                 self._m_px_cached.set(eng.prefix.cached_blocks)
             first_token_ever = not slot.entry.carried
@@ -1215,6 +1374,11 @@ class Scheduler:
         if self.slo is not None and \
                 self._iterations % self.slo.check_every == 0:
             self.slo.check()
+            if self.policy is not None:
+                # Feed the fresh verdict into the drift latch on the
+                # check cadence — hysteresis counts CHECKS, not
+                # iterations, mirroring the autoscaler's streaks.
+                self.policy.on_slo_check(self.slo.last_report)
         if self.incidents is not None and \
                 self._iterations % self._mem_every == 0:
             # Watch-rule evaluation on the SLO-check cadence, AFTER the
@@ -1238,6 +1402,10 @@ class Scheduler:
                 # nothing (the harvest books the eviction instead).
                 self.ledger.book(
                     s.entry.req.id, "decode_iterations", 1
+                )
+            if self.policy is not None:
+                self.policy.charge(
+                    s.entry.req.tenant, "decode_iterations", 1
                 )
             if k:
                 # One speculative round: emit the accepted drafts plus
@@ -1309,11 +1477,14 @@ class Scheduler:
             eng.prefix.insert(
                 seq[: slot.pos],
                 slot.blocks[: slot.pos // eng.block_len],
+                owner=req.tenant,
             )
             self._m_px_cached.set(eng.prefix.cached_blocks)
         eng.release_blocks(slot.blocks)
         self._slots[slot.idx] = None
         now = self.clock.now()
+        if self.policy is not None:
+            self.policy.set_blocks(req.id, req.tenant, 0, now)
         usage = (
             self.ledger.finalize(req.id, "ok", now)
             if self.ledger is not None else None
@@ -1361,6 +1532,14 @@ class Scheduler:
             progressed = True
         self._m_queue.set(len(self._queue))
         self._m_occ.set(self.slot_occupancy)
+        if self.policy is not None and not self.policy.fleet:
+            # Standalone scheduler: its queue IS the fleet view.  Under
+            # a router (policy.fleet) the router publishes the
+            # fleet-wide census instead — per-replica publishes would
+            # thrash the shared gauges.
+            self.policy.publish_queue(
+                [e.req.tenant for e in self._queue]
+            )
         return progressed
 
     def run(self, requests: Optional[Sequence[Request]] = None
@@ -1371,13 +1550,21 @@ class Scheduler:
         while self.pending:
             if not self.tick():
                 if not any(s is not None for s in self._slots):
-                    # Idle: jump the clock to the HEAD entry's arrival —
-                    # admission is strictly FIFO, so the head is the only
-                    # entry whose arrival can unblock anything; skipping
-                    # to a later entry's earlier arrival would leave the
-                    # loop spinning until the head's time on the real
-                    # clock.
-                    self.clock.skip_to(self._queue[0].req.arrival)
+                    # Idle: jump the clock to the next admission-
+                    # unblocking time.  FIFO: the HEAD entry's arrival
+                    # (the head is the only entry whose arrival can
+                    # unblock anything; skipping to a later entry's
+                    # earlier arrival would leave the loop spinning
+                    # until the head's time on the real clock).  Policy:
+                    # the min future arrival OR the earliest throttle
+                    # release — a fully-throttled queue must advance
+                    # the clock, never spin (next_arrival covers both).
+                    nxt = self.next_arrival()
+                    if nxt is None or nxt <= self.clock.now():
+                        raise RuntimeError(
+                            "scheduler made no progress on arrived work"
+                        )
+                    self.clock.skip_to(nxt)
                 else:  # pragma: no cover - defensive
                     raise RuntimeError(
                         "scheduler made no progress with live slots"

@@ -97,6 +97,10 @@ def _chaos_drive(make_model, tiny_params, prompts, oracle, seed,
     return harness, report, reg
 
 
+@pytest.mark.slow  # tier-1 wall budget: the same acceptance
+# schedule runs tier-1 with the policy plane ON
+# (test_serve_policy.py::test_chaos_with_policy_on); the counter
+# envelope rides the seeded battery + drop_migrate/probation tests
 def test_chaos_terminal_invariant_explicit_schedule(
     make_model, tiny_params, prompts, oracle
 ):

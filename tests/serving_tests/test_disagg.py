@@ -590,6 +590,9 @@ def test_migration_failed_default_incident_rule(tmp_path):
 
 
 # ----------------------------------------------------------- preemption
+@pytest.mark.slow  # tier-1 wall budget: the 2-OS-rank SIGTERM drain
+# acceptance (multiprocess_tests/test_disagg_preempt.py) keeps the
+# zero-loss contract tier-1; this is the in-process twin
 def test_preemption_drain_zero_loss_oracle(make_model, tiny_params,
                                            oracle):
     """SIGTERM-shaped drain (programmatic ``request()`` through the real

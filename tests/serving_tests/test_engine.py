@@ -97,6 +97,9 @@ def test_einsum_engine_same_tokens(make_model, tiny_params, prompts, oracle):
     assert eng.decode_compiles == 1
 
 
+@pytest.mark.slow  # tier-1 wall budget: the fp and einsum oracle
+# twins above stay tier-1; the int8 pool planes are pinned fast by
+# the kv_pool battery
 def test_int8_paged_engine_matches_sequential_greedy(
     make_model, prompts, oracle
 ):

@@ -1,0 +1,725 @@
+"""Policy-plane battery (ISSUE 19): weighted fair admission against an
+exact VTC oracle, priority preemption greedy-identical to the
+unpreempted twin, the drift-latched prefill cap, prefix-quota
+isolation, rate-limit throttling with exactly-once terminals, the
+policy-aware rebalance steal, per-tenant deadline/shed defaults, the
+``tenant_starvation`` default incident rule, and the seeded chaos
+schedule re-run with policy ON.
+"""
+
+import pytest
+
+from chainermn_tpu.observability.metrics import MetricsRegistry
+from chainermn_tpu.serving import (
+    ChaosHarness,
+    DecodeEngine,
+    PolicyPlane,
+    Request,
+    Router,
+    Scheduler,
+    TenantPolicy,
+    verify_terminal_invariant,
+)
+from chainermn_tpu.serving.policy import (
+    decode_cost_from_env,
+    drift_hysteresis_from_env,
+    prefill_cap_from_env,
+    starvation_ms_from_env,
+    tenant_spec_from_env,
+)
+
+pytestmark = [pytest.mark.tier1, pytest.mark.serving]
+
+
+def _mk_engine(make_model, tiny_params, capacity=2, num_blocks=24):
+    return DecodeEngine(
+        make_model(), tiny_params, capacity=capacity,
+        num_blocks=num_blocks, block_len=8, prefill_chunk=8,
+    )
+
+
+def _req(i, prompt, tenant="default", priority=0, max_new=5, **kw):
+    return Request(id=i, prompt=prompt, max_new_tokens=max_new,
+                   tenant=tenant, priority=priority, **kw)
+
+
+def _drain_check(sched):
+    """Zero-leak baseline: after drop_prefix_cache the pool is fully
+    free again (the 1-block engine scratch stays reserved)."""
+    eng = sched.engine
+    eng.drop_prefix_cache()
+    assert sched.memory.check_drained(eng) == 0
+
+
+# ------------------------------------------------------------ VTC oracle
+def test_vtc_pick_matches_exact_oracle():
+    """Host-only: drive pick/charge through a long two-tenant backlog
+    and replay every decision against an independent in-test VTC
+    implementation — identical pick sequence, and service splits by
+    weight (w=1 vs w=3 → 1:3)."""
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("a", weight=1.0),
+                 TenantPolicy("b", weight=3.0)],
+        registry=MetricsRegistry(),
+    )
+    queue = []
+    rid = 0
+    for _ in range(40):
+        for t in ("a", "b"):
+            queue.append(_req(rid, [1, 2, 3], tenant=t))
+            rid += 1
+    # Independent oracle: vt[t] += cost / weight, pick min (vt, index).
+    vt = {"a": 0.0, "b": 0.0}
+    weights = {"a": 1.0, "b": 3.0}
+    index = {"a": 0, "b": 1}
+    served = {"a": 0, "b": 0}
+    cost = 10.0
+    for _ in range(40):
+        idx = plane.pick_index(queue, now=0.0)
+        picked = queue[idx].tenant
+        expect = min(vt, key=lambda t: (vt[t], index[t]))
+        assert picked == expect, (plane.state(), vt)
+        queue.pop(idx)
+        plane.charge(picked, "prefill_tokens", cost)
+        vt[picked] += cost / weights[picked]
+        served[picked] += 1
+    assert served == {"a": 10, "b": 30}
+    # Equal raw charge per pick → virtual clocks track the oracle.
+    st = plane.state()
+    assert st["virtual"]["a"] == pytest.approx(vt["a"])
+    assert st["virtual"]["b"] == pytest.approx(vt["b"])
+
+
+def test_vtc_activation_lift_banks_no_credit():
+    """A tenant that idles while others burn service re-enters at the
+    busiest floor, not at zero — it may not replay its idle time as a
+    monopoly."""
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("busy"), TenantPolicy("late")],
+        registry=MetricsRegistry(),
+    )
+    queue = [_req(i, [1], tenant="busy") for i in range(8)]
+    for _ in range(4):
+        idx = plane.pick_index(queue, now=0.0)
+        plane.charge(queue.pop(idx).tenant, "prefill_tokens", 50)
+    assert plane.virtual["busy"] == 200.0
+    # "late" joins after 200 units of busy service: lifted to the floor.
+    queue.append(_req(99, [1], tenant="late"))
+    idx = plane.pick_index(queue, now=0.0)
+    assert plane.virtual["late"] == 200.0
+    # Tie broken by first-sighting index — busy keeps the head.
+    assert queue[idx].tenant == "busy"
+
+
+@pytest.mark.slow  # tier-1 wall budget: the exact VTC oracle +
+# activation-lift tests pin the pick rule fast; this is the real-
+# engine integration twin
+def test_weighted_share_end_to_end(make_model, tiny_params, prompts,
+                                   oracle):
+    """Two backlogged tenants through a real capacity-1 scheduler: the
+    admission log is weight-ordered, every request completes ok with
+    greedy tokens identical to ``lm_generate``, the decode step compiled
+    once, zero blocks leak."""
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("a", weight=1.0),
+                 TenantPolicy("b", weight=3.0)],
+        registry=MetricsRegistry(),
+    )
+    sched = Scheduler(eng, registry=MetricsRegistry(), policy=plane)
+    reqs = []
+    for i in range(6):
+        t = "a" if i % 2 == 0 else "b"
+        reqs.append(_req(i, prompts[i % len(prompts)], tenant=t,
+                         max_new=4))
+    for r in reqs:
+        sched.submit(r)
+    comps = sched.run()
+    assert len(comps) == 6 and all(c.status == "ok" for c in comps)
+    for c in comps:
+        assert c.tokens == oracle(
+            eng.model, tiny_params, prompts[c.id % len(prompts)], 4
+        ), c.id
+    # Weight 3 drains b's backlog ahead of a's: b's LAST admission
+    # precedes a's (the first pick is the vt-0 tie, broken to a by
+    # first-sighting index — deterministic too).
+    log = plane.admission_log
+    assert len(log) == 6
+    admitted = [t for _, t, _ in log]
+    assert admitted[0] == "a", log
+    assert admitted.count("a") == 3 and admitted.count("b") == 3
+    assert (
+        max(i for i, t in enumerate(admitted) if t == "b")
+        < max(i for i, t in enumerate(admitted) if t == "a")
+    ), log
+    per_tenant = {"a": [], "b": []}
+    for _, t, v in log:
+        per_tenant[t].append(v)
+    for t in per_tenant:  # per-tenant clocks only move forward
+        assert per_tenant[t] == sorted(per_tenant[t])
+    assert plane.charged["a"] > 0 and plane.charged["b"] > 0
+    assert eng.decode_compiles == 1
+    _drain_check(sched)
+
+
+# ----------------------------------------------------------- preemption
+def test_priority_preemption_greedy_identical(make_model, tiny_params,
+                                              prompts, oracle):
+    """A high-class arrival preempts the running low-class slot through
+    the recompute-requeue path: the victim's continuation is
+    greedy-identical to its unpreempted twin, ``retries`` stays 0
+    (that counter means replica deaths), and the high request finishes
+    first."""
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    reg = MetricsRegistry()
+    plane = PolicyPlane(registry=reg)
+    sched = Scheduler(eng, registry=MetricsRegistry(), policy=plane)
+    sched.submit(_req(0, prompts[1], tenant="lo", max_new=12))
+    for _ in range(4):  # admit + start decoding the low request
+        sched.tick()
+    assert any(s is not None for s in sched._slots)
+    sched.submit(_req(1, prompts[2], tenant="hi", priority=5,
+                      max_new=4))
+    comps = sched.run()
+    by_id = {c.id: c for c in comps}
+    assert plane.preemptions == 1
+    assert reg.peek("serve.policy.preemptions").value == 1
+    assert reg.peek("serve.tenant.lo.preempted").value == 1
+    assert by_id[0].evictions == 1 and by_id[0].retries == 0
+    assert by_id[1].finished_at <= by_id[0].finished_at
+    # Both greedy-identical to the unpreempted twin.
+    assert by_id[0].tokens == oracle(eng.model, tiny_params,
+                                     prompts[1], 12)
+    assert by_id[1].tokens == oracle(eng.model, tiny_params,
+                                     prompts[2], 4)
+    assert eng.decode_compiles == 1
+    _drain_check(sched)
+
+
+def test_preempt_pick_lowest_class_youngest():
+    """Victim selection is the eviction discipline: strictly-outranked
+    slots only, lowest class first, youngest admission among equals."""
+    plane = PolicyPlane(registry=MetricsRegistry())
+
+    class _S:
+        def __init__(self, prio, seq):
+            self.entry = type("E", (), {})()
+            self.entry.req = _req(seq, [1], priority=prio)
+            self.admit_seq = seq
+
+    slots = [_S(1, 0), _S(1, 7), _S(3, 2)]
+    v = plane.preempt_pick(slots, incoming_class=2)
+    assert v.admit_seq == 7  # class 1 outranked; youngest of the two
+    assert plane.preempt_pick(slots, incoming_class=1) is None
+    assert plane.preempt_pick(slots, incoming_class=4).admit_seq == 7
+
+
+# -------------------------------------------------------- prefill budget
+def test_drift_latch_engage_release():
+    """The Sarathi latch is hysteresis-gated both ways: engages only
+    after ``drift_hysteresis`` consecutive breaching checks, releases
+    only after the same number of clean ones."""
+    reg = MetricsRegistry()
+    plane = PolicyPlane(registry=reg, prefill_cap=8, drift_hysteresis=2)
+    breach = {"token": {"breached": True}}
+    clean = {"token": {"breached": False}, "ttft": {"breached": None}}
+    assert plane.prefill_budget() is None
+    plane.on_slo_check(breach)
+    assert not plane.prefill_cap_active  # 1 of 2
+    plane.on_slo_check(clean)
+    plane.on_slo_check(breach)
+    assert not plane.prefill_cap_active  # streak reset by the clean one
+    plane.on_slo_check(breach)
+    assert plane.prefill_cap_active
+    assert plane.prefill_budget() == 8
+    assert reg.peek("serve.policy.prefill_cap_active").value == 1
+    plane.on_slo_check(clean)
+    assert plane.prefill_cap_active  # 1 clean of 2
+    plane.on_slo_check(clean)
+    assert not plane.prefill_cap_active
+    assert plane.prefill_budget() is None
+    assert reg.peek("serve.policy.prefill_cap_active").value == 0
+
+
+@pytest.mark.slow  # tier-1 wall budget: the synthetic drift-latch
+# test + the pinned-cap budget test pin engage/release and
+# enforcement fast; this is the fault-injected integration twin
+def test_prefill_cap_engages_under_skew(make_model, tiny_params,
+                                        prompts, oracle):
+    """``skew@serve_step`` inflates per-token latency past the absolute
+    SLO target → consecutive breaching checks latch the cap mid-run —
+    and the capped schedule still produces oracle-identical tokens
+    (budgeting reorders prefill work, never results)."""
+    from chainermn_tpu.observability.slo import SLOMonitor
+    from chainermn_tpu.resilience.faults import (
+        FaultInjector,
+        parse_fault_spec,
+    )
+
+    eng = _mk_engine(make_model, tiny_params, capacity=2)
+    reg = MetricsRegistry()
+    plane = PolicyPlane(registry=reg, prefill_cap=8, drift_hysteresis=2)
+    slo = SLOMonitor(registry=reg, min_samples=2, window=8,
+                     check_every=2, targets={"token": 0.01})
+    sched = Scheduler(
+        eng, registry=reg, policy=plane, slo=slo,
+        fault=FaultInjector(parse_fault_spec("skew@serve_step:2:20ms")),
+    )
+    for i in range(4):
+        sched.submit(_req(i, prompts[i % len(prompts)], max_new=8))
+    comps = sched.run()
+    assert all(c.status == "ok" for c in comps) and len(comps) == 4
+    for c in comps:
+        assert c.tokens == oracle(
+            eng.model, tiny_params, prompts[c.id % len(prompts)], 8
+        )
+    # The 20ms stretch on every step from iteration 2 blows the 0.01ms
+    # target: the latch engaged during the run and is still up (skew
+    # never stops).
+    assert plane.prefill_cap_active
+    assert reg.peek("serve.policy.prefill_cap_active").value == 1
+    assert eng.decode_compiles == 1
+    _drain_check(sched)
+
+
+def test_prefill_cap_budget_enforced(make_model, tiny_params, prompts,
+                                     oracle):
+    """With the latch pinned ON and the cap at one chunk, a multi-slot
+    prefill round stops after the first chunk (the capped counter
+    ticks) — chunk-granular, first chunk always runs, outputs
+    unchanged."""
+    eng = _mk_engine(make_model, tiny_params, capacity=3)
+    reg = MetricsRegistry()
+    plane = PolicyPlane(registry=reg, prefill_cap=1,
+                        drift_hysteresis=99)  # pinned ON for the test
+    plane.prefill_cap_active = True
+    sched = Scheduler(eng, registry=reg, policy=plane)
+    for i in range(3):
+        sched.submit(_req(i, prompts[(i + 1) % len(prompts)], max_new=4))
+    comps = sched.run()
+    assert all(c.status == "ok" for c in comps) and len(comps) == 3
+    for c in comps:
+        assert c.tokens == oracle(
+            eng.model, tiny_params, prompts[(c.id + 1) % len(prompts)], 4
+        )
+    assert reg.peek("serve.policy.prefill_capped").value > 0
+    assert eng.decode_compiles == 1
+    _drain_check(sched)
+
+
+# ------------------------------------------------------- prefix quotas
+def test_prefix_quota_recycles_own_leaves_only():
+    """Trie-level isolation: a tenant at quota recycles its OWN
+    least-recently-used eligible leaf per new node and never touches
+    the other tenant's chain."""
+    from chainermn_tpu.serving.kv_pool import BlockAllocator
+    from chainermn_tpu.serving.prefix_cache import PrefixCache
+
+    alloc = BlockAllocator(16)
+    px = PrefixCache(block_len=2, allocator=alloc)
+    px.quotas = {"a": 2}
+    # Tenant b caches one chain; its writer then lets go (trie-only).
+    b_blocks = alloc.alloc(2)
+    px.insert([1, 2, 3, 4], b_blocks, owner="b")
+    alloc.free(b_blocks)
+    # Tenant a fills its quota the same way.
+    for base in (10, 20):
+        blks = alloc.alloc(1)
+        px.insert([base, base + 1], blks, owner="a")
+        alloc.free(blks)
+    assert px._owner_count == {"a": 2, "b": 2}
+    # A third distinct chain from a: recycles a's LRU leaf, count holds.
+    blks = alloc.alloc(1)
+    px.insert([30, 31], blks, owner="a")
+    alloc.free(blks)
+    assert px._owner_count["a"] == 2
+    assert px._owner_count["b"] == 2
+    blocks, matched = px.match([1, 2, 3, 4])
+    assert matched == 4 and blocks == b_blocks  # b untouched
+    assert px.match([10, 11])[1] == 0  # a's LRU chain was the victim
+    assert px.match([30, 31])[1] == 2  # the newcomer is in
+
+
+def test_prefix_quota_isolation_end_to_end(make_model, tiny_params,
+                                           prompts):
+    """Through the scheduler: tenant B caches its prompt, a quota-2
+    tenant A churns distinct prompts, and B's cached prefix survives
+    with A pinned at its cap."""
+    import numpy as np
+
+    eng = _mk_engine(make_model, tiny_params, capacity=1, num_blocks=32)
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("a", prefix_quota=2), TenantPolicy("b")],
+        registry=MetricsRegistry(),
+    )
+    sched = Scheduler(eng, registry=MetricsRegistry(), policy=plane)
+    b_prompt = list(prompts[4])  # len 17 → two full blocks cached
+    sched.submit(_req(0, b_prompt, tenant="b", max_new=2))
+    rng = np.random.RandomState(7)
+    churn = [rng.randint(1, 127, size=17).tolist() for _ in range(5)]
+    for i, p in enumerate(churn):
+        sched.submit(_req(1 + i, p, tenant="a", max_new=2))
+    comps = sched.run()
+    assert all(c.status == "ok" for c in comps) and len(comps) == 6
+    # B's trie chain survived A's churn; A never exceeded its cap.
+    assert eng.prefix.match(b_prompt)[1] >= eng.block_len
+    assert eng.prefix._owner_count.get("a", 0) <= 2
+    assert plane.prefix_quotas is eng.prefix.quotas  # live shared view
+    _drain_check(sched)
+
+
+# ---------------------------------------------------------- rate limits
+@pytest.mark.slow  # tier-1 wall budget: the unlimited-tenant
+# ordering test pins throttle semantics fast; this is the
+# clock-skip drain integration twin
+def test_rate_limit_throttles_exactly_once(make_model, tiny_params,
+                                           prompts, oracle):
+    """A rate-limited tenant's backlog drains in throttle-gated bursts:
+    picks defer while the clock is ahead of the allowance and ``run()``
+    skips to the release time instead of spinning — every request still
+    terminates exactly once, ok."""
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    reg = MetricsRegistry()
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("lim", rate_limit=0.5)], registry=reg,
+    )
+    sched = Scheduler(eng, registry=MetricsRegistry(), policy=plane)
+    reqs = [_req(i, prompts[i % len(prompts)], tenant="lim", max_new=3)
+            for i in range(4)]
+    for r in reqs:
+        sched.submit(r)
+    t_start = sched.clock.now()
+    comps = sched.run()
+    report = verify_terminal_invariant(reqs, comps)
+    assert report["holds"] and report["by_status"]["ok"] == 4
+    for c in comps:
+        assert c.tokens == oracle(
+            eng.model, tiny_params, prompts[c.id % len(prompts)], 3
+        )
+    assert plane.throttle_deferrals > 0
+    assert reg.peek("serve.policy.throttled").value > 0
+    assert reg.peek("serve.tenant.lim.throttled").value > 0
+    # The drain waited out the allowance: at 0.5 units/s the charged
+    # cost bounds the elapsed (virtual) time from below — run() skipped
+    # the clock to each release instead of spinning.
+    assert sched.clock.now() - t_start >= \
+        plane.charged["lim"] / 0.5 - 20.0
+    assert eng.decode_compiles == 1
+    _drain_check(sched)
+
+
+def test_unlimited_tenant_not_blocked_by_throttled_one(
+    make_model, tiny_params, prompts
+):
+    """Throttling is per-tenant eligibility, not a queue freeze: the
+    unlimited tenant keeps admitting while the limited one waits."""
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("lim", rate_limit=1.0),
+                 TenantPolicy("free")],
+        registry=MetricsRegistry(),
+    )
+    sched = Scheduler(eng, registry=MetricsRegistry(), policy=plane)
+    # Exhaust lim's allowance up front so its queue is gated.
+    plane.charge("lim", "prefill_tokens", 1000)
+    reqs = [_req(0, prompts[0], tenant="lim", max_new=2)] + [
+        _req(i, prompts[i], tenant="free", max_new=2)
+        for i in range(1, 4)
+    ]
+    for r in reqs:
+        sched.submit(r)
+    comps = sched.run()
+    assert verify_terminal_invariant(reqs, comps)["holds"]
+    by_id = {c.id: c for c in comps}
+    assert all(c.status == "ok" for c in comps)
+    # Every free admission beat the throttled tenant's.
+    admitted = [t for _, t, _ in plane.admission_log]
+    assert admitted[:3] == ["free", "free", "free"]
+    assert admitted[3] == "lim"
+    assert by_id[0].tokens  # the throttled request still completed
+    _drain_check(sched)
+
+
+# ------------------------------------------------------ rebalance steal
+def test_steal_routes_through_policy_fair_head(make_model, tiny_params,
+                                               prompts):
+    """The adversarial-backlog case: a flooding tenant has charged far
+    past an SLO tenant — the rebalance steal must hand over the FAIR
+    head (the SLO tenant's entry), not the youngest queued request."""
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    plane = PolicyPlane(registry=MetricsRegistry())
+    sched = Scheduler(eng, registry=MetricsRegistry(), policy=plane)
+    for i in range(4):
+        sched.submit(_req(i, prompts[0], tenant="adv", max_new=2))
+    sched.submit(_req(9, prompts[1], tenant="slo", max_new=2))
+    sched.submit(_req(10, prompts[0], tenant="adv", max_new=2))
+    plane.charge("adv", "prefill_tokens", 500)
+    stolen = sched.steal_queued()
+    assert stolen is not None
+    assert stolen.req.tenant == "slo" and stolen.req.id == 9
+    # Without a policy the victim is the youngest — unchanged behavior.
+    sched_fifo = Scheduler(eng, registry=MetricsRegistry())
+    for i in range(3):
+        sched_fifo.submit(_req(i, prompts[0], max_new=2))
+    assert sched_fifo.steal_queued().req.id == 2
+
+
+# --------------------------------------------------- per-tenant defaults
+def test_tenant_deadline_default(make_model, tiny_params, prompts):
+    """A tenant-level deadline catches its requests that carry none;
+    a request's own deadline still wins (specificity order)."""
+    eng = _mk_engine(make_model, tiny_params, capacity=1)
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("slo", deadline_ms=0.01)],
+        registry=MetricsRegistry(),
+    )
+    sched = Scheduler(eng, registry=MetricsRegistry(), policy=plane)
+    sched.submit(_req(0, prompts[0], tenant="slo", max_new=8))
+    sched.submit(_req(1, prompts[1], tenant="slo", max_new=8,
+                      deadline_ms=9e9))
+    sched.submit(_req(2, prompts[2], tenant="other", max_new=4))
+    sched.clock.skip_to(sched.clock.now() + 1.0)
+    comps = sched.run()
+    by_id = {c.id: c for c in comps}
+    assert by_id[0].status == "deadline"  # tenant default applied
+    assert by_id[1].status == "ok"        # own deadline overrides
+    assert by_id[2].status == "ok"        # other tenants untouched
+    _drain_check(sched)
+
+
+def test_tenant_shed_depth(make_model, tiny_params, prompts):
+    """The per-tenant router holdback cap: the bursty tenant's arrived
+    overflow sheds newest-first while the quiet tenant's queue is
+    untouched — terminals exactly-once."""
+    reg = MetricsRegistry()
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("burst", shed_depth=2)], registry=reg,
+    )
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)],
+        registry=reg, max_queue=1, policy=plane,
+    )
+    reqs = [_req(i, prompts[i % len(prompts)], tenant="burst",
+                 max_new=3) for i in range(6)]
+    reqs.append(_req(6, prompts[1], tenant="quiet", max_new=3))
+    comps = router.run(reqs)
+    report = verify_terminal_invariant(reqs, comps)
+    assert report["holds"], report
+    by_id = {c.id: c for c in comps}
+    assert by_id[6].status == "ok"  # quiet tenant never shed
+    shed = sorted(c.id for c in comps if c.status == "shed")
+    assert shed and all(
+        by_id[i].error and "burst" in by_id[i].error for i in shed
+    )
+    # Newest-first within the burst tenant.
+    ok_burst = [c.id for c in comps
+                if c.status == "ok" and c.id != 6]
+    assert max(ok_burst) < min(shed)
+
+
+# ----------------------------------------------------------- starvation
+def test_starvation_gauge_and_default_rule(tmp_path):
+    """CI/tooling satellite: the shipped ``tenant_starvation`` rule is
+    a warning-severity key_by_value watch on the starved-tenant gauge
+    with hysteresis 3 — −1 (nobody) never fires, a starved tenant's
+    index fires once per tenant after three consecutive breaching
+    evaluations."""
+    from chainermn_tpu.observability.incident import (
+        IncidentManager,
+        default_rules,
+    )
+
+    rules = [r for r in default_rules() if r.name == "tenant_starvation"]
+    assert rules and rules[0].metric == "serve.policy.starved_tenant"
+    assert rules[0].severity == "warning"
+    assert rules[0].key_by_value and rules[0].hysteresis == 3
+    reg = MetricsRegistry()
+    plane = PolicyPlane(registry=reg, starvation_ms=100.0)
+    mgr = IncidentManager(registry=reg, rules=rules,
+                          directory=str(tmp_path), cooldown_s=0.0)
+    # Healthy: waits under the envelope keep the gauge at −1.
+    plane.note_queue_wait("a", 5.0)
+    assert reg.peek("serve.policy.starved_tenant").value == -1
+    for _ in range(5):
+        assert mgr.evaluate() == []
+    # Tenant b's rolling p95 breaches: gauge names its index, the rule
+    # fires after 3 consecutive evaluations, keyed by tenant.
+    for _ in range(8):
+        plane.note_queue_wait("b", 500.0)
+    assert reg.peek("serve.policy.starved_tenant").value == \
+        plane.tenant_index("b")
+    assert mgr.evaluate() == [] and mgr.evaluate() == []
+    fired = mgr.evaluate()
+    assert len(fired) == 1
+    assert fired[0]["rule"]["name"] == "tenant_starvation"
+    assert mgr.evaluate() == []  # latched for this tenant
+
+
+# ------------------------------------------------------------- env knobs
+def test_env_knob_parsing(monkeypatch):
+    monkeypatch.setenv("CMN_POLICY_PREFILL_CAP", "64")
+    monkeypatch.setenv("CMN_POLICY_DRIFT_HYSTERESIS", "4")
+    monkeypatch.setenv("CMN_POLICY_COST_DECODE", "3")
+    monkeypatch.setenv("CMN_POLICY_STARVATION_MS", "250")
+    assert prefill_cap_from_env() == 64
+    assert drift_hysteresis_from_env() == 4
+    assert decode_cost_from_env() == 3
+    assert starvation_ms_from_env() == 250.0
+    monkeypatch.setenv("CMN_POLICY_PREFILL_CAP", "junk")
+    assert prefill_cap_from_env() == 32  # tolerant default
+    monkeypatch.setenv(
+        "CMN_SERVE_TENANT_SPEC",
+        "slo:weight=4,priority=2,deadline_ms=500;"
+        "batch:weight=1,rate=200,quota=8,shed=3;"
+        "bad:weight=oops;;",
+    )
+    spec = tenant_spec_from_env()
+    assert spec["slo"].weight == 4 and spec["slo"].priority == 2
+    assert spec["slo"].deadline_ms == 500.0
+    assert spec["batch"].rate_limit == 200.0
+    assert spec["batch"].prefix_quota == 8
+    assert spec["batch"].shed_depth == 3
+    assert spec["bad"].weight == 1.0  # bad fragment skipped, not fatal
+    plane = PolicyPlane(registry=MetricsRegistry())
+    assert plane.tenants["batch"].prefix_quota == 8
+    assert plane.prefix_quotas == {"batch": 8}
+    with pytest.raises(ValueError):
+        TenantPolicy("x", weight=0.0)
+    with pytest.raises(ValueError):
+        PolicyPlane(registry=MetricsRegistry()).charge("t", "nope", 1)
+
+
+def test_policy_noop_when_obs_off(monkeypatch):
+    """registry=None + CMN_OBS off → noop instruments, mechanisms still
+    decide (the obs latch, not a kill switch)."""
+    import chainermn_tpu.observability as obs
+
+    monkeypatch.delenv("CMN_SERVE_TENANT_SPEC", raising=False)
+    obs.set_enabled(False)
+    try:
+        plane = PolicyPlane()
+        plane.note_preemption("t")
+        plane.note_queue_wait("t", 1e9)
+        assert plane.pick_index([_req(0, [1], tenant="t")], 0.0) == 0
+        assert plane.preemptions == 1
+    finally:
+        obs.set_enabled(None)
+
+
+# -------------------------------------------------- priority over codec
+def test_priority_rides_migration_codec():
+    """Satellite regression: ``Request.priority`` rides the
+    ``cmn-kvmig-1`` codec additively — round-trips intact, and a frame
+    from a pre-ISSUE-19 sender unpacks to the class-0 default."""
+    from chainermn_tpu.serving.disagg import _pack_entry, _unpack_entry
+    from chainermn_tpu.serving.scheduler import _QueueEntry
+
+    entry = _QueueEntry(_req(3, [5, 6, 7], tenant="vip", priority=4))
+    rec = _pack_entry(entry)
+    assert rec["req"]["priority"] == 4
+    back = _unpack_entry(rec)
+    assert back.req.priority == 4 and back.req.tenant == "vip"
+    # Pre-ISSUE-19 frame: no priority key → dataclass default 0.
+    del rec["req"]["priority"]
+    assert _unpack_entry(rec).req.priority == 0
+
+
+@pytest.mark.slow  # tier-1 wall budget: the codec round-trip test
+# pins priority-through-cmn-kvmig-1 fast; this is the crash-harvest
+# integration twin
+def test_harvested_entry_keeps_priority(make_model, tiny_params,
+                                        prompts, oracle):
+    """A high-priority entry harvested off a dead replica re-dispatches
+    still carrying its class: on the survivor it preempts the running
+    low-class slot instead of waiting behind it."""
+    from chainermn_tpu.resilience.faults import (
+        FaultInjector,
+        parse_fault_spec,
+    )
+
+    reg = MetricsRegistry()
+    plane = PolicyPlane(registry=reg)
+    router = Router(
+        [_mk_engine(make_model, tiny_params, capacity=1)
+         for _ in range(2)],
+        registry=reg, policy=plane,
+        faults=[FaultInjector(parse_fault_spec("crash@serve_step:2")),
+                None],
+    )
+    reqs = [
+        _req(0, prompts[0], tenant="hi", priority=5, max_new=6),
+        _req(1, prompts[1], tenant="lo", max_new=6),
+    ]
+    comps = router.run(reqs)
+    report = verify_terminal_invariant(reqs, comps)
+    assert report["holds"] and report["by_status"]["ok"] == 2
+    by_id = {c.id: c for c in comps}
+    assert by_id[0].retries == 1  # died once, re-dispatched
+    for c in comps:
+        assert c.tokens == oracle(
+            router.schedulers[1].engine.model, tiny_params,
+            prompts[c.id], 6,
+        )
+
+
+# ------------------------------------------------------- chaos, policy ON
+def test_chaos_with_policy_on(make_model, tiny_params, prompts, oracle):
+    """The ISSUE-15 acceptance schedule re-run with the policy plane ON
+    and mixed tenants/classes: exactly-once terminals, survivors
+    greedy-identical, one decode compile per serving replica, zero
+    leaked blocks, and the fleet ledger's conservation oracle holds."""
+    from chainermn_tpu.observability.ledger import CostLedger
+
+    reg = MetricsRegistry()
+    ledger = CostLedger(registry=reg)
+    plane = PolicyPlane(
+        tenants=[TenantPolicy("slo", weight=3.0, priority=1),
+                 TenantPolicy("batch", weight=1.0)],
+        registry=reg,
+    )
+    harness = ChaosHarness(
+        lambda: _mk_engine(make_model, tiny_params),
+        replicas=3, seed=0, registry=reg, revive_after=2,
+        schedule={
+            "seed": None,
+            "replica_faults": [
+                "crash@serve_step:4",
+                "skew@serve_step:2:5ms;crash@serve_step:8",
+                None,
+            ],
+            "router_faults": "drop@migrate:1",
+        },
+        policy=plane, ledger=ledger,
+    )
+    n = 8
+    reqs = [
+        _req(i, prompts[i % len(prompts)],
+             tenant="slo" if i % 2 else "batch",
+             priority=1 if i % 2 else 0, max_new=5)
+        for i in range(n)
+    ]
+    report = harness.run(reqs)
+    assert report["holds"], report
+    assert sum(report["by_status"].values()) == n
+    router = harness.router
+    eng0 = router.schedulers[0].engine
+    for c in router.completions:
+        if c.status == "ok":
+            assert c.tokens == oracle(
+                eng0.model, tiny_params,
+                prompts[c.id % len(prompts)], 5,
+            ), (c.id, c.retries, c.evictions)
+    served = 0
+    for i, s in enumerate(router.schedulers):
+        if not router.health.is_up(i):
+            continue
+        assert s.engine.decode_compiles <= 1, (i, report)
+        if s._iterations:
+            assert s.engine.decode_compiles == 1, (i, report)
+            served += 1
+        assert s.memory.check_drained(s.engine) == 0, i
+    assert served > 0
+    # One shared plane fleet-wide; the cost books balance with policy ON.
+    assert all(s.policy is plane for s in router.schedulers)
+    assert ledger.verify_conservation()["holds"]
+    assert plane.charged  # the clocks actually advanced under chaos

@@ -168,6 +168,39 @@ assert "elastic_replica_seconds_saved_pct" not in fit4
 assert "rollout_zero_loss" not in fit4
 assert fit4["metric"] == "m" and fit4["value"] == 1.0
 
+# Policy-arm pointers (ISSUE 19): the SLO tenant's p95-held verdict +
+# the fairness-throughput percentage — present only when the serving
+# headline carries the multitenant SLO-policy arm, and both ride the
+# _fit_summary droppable list.
+srv7 = {"tokens_per_sec": 9.9, "speedup_vs_static": 1.6,
+        "slo_tenant_p95_held": True, "fairness_throughput_pct": 98.7,
+        "artifact": "result/serving_tpu.json", **blob}
+ok7 = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv7, None,
+)
+assert len(json.dumps(ok7)) <= bench.SUMMARY_MAX_BYTES
+assert ok7["slo_tenant_p95_held"] is True, ok7
+assert ok7["fairness_throughput_pct"] == 98.7, ok7
+no_pol = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv, None,
+)  # absent arm -> absent pointers
+assert "slo_tenant_p95_held" not in no_pol
+assert "fairness_throughput_pct" not in no_pol
+fat6 = {
+    "bench_summary": True, "metric": "m", "value": 1.0,
+    "slo_tenant_p95_held": True, "fairness_throughput_pct": 98.7,
+    # Oversized mass in a field dropped AFTER the policy pointers, so
+    # the shrink loop must shed both on its way down.
+    "perf_sentinel": {"verdict": "green", "note": "y" * 1500},
+}
+fit6 = bench._fit_summary(fat6)
+assert len(json.dumps(fit6)) <= bench.SUMMARY_MAX_BYTES
+assert "slo_tenant_p95_held" not in fit6
+assert "fairness_throughput_pct" not in fit6
+assert fit6["metric"] == "m" and fit6["value"] == 1.0
+
 # Resilience pointers (ISSUE 18): the training-chaos goodput ratio +
 # per-arm recovery_ms p50s — present only when a resilience headline is
 # passed, and both ride the _fit_summary droppable list.
