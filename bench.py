@@ -305,6 +305,13 @@ def _serving_headline() -> dict | None:
             "fairness_throughput_pct": rec.get(
                 "multitenant", {}
             ).get("fairness_throughput_pct"),
+            # Sharded-decode kernel arm (ISSUE 20), when the artifact
+            # carries it: per-clean-decode-step speedup of the shard_map
+            # Pallas kernel path over the gathered-einsum path on the
+            # same tensor-parallel mesh (contract: >= 1).
+            "sharded_kernel_speedup_vs_einsum": rec.get(
+                "sharded_decode", {}
+            ).get("kernel_speedup_vs_einsum"),
         }
 
     return _best_result("serving*.json", cands)
@@ -507,6 +514,15 @@ def _summary_line(payload: dict, lm=None, dec=None, srv=None,
         summary["fairness_throughput_pct"] = srv[
             "fairness_throughput_pct"
         ]
+    # Sharded-kernel pointer (ISSUE 20): per-clean-decode-step speedup
+    # of the shard_map Pallas kernel path over the gathered einsum on
+    # the same mesh — present only when the serving artifact carries
+    # the sharded-decode A/B.
+    if srv is not None and \
+            srv.get("sharded_kernel_speedup_vs_einsum") is not None:
+        summary["sharded_kernel_speedup_vs_einsum"] = srv[
+            "sharded_kernel_speedup_vs_einsum"
+        ]
     # Training-chaos pointers (ISSUE 18): the peer-restore vs orbax-only
     # goodput ratio and the per-arm recovery_ms p50s, present only when a
     # resilience capture exists (full verdict — bit-exactness, invariant,
@@ -586,6 +602,7 @@ def _fit_summary(summary: dict) -> dict:
               "tenant_top_share", "elastic_replica_seconds_saved_pct",
               "rollout_zero_loss",
               "slo_tenant_p95_held", "fairness_throughput_pct",
+              "sharded_kernel_speedup_vs_einsum",
               "router_tokens_per_sec", "cache_source_commit",
               "serving_artifact", "decode_artifact", "lm_artifact",
               "cache_age_hours", "incident_count", "perf_sentinel",

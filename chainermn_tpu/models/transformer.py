@@ -128,6 +128,15 @@ class _DecoderBlock(nn.Module):
     #: einsum fallback for prefill chunks / window models / lengths past
     #: ``MAX_FUSED_LEN``.  Training paths are untouched either way.
     decode_attention: str = "einsum"
+    #: tensor-parallel serving mesh (``jax.sharding.Mesh``, 1-D) or None.
+    #: When set (``serving.sharding.attach_decode_mesh``) the "fused"
+    #: decode dispatches run the Pallas kernel per shard under
+    #: ``shard_map`` (:func:`~chainermn_tpu.ops.sharded_paged_decode_attention`)
+    #: — queries cut on the head axis, caches/pools on the KV-head axis —
+    #: instead of forcing sharded engines onto the gathered einsum.
+    #: Static (Mesh is hashable) so it composes with flax's module
+    #: dataclass and jit caching.
+    decode_mesh: Any = None
     #: "learned" (parent adds a position table to the embeddings) or
     #: "rope" (this block rotates q/k — the parent adds nothing to ``h``
     #: and passes shared per-step cos/sin ``rope`` tables instead).
@@ -176,6 +185,8 @@ class _DecoderBlock(nn.Module):
             paged_decode_attention,
             reference_attention,
             resolve_attention,
+            sharded_fused_decode_attention,
+            sharded_paged_decode_attention,
         )
         from chainermn_tpu.ops.rope import apply_rope
 
@@ -358,12 +369,26 @@ class _DecoderBlock(nn.Module):
                 )
                 if (self.decode_attention == "fused" and not self.window
                         and (T == 1 or verify)):
-                    a = paged_decode_attention(
-                        q[:, 0] if T == 1 else q, kc, vc, block_tables,
-                        valid,
-                        k_scale=ks_c if quant else None,
-                        v_scale=vs_c if quant else None,
-                    )
+                    if self.decode_mesh is not None:
+                        # Tensor-parallel engines: the kernel runs per
+                        # shard under shard_map (q cut on heads, pool on
+                        # kv heads — the placement the serving plane
+                        # already installs); bit-identical to the
+                        # unsharded call, no collective added here.
+                        a = sharded_paged_decode_attention(
+                            q[:, 0] if T == 1 else q, kc, vc,
+                            block_tables, valid,
+                            k_scale=ks_c if quant else None,
+                            v_scale=vs_c if quant else None,
+                            mesh=self.decode_mesh,
+                        )
+                    else:
+                        a = paged_decode_attention(
+                            q[:, 0] if T == 1 else q, kc, vc, block_tables,
+                            valid,
+                            k_scale=ks_c if quant else None,
+                            v_scale=vs_c if quant else None,
+                        )
                     if T == 1:
                         a = a[:, None]
                 else:
@@ -430,11 +455,19 @@ class _DecoderBlock(nn.Module):
                         )
                 if (T == 1 and not self.window
                         and cache["k"].shape[2] <= MAX_FUSED_LEN):
-                    a = fused_decode_attention(
-                        q[:, 0], kc, vc, q_pos[:, 0] + 1,
-                        k_scale=ks_c if quant else None,
-                        v_scale=vs_c if quant else None,
-                    )[:, None]
+                    if self.decode_mesh is not None:
+                        a = sharded_fused_decode_attention(
+                            q[:, 0], kc, vc, q_pos[:, 0] + 1,
+                            k_scale=ks_c if quant else None,
+                            v_scale=vs_c if quant else None,
+                            mesh=self.decode_mesh,
+                        )[:, None]
+                    else:
+                        a = fused_decode_attention(
+                            q[:, 0], kc, vc, q_pos[:, 0] + 1,
+                            k_scale=ks_c if quant else None,
+                            v_scale=vs_c if quant else None,
+                        )[:, None]
                 else:
                     a = _attend_kv_major(
                         q, kc, vc, q_pos, self.window,
@@ -709,6 +742,13 @@ class TransformerLM(nn.Module):
     #: ``rolling`` streaming decode requires "einsum".  Training paths are
     #: untouched either way.
     decode_attention: str = "einsum"
+    #: tensor-parallel serving mesh (``jax.sharding.Mesh``, 1-D) or None.
+    #: Set by ``serving.sharding.attach_decode_mesh`` on mesh-sharded
+    #: engines: "fused" decode steps then run the Pallas kernels per
+    #: shard under ``shard_map`` (KV-head cut, no new collectives)
+    #: instead of the gathered einsum.  Threads straight through to
+    #: :class:`_DecoderBlock`; single-device use leaves it ``None``.
+    decode_mesh: Any = None
     #: Rematerialize each block in the backward pass (``jax.checkpoint``):
     #: activation memory drops from O(n_layers) residuals+intermediates to
     #: O(n_layers) residuals only, for one extra forward of compute — the
@@ -828,6 +868,7 @@ class TransformerLM(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_group=self.moe_group,
                 decode_attention=self.decode_attention,
+                decode_mesh=self.decode_mesh,
                 param_dtype=self.param_dtype, name=f"block_{i}",
             )
             if cache is not None:

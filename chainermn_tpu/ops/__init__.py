@@ -13,6 +13,8 @@ from chainermn_tpu.ops.decode_attention import (
     MAX_VERIFY_T,
     fused_decode_attention,
     paged_decode_attention,
+    sharded_fused_decode_attention,
+    sharded_paged_decode_attention,
 )
 from chainermn_tpu.ops.rope import apply_rope
 from chainermn_tpu.ops.augment import (
@@ -40,6 +42,8 @@ __all__ = [
     "max_pool_fused",
     "fused_decode_attention",
     "paged_decode_attention",
+    "sharded_fused_decode_attention",
+    "sharded_paged_decode_attention",
     "MAX_FUSED_LEN",
     "MAX_VERIFY_T",
     "chunked_softmax_cross_entropy",

@@ -55,6 +55,22 @@ Pallas hot-op family (``ops/flash_attention.py``) to the inference loop.
 On non-TPU backends the kernel runs in Pallas interpret mode;
 ``tests/ops_tests/test_decode_attention.py`` pins its numerics against
 an einsum oracle (MHA/GQA, ragged ``valid_len``, int8 cache + scales).
+
+**Tensor-parallel (shard_map) entry points**: the Pallas kernels carry
+no GSPMD partitioning rule, so a mesh-sharded caller cannot simply let
+the partitioner propagate through ``pallas_call``.
+:func:`sharded_paged_decode_attention` and
+:func:`sharded_fused_decode_attention` close the gap by running the
+kernel **per shard** under ``jax.shard_map`` over a 1-D mesh: queries
+shard on the query-head axis, caches/pools on the KV-head axis (the
+serving plane's kv-head-major pool layout was chosen in PR 4 with
+exactly this cut in mind), block tables / lengths ride replicated, and
+each shard runs the unmodified kernel over its local ``KH / n`` heads.
+Attention is embarrassingly parallel across KV heads, so the sharded
+output is bit-identical to the unsharded kernel's — no collective is
+introduced; the row-parallel output projection's existing ``psum``
+downstream completes the Megatron cut
+(:mod:`chainermn_tpu.serving.sharding`).
 """
 
 from __future__ import annotations
@@ -373,3 +389,175 @@ def paged_decode_attention(
         return out.reshape(S, KH, T, G, Dh).transpose(0, 2, 1, 3, 4) \
             .reshape(S, T, H, Dh)
     return out.reshape(S, H, Dh)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel (shard_map) entry points
+# ---------------------------------------------------------------------------
+#
+# Both kernels are embarrassingly parallel across KV heads: program
+# (.., kh, ..) touches only kv head ``kh`` of the cache/pool and query
+# group ``kh`` of q.  A 1-D mesh cut on the KV-head axis therefore needs
+# NO collective — each shard runs the unmodified kernel over its
+# ``KH / n`` local heads and the per-shard outputs concatenate on the
+# (query-)head axis, which is exactly the Megatron column cut the
+# serving plane's attention projections already use
+# (``serving/sharding.py — param_spec``).  The wrappers below only
+# declare that cut to ``shard_map``; the kernel body is reused verbatim.
+
+
+def _mesh_axis(mesh, axis: Optional[str]) -> str:
+    if axis is None:
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                f"mesh has axes {mesh.axis_names}; pass axis= explicitly"
+            )
+        axis = mesh.axis_names[0]
+    return axis
+
+
+def sharded_paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    valid_len: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    *,
+    mesh,
+    axis: Optional[str] = None,
+) -> jax.Array:
+    """:func:`paged_decode_attention` under ``shard_map`` on a 1-D mesh.
+
+    Queries shard on the query-head axis, pools (and int8 scales) on the
+    KV-head axis 0 — the layout :func:`serving.sharding.pool_placement`
+    already produces — block tables and lengths ride replicated.  Each
+    shard runs the Pallas kernel over its ``KH / n`` local heads, so the
+    output (sharded like ``q``) is bit-identical to the unsharded call:
+    softmax never crosses KV heads.  Supports the 4-D multi-query verify
+    form and the int8 pool exactly like the unsharded entry.
+
+    ``mesh`` is the serving :class:`jax.sharding.Mesh`; ``axis`` defaults
+    to the mesh's only axis name.  A mesh of size 1 falls through to the
+    plain call.  ``KH % n != 0`` is a :class:`ValueError` naming the
+    failing axes (mirrored ahead of engine construction by
+    ``serving.sharding.validate_geometry``).
+    """
+    axis = _mesh_axis(mesh, axis)
+    n = int(mesh.shape[axis])
+    if n == 1:
+        return paged_decode_attention(
+            q, k_pool, v_pool, block_tables, valid_len, k_scale, v_scale
+        )
+    KH = k_pool.shape[0]
+    if KH % n:
+        raise ValueError(
+            f"KV heads ({KH}, pool axis 0) are not divisible by mesh "
+            f"axis '{axis}' ({n}); the per-shard paged kernel needs a "
+            f"whole number of local KV heads"
+        )
+    multi = q.ndim == 4
+    q_spec = (
+        jax.sharding.PartitionSpec(None, None, axis, None)
+        if multi
+        else jax.sharding.PartitionSpec(None, axis, None)
+    )
+    pool_spec = jax.sharding.PartitionSpec(axis, None, None, None)
+    scale_spec = jax.sharding.PartitionSpec(axis, None, None)
+    rep2 = jax.sharding.PartitionSpec(None, None)
+    rep1 = jax.sharding.PartitionSpec(None)
+    quant = k_pool.dtype == jnp.int8
+    if quant:
+        if k_scale is None or v_scale is None:
+            raise ValueError("int8 pool needs k_scale and v_scale")
+
+        def body(q, kp, vp, tbl, lens, ks, vs):
+            return paged_decode_attention(q, kp, vp, tbl, lens, ks, vs)
+
+        sm = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(q_spec, pool_spec, pool_spec, rep2, rep1,
+                      scale_spec, scale_spec),
+            out_specs=q_spec,
+            check_vma=False,
+        )
+        return sm(q, k_pool, v_pool, block_tables, valid_len,
+                  k_scale, v_scale)
+
+    def body(q, kp, vp, tbl, lens):
+        return paged_decode_attention(q, kp, vp, tbl, lens)
+
+    sm = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(q_spec, pool_spec, pool_spec, rep2, rep1),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return sm(q, k_pool, v_pool, block_tables, valid_len)
+
+
+def sharded_fused_decode_attention(
+    q: jax.Array,
+    kc: jax.Array,
+    vc: jax.Array,
+    valid_len: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    *,
+    mesh,
+    axis: Optional[str] = None,
+) -> jax.Array:
+    """:func:`fused_decode_attention` under ``shard_map`` on a 1-D mesh.
+
+    The contiguous kv-major cache ``(B, KH, L, Dh)`` shards on its
+    KV-head axis 1, queries on the head axis, lengths replicated — the
+    same head cut as :func:`sharded_paged_decode_attention`, applied to
+    the single-sequence (non-paged) decode cache.
+    """
+    axis = _mesh_axis(mesh, axis)
+    n = int(mesh.shape[axis])
+    if n == 1:
+        return fused_decode_attention(q, kc, vc, valid_len, k_scale, v_scale)
+    KH = kc.shape[1]
+    if KH % n:
+        raise ValueError(
+            f"KV heads ({KH}, cache axis 1) are not divisible by mesh "
+            f"axis '{axis}' ({n}); the per-shard fused kernel needs a "
+            f"whole number of local KV heads"
+        )
+    q_spec = jax.sharding.PartitionSpec(None, axis, None)
+    cache_spec = jax.sharding.PartitionSpec(None, axis, None, None)
+    scale_spec = jax.sharding.PartitionSpec(None, axis, None)
+    rep1 = jax.sharding.PartitionSpec(None)
+    quant = kc.dtype == jnp.int8
+    if quant:
+        if k_scale is None or v_scale is None:
+            raise ValueError("int8 cache needs k_scale and v_scale")
+
+        def body(q, kc, vc, lens, ks, vs):
+            return fused_decode_attention(q, kc, vc, lens, ks, vs)
+
+        sm = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(q_spec, cache_spec, cache_spec, rep1,
+                      scale_spec, scale_spec),
+            out_specs=q_spec,
+            check_vma=False,
+        )
+        return sm(q, kc, vc, valid_len, k_scale, v_scale)
+
+    def body(q, kc, vc, lens):
+        return fused_decode_attention(q, kc, vc, lens)
+
+    sm = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(q_spec, cache_spec, cache_spec, rep1),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return sm(q, kc, vc, valid_len)

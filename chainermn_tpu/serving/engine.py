@@ -110,11 +110,15 @@ class DecodeEngine:
         pools (target AND draft) sharded kv-head-major on axis 0, block
         tables / allocator / prefix trie untouched (pure host
         bookkeeping over block ids), control vectors uploaded
-        replicated.  Requires ``decode_attention="einsum"`` and a
-        geometry every sharded axis of which divides the mesh (checked
-        at construction).  The one-compile contract is unchanged: input
-        shardings are stable across steps, so the jit caches never see
-        a second signature.
+        replicated.  Both decode paths work under a mesh:
+        ``decode_attention="fused"`` (the default fast path) runs the
+        Pallas kernels per shard under ``shard_map`` on the KV-head
+        cut — bit-identical to the unsharded kernel, no new
+        collectives — while ``"einsum"`` remains the gathered GSPMD
+        fallback.  The geometry must divide the mesh on the KV-head
+        axis (checked at construction).  The one-compile contract is
+        unchanged: input shardings are stable across steps, so the jit
+        caches never see a second signature.
       device: optional ``jax.Device`` pinning a single-device engine's
         pools and control uploads (the router's N-replicas-on-N-chips
         layout without sharding).  Mutually exclusive with ``mesh``.
@@ -170,9 +174,17 @@ class DecodeEngine:
 
             _sharding.validate_geometry(model, mesh)
             params = _sharding.shard_params(params, mesh)
+            # Fused engines run the Pallas decode kernels per shard
+            # under shard_map (ops.sharded_paged_decode_attention) —
+            # the mesh threads into the model's dispatch as a static
+            # field.  Einsum engines come back unchanged.
+            model = _sharding.attach_decode_mesh(model, mesh)
             if draft_model is not None:
                 _sharding.validate_geometry(draft_model, mesh)
                 draft_params = _sharding.shard_params(draft_params, mesh)
+                draft_model = _sharding.attach_decode_mesh(
+                    draft_model, mesh
+                )
             placement = _sharding.pool_placement(mesh)
             #: where small per-step host arrays (control vectors, RNG
             #: lanes) go: replicated on the mesh — one upload, every

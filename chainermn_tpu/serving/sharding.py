@@ -41,10 +41,17 @@ would turn that into a collective on the hot path for a table that is a
 rounding error next to the KV pool.
 
 The Pallas fused/paged decode kernels do not carry GSPMD partitioning
-rules — a sharded engine therefore requires ``decode_attention=
-"einsum"`` (the gathered fallback partitions cleanly).  Driving the
-Pallas kernels under a mesh needs a ``shard_map`` port, tracked in the
-ROADMAP.
+rules, so GSPMD alone cannot propagate through ``pallas_call`` — instead
+a sharded ``decode_attention="fused"`` engine runs the kernels **per
+shard under** ``shard_map`` (:func:`~chainermn_tpu.ops.
+sharded_paged_decode_attention`): queries cut on the head axis, pools on
+the KV-head axis 0 (the placement above), block tables replicated.
+Attention never crosses KV heads, so the per-shard outputs are
+bit-identical to the unsharded kernel's and no new collective lands on
+the decode hot path — the row-parallel ``proj`` psum that already exists
+completes the reduction.  :func:`attach_decode_mesh` wires the mesh into
+the model's dispatch; ``decode_attention="einsum"`` remains an explicit
+fallback knob (the gathered path partitions cleanly under plain GSPMD).
 """
 
 from __future__ import annotations
@@ -55,6 +62,7 @@ __all__ = [
     "serving_mesh",
     "mesh_model_size",
     "validate_geometry",
+    "attach_decode_mesh",
     "param_spec",
     "shard_params",
     "pool_placement",
@@ -101,12 +109,15 @@ def validate_geometry(model, mesh) -> None:
     """Fail fast when ``model``'s geometry cannot split ``n`` ways.
 
     Only the KV-head axis is MANDATORY: :func:`pool_placement` shards
-    every pool on axis 0, so ``KH % n`` must hold (and with GQA,
-    ``H = KH * groups``, so the query heads divide whenever KH does).
-    Any OTHER indivisible parameter axis (an odd vocab, a prime
-    ``d_ff``) simply falls back to replication leaf-by-leaf in
-    :func:`shard_params` — correct, just less parallel — rather than
-    refusing the model.
+    every pool on axis 0 and the per-shard Pallas kernels
+    (``decode_attention="fused"``) need a whole number of local KV
+    heads, so ``KH % n`` must hold (and with GQA, ``H = KH * groups``,
+    so the query heads divide whenever KH does).  Any OTHER indivisible
+    parameter axis (an odd vocab, a prime ``d_ff``) simply falls back to
+    replication leaf-by-leaf in :func:`shard_params` — correct, just
+    less parallel — rather than refusing the model.  Both decode paths
+    ("fused" shard_map kernels, "einsum" gathered fallback) are legal
+    under a mesh.
     """
     n = mesh_model_size(mesh)
     if n == 1:
@@ -114,17 +125,25 @@ def validate_geometry(model, mesh) -> None:
     kvh = model.n_kv_heads or model.n_heads
     if kvh % n:
         raise ValueError(
-            f"model kv heads ({kvh}) are not divisible by the mesh's "
-            f"model axis ({n}) — the paged pools shard kv-head-major, "
-            "so KH is the one axis that must split"
+            f"model kv heads ({kvh}, the pools' shard axis 0) are not "
+            f"divisible by the mesh's '{MODEL_AXIS}' axis ({n}) — the "
+            "paged pools shard kv-head-major and the per-shard decode "
+            "kernels need whole local KV heads, so KH is the one axis "
+            "that must split"
         )
-    if model.decode_attention != "einsum":
-        raise ValueError(
-            "sharded engines require decode_attention='einsum' (the "
-            "Pallas fused/paged kernels carry no GSPMD partitioning "
-            "rule; a shard_map port is future work) — got "
-            f"{model.decode_attention!r}"
-        )
+
+
+def attach_decode_mesh(model, mesh):
+    """Return ``model`` with the serving mesh wired into its decode
+    dispatch (``decode_mesh`` static field), so ``decode_attention=
+    "fused"`` steps run the Pallas kernels per shard under ``shard_map``.
+
+    A no-op (the same model comes back) for size-1 meshes and for
+    einsum engines — their decode path never consults the mesh.
+    """
+    if mesh_model_size(mesh) == 1 or model.decode_attention != "fused":
+        return model
+    return model.clone(decode_mesh=mesh)
 
 
 def param_spec(path: Sequence[str], leaf):

@@ -217,3 +217,112 @@ def test_paged_idle_slot_zero_valid_is_defined():
     out = np.asarray(paged_decode_attention(q, kp, vp, tbl, valid))
     assert np.isfinite(out).all()
     assert (out[:, 0] == 0).all()  # offset 0: fully masked
+
+
+# ------------------------------------------------- sharded (shard_map)
+def _mesh2():
+    """A 2-way serving mesh over the forced CPU pod (the tests/conftest
+    env hook); KH=2 in the shapes below puts one KV head per shard."""
+    from chainermn_tpu.serving.sharding import serving_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("multi-device CPU rig missing")
+    return serving_mesh(2)
+
+
+def test_sharded_paged_bit_identical_to_unsharded():
+    """The shard_map wrapper is a pure layout move: per-shard kernels
+    over the KV-head cut produce EXACTLY the unsharded kernel's output
+    (softmax never crosses KV heads) — 3-D, 4-D verify, and int8."""
+    from chainermn_tpu.ops import (
+        paged_decode_attention,
+        sharded_paged_decode_attention,
+    )
+
+    mesh = _mesh2()
+    rng = np.random.RandomState(7)
+    S, T, H, KH, Dh, NB, BL, MB = 2, 3, 4, 2, 8, 8, 4, 4
+    q3 = jnp.asarray(rng.randn(S, H, Dh), jnp.float32)
+    q4 = jnp.asarray(rng.randn(S, T, H, Dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    tbl = jnp.asarray(rng.randint(1, NB, size=(S, MB)), jnp.int32)
+    valid = jnp.asarray([5, 14], jnp.int32)
+    for q in (q3, q4):
+        ref = paged_decode_attention(q, kp, vp, tbl, valid)
+        out = sharded_paged_decode_attention(q, kp, vp, tbl, valid,
+                                             mesh=mesh)
+        assert (np.asarray(out) == np.asarray(ref)).all()
+    ks = jnp.asarray(np.abs(rng.rand(KH, NB, BL)) + 0.1, jnp.float32)
+    vs = jnp.asarray(np.abs(rng.rand(KH, NB, BL)) + 0.1, jnp.float32)
+    kp8, vp8 = (kp * 5).astype(jnp.int8), (vp * 5).astype(jnp.int8)
+    ref = paged_decode_attention(q3, kp8, vp8, tbl, valid, ks, vs)
+    out = sharded_paged_decode_attention(q3, kp8, vp8, tbl, valid, ks, vs,
+                                         mesh=mesh)
+    assert (np.asarray(out) == np.asarray(ref)).all()
+
+
+def test_sharded_paged_single_query_is_multi_query_t1():
+    """The T == 1 == 3-D-call identity pin, THROUGH the shard-local
+    entry: the wrapper's 4-D spec at T == 1 must hit the same kernel
+    path as the 3-D spec, bit for bit."""
+    from chainermn_tpu.ops import sharded_paged_decode_attention
+
+    mesh = _mesh2()
+    rng = np.random.RandomState(8)
+    S, H, KH, Dh, NB, BL, MB = 2, 4, 2, 8, 8, 4, 4
+    q = jnp.asarray(rng.randn(S, H, Dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    tbl = jnp.asarray(rng.randint(1, NB, size=(S, MB)), jnp.int32)
+    valid = jnp.asarray([6, 11], jnp.int32)
+    a = sharded_paged_decode_attention(q, kp, vp, tbl, valid, mesh=mesh)
+    b = sharded_paged_decode_attention(q[:, None], kp, vp, tbl, valid,
+                                       mesh=mesh)[:, 0]
+    assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_sharded_fused_bit_identical_to_unsharded():
+    from chainermn_tpu.ops import (
+        fused_decode_attention,
+        sharded_fused_decode_attention,
+    )
+
+    mesh = _mesh2()
+    rng = np.random.RandomState(9)
+    B, H, KH, L, Dh = 3, 4, 2, 8, 8
+    q = jnp.asarray(rng.randn(B, H, Dh), jnp.float32)
+    kc = jnp.asarray(rng.randn(B, KH, L, Dh), jnp.float32)
+    vc = jnp.asarray(rng.randn(B, KH, L, Dh), jnp.float32)
+    valid = jnp.asarray([3, 8, 5], jnp.int32)
+    ref = fused_decode_attention(q, kc, vc, valid)
+    out = sharded_fused_decode_attention(q, kc, vc, valid, mesh=mesh)
+    assert (np.asarray(out) == np.asarray(ref)).all()
+
+
+def test_sharded_wrapper_validation():
+    """Indivisible KV heads must fail up front, naming both axes; a
+    size-1 mesh falls through to the plain kernel call."""
+    from chainermn_tpu.serving.sharding import serving_mesh
+
+    from chainermn_tpu.ops import (
+        paged_decode_attention,
+        sharded_paged_decode_attention,
+    )
+
+    if len(jax.devices()) < 4:
+        pytest.skip("multi-device CPU rig missing")
+    rng = np.random.RandomState(10)
+    S, H, KH, Dh, NB, BL, MB = 2, 4, 2, 8, 8, 4, 4
+    q = jnp.asarray(rng.randn(S, H, Dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(KH, NB, BL, Dh), jnp.float32)
+    tbl = jnp.asarray(rng.randint(1, NB, size=(S, MB)), jnp.int32)
+    valid = jnp.asarray([6, 11], jnp.int32)
+    with pytest.raises(ValueError, match=r"KV heads \(2.*'model' \(4\)"):
+        sharded_paged_decode_attention(q, kp, vp, tbl, valid,
+                                       mesh=serving_mesh(4))
+    ref = paged_decode_attention(q, kp, vp, tbl, valid)
+    out = sharded_paged_decode_attention(q, kp, vp, tbl, valid,
+                                         mesh=serving_mesh(1))
+    assert (np.asarray(out) == np.asarray(ref)).all()

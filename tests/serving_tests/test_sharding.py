@@ -13,10 +13,17 @@ top-level conftest env hook), so every assertion here exercises REAL
    COW resolution on the sharded engine: stable input shardings are part
    of the jit cache key, so this pins that nothing re-places an input
    mid-run.
-3. **Layout** — params land on the Megatron cut (:mod:`sharding`'s spec
+3. **The sharded kernel path** — ``decode_attention="fused"`` engines
+   run the Pallas paged kernel per shard under ``shard_map``
+   (:func:`~chainermn_tpu.ops.sharded_paged_decode_attention`): greedy
+   tokens identical to the sharded-einsum engine with sharing + spec
+   verify on, sampling parity seed for seed, the one-compile contract
+   and CompileWatch budgets intact through ``shard_map``, at mesh sizes
+   2 AND 4 (4 needs ``n_kv_heads=4`` — one local head per shard).
+4. **Layout** — params land on the Megatron cut (:mod:`sharding`'s spec
    table), KV pools shard kv-head-major on axis 0, and the host-side
    bookkeeping (allocator, trie, block tables) is untouched by sharding.
-4. **The rig itself** — a pristine subprocess proves the env hook alone
+5. **The rig itself** — a pristine subprocess proves the env hook alone
    (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) builds the
    pod and a 2-way mesh, independent of this process's conftest.
 """
@@ -45,7 +52,7 @@ def sharded_vs_single(make_model, tiny_params, prompts, model_mesh):
     import jax
     import jax.numpy as jnp
 
-    model = make_model()  # einsum decode path — the sharded requirement
+    model = make_model()  # einsum decode path (the gathered GSPMD arm)
     draft = make_model(n_layers=1)
     draft_params = draft.init(
         jax.random.PRNGKey(1), jnp.zeros((1, 12), jnp.int32)
@@ -100,6 +107,140 @@ def test_one_compile_contract_holds_under_sharding(sharded_vs_single):
     assert sched.prefix_hit_tokens > 0
 
 
+@pytest.fixture(scope="module")
+def sharded_fused_vs_einsum(make_model, tiny_params, model_mesh,
+                            sharded_vs_single):
+    """The kernel-path battery workload: the SAME churny spec+prefix
+    traffic on a 2-way sharded ``decode_attention="fused"`` engine
+    (Pallas kernels per shard under ``shard_map``), compared against
+    the ``sharded_vs_single`` fixture's einsum-path run (the gathered
+    GSPMD fallback — identical pset by construction, so one engine
+    build amortizes into the module's existing pair)."""
+    import jax
+    import jax.numpy as jnp
+
+    draft_params = make_model(n_layers=1).init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 12), jnp.int32)
+    )["params"]
+    rng = np.random.RandomState(3)
+    tpl = rng.randint(1, 128, size=11).tolist()
+    pset = [tpl + rng.randint(1, 128, size=4).tolist() for _ in range(4)]
+    pset += [[5, 9, 77], rng.randint(1, 128, size=15).tolist()]
+    eng = DecodeEngine(
+        make_model(decode_attention="fused"), tiny_params,
+        capacity=2, num_blocks=20, block_len=8, prefill_chunk=8,
+        draft_model=make_model(n_layers=1, decode_attention="fused"),
+        draft_params=draft_params, spec_k=2, mesh=model_mesh,
+    )
+    sched = Scheduler(eng)
+    comps = sched.run([
+        Request(id=i, prompt=p, max_new_tokens=8, seed=100 + i)
+        for i, p in enumerate(pset)
+    ])
+    return {
+        "fused": (eng, sched, {c.id: c.tokens for c in comps}),
+        "einsum": sharded_vs_single["sharded"],
+    }
+
+
+def test_sharded_kernel_greedy_matches_einsum(sharded_fused_vs_einsum):
+    """The tentpole bar: the per-shard Pallas kernel path (prefix
+    sharing + speculative verify ON) is greedy token-identical to the
+    sharded gathered-einsum path."""
+    fused = sharded_fused_vs_einsum["fused"][2]
+    einsum = sharded_fused_vs_einsum["einsum"][2]
+    assert set(fused) == set(einsum) == set(range(6))
+    for rid in einsum:
+        assert fused[rid] == einsum[rid], (
+            f"request {rid}: sharded-kernel tokens diverged from the "
+            f"sharded-einsum engine ({fused[rid]} vs {einsum[rid]})"
+        )
+
+
+def test_sharded_kernel_one_compile_and_watcher(sharded_fused_vs_einsum):
+    """``shard_map`` must not cost the one-compile contract or the
+    CompileWatch plumbing: the fused sharded engine's watched programs
+    stay at their declared budgets (``decode_step <= 1``,
+    ``spec_round <= 1``) under churn, and nothing reads over budget."""
+    from chainermn_tpu.observability import device as odev
+
+    eng, sched, _ = sharded_fused_vs_einsum["fused"]
+    assert eng.decode_compiles == 1, (
+        f"sharded kernel hot loop compiled {eng.decode_compiles} "
+        "variants — shard_map leaked a second signature into the cache"
+    )
+    assert eng.cow_compiles <= 1
+    assert eng.prefill_compiles == len(eng.prefill_ladder)
+    assert sched.prefix_hit_tokens > 0  # sharing was actually live
+    # Watcher-backed accounting reads through shard_map unchanged.
+    assert isinstance(eng._spec, odev.WatchedFunction)
+    assert eng._spec.compiles == 1 and eng._spec.budget == 1
+    for wf in (eng._step, eng._spec, eng._cow):
+        assert not wf.over_budget, wf.program
+    assert "compile_over_budget" not in eng.stats()
+
+
+def test_sharded_kernel_sampling_parity(sharded_fused_vs_einsum, prompts):
+    """Seeded sampling: the kernel and einsum sharded engines draw the
+    same tokens seed for seed (per-slot RNG lanes hash positions, not
+    attention internals; CPU logits are deterministic per path).  Runs
+    through the module fixtures' already-compiled spec engines — the
+    sampling slots ride the verify round's position-0 logits, so this
+    also pins mixed greedy/sampling traffic on the kernel path.
+
+    NOTE: mutates the module engines (more retired requests) — keep
+    this after the compile-count tests in file order."""
+    outs = {}
+    for attn in ("fused", "einsum"):
+        eng, _, _ = sharded_fused_vs_einsum[attn]
+        comps = Scheduler(eng).run([
+            Request(id=10 + i, prompt=prompts[i], max_new_tokens=6,
+                    temperature=0.8, seed=42 + i)
+            for i in range(3)
+        ])
+        outs[attn] = {c.id: c.tokens for c in comps}
+    assert set(outs["fused"]) == {10, 11, 12}
+    assert outs["fused"] == outs["einsum"]
+
+
+@pytest.mark.slow
+def test_sharded_kernel_mesh4(make_model, pod_devices):
+    """The 4-way cut — one KV head per shard (``n_kv_heads=4``), the
+    tightest legal split of the shared geometry: kernel vs einsum
+    sharded engines stay greedy-identical (spec-verify parity under
+    sharding is the 2-way battery's job — no draft here, the mesh-4
+    point is the KH/M == 1 kernel grid).  Behind the slow marker to
+    hold the 800s tier-1 budget — the 2-way battery above is the
+    tier-1 witness; this widens it to the per-shard-grid edge."""
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.serving.sharding import serving_mesh
+
+    mesh4 = serving_mesh(4, devices=pod_devices[:4])
+    params4 = make_model(n_kv_heads=4).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 12), jnp.int32)
+    )["params"]
+    rng = np.random.RandomState(5)
+    tpl = rng.randint(1, 128, size=9).tolist()
+    pset = [tpl + rng.randint(1, 128, size=3).tolist() for _ in range(2)]
+    pset.append(rng.randint(1, 128, size=6).tolist())
+    outs = {}
+    for attn in ("fused", "einsum"):
+        eng = DecodeEngine(
+            make_model(n_kv_heads=4, decode_attention=attn), params4,
+            capacity=2, num_blocks=20, block_len=8, prefill_chunk=8,
+            mesh=mesh4,
+        )
+        comps = Scheduler(eng).run([
+            Request(id=i, prompt=p, max_new_tokens=6)
+            for i, p in enumerate(pset)
+        ])
+        outs[attn] = {c.id: c.tokens for c in comps}
+        assert eng.decode_compiles == 1, attn
+    assert outs["fused"] == outs["einsum"]
+
+
 def test_param_and_pool_layout(make_model, tiny_params, model_mesh):
     """The Megatron cut lands where the spec table says: q heads, kv
     heads, ffn hidden and vocab sharded; the pool kv-head-major on axis
@@ -137,22 +278,27 @@ def test_geometry_validation_fails_fast(make_model, tiny_params,
                                         pod_devices):
     from chainermn_tpu.serving.sharding import serving_mesh
 
-    # 3 does not divide n_kv_heads=2 / d_ff=128 — construction must name
-    # the failing axis, not surface a partitioner error mid-step.
+    # 3 does not divide n_kv_heads=2 — construction must name the
+    # failing axis, not surface a partitioner (or per-shard kernel)
+    # error mid-step.  Same check for BOTH decode paths: the pools
+    # shard kv-head-major either way.
     mesh3 = serving_mesh(3, devices=pod_devices[:3])
-    with pytest.raises(ValueError, match="divisible by the mesh"):
-        DecodeEngine(
-            make_model(), tiny_params, capacity=1, num_blocks=8,
-            block_len=8, prefill_chunk=8, mesh=mesh3,
-        )
-    # Fused decode (Pallas) carries no GSPMD rule — refused up front.
+    for attn in ("einsum", "fused"):
+        with pytest.raises(ValueError, match="divisible by the mesh"):
+            DecodeEngine(
+                make_model(decode_attention=attn), tiny_params,
+                capacity=1, num_blocks=8, block_len=8, prefill_chunk=8,
+                mesh=mesh3,
+            )
+    # Fused decode under a mesh is LEGAL since the shard_map port: the
+    # engine wires the mesh into the model's kernel dispatch.
     mesh2 = serving_mesh(2, devices=pod_devices[:2])
-    with pytest.raises(ValueError, match="einsum"):
-        DecodeEngine(
-            make_model(decode_attention="fused"), tiny_params,
-            capacity=1, num_blocks=8, block_len=8, prefill_chunk=8,
-            mesh=mesh2,
-        )
+    eng = DecodeEngine(
+        make_model(decode_attention="fused"), tiny_params,
+        capacity=1, num_blocks=8, block_len=8, prefill_chunk=8,
+        mesh=mesh2,
+    )
+    assert eng.model.decode_mesh is mesh2
     # mesh and device are mutually exclusive placements.
     with pytest.raises(ValueError, match="mutually exclusive"):
         DecodeEngine(

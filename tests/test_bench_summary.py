@@ -201,6 +201,36 @@ assert "slo_tenant_p95_held" not in fit6
 assert "fairness_throughput_pct" not in fit6
 assert fit6["metric"] == "m" and fit6["value"] == 1.0
 
+# Sharded-kernel pointer (ISSUE 20): the shard_map'd Pallas decode
+# path's per-step speedup over the gathered-einsum fallback — present
+# only when the serving headline carries the sharded-decode A/B arm,
+# and it rides the _fit_summary droppable list.
+srv8 = {"tokens_per_sec": 9.9, "speedup_vs_static": 1.6,
+        "sharded_kernel_speedup_vs_einsum": 1.42,
+        "artifact": "result/serving_tpu.json", **blob}
+ok8 = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv8, None,
+)
+assert len(json.dumps(ok8)) <= bench.SUMMARY_MAX_BYTES
+assert ok8["sharded_kernel_speedup_vs_einsum"] == 1.42, ok8
+no_shard = bench._summary_line(
+    {"metric": "m", "value": 1.0, "unit": "u", "platform": "tpu"},
+    lm, dec, srv, None,
+)  # absent arm -> absent pointer
+assert "sharded_kernel_speedup_vs_einsum" not in no_shard
+fat7 = {
+    "bench_summary": True, "metric": "m", "value": 1.0,
+    "sharded_kernel_speedup_vs_einsum": 1.42,
+    # Oversized mass in a field dropped AFTER the sharded pointer, so
+    # the shrink loop must shed it on its way down.
+    "perf_sentinel": {"verdict": "green", "note": "y" * 1500},
+}
+fit7 = bench._fit_summary(fat7)
+assert len(json.dumps(fit7)) <= bench.SUMMARY_MAX_BYTES
+assert "sharded_kernel_speedup_vs_einsum" not in fit7
+assert fit7["metric"] == "m" and fit7["value"] == 1.0
+
 # Resilience pointers (ISSUE 18): the training-chaos goodput ratio +
 # per-arm recovery_ms p50s — present only when a resilience headline is
 # passed, and both ride the _fit_summary droppable list.
